@@ -1,0 +1,101 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on XLA (via JAX) + Pallas instead of CUDA/cuDNN/NCCL.
+
+Layer map vs the reference (see SURVEY.md):
+  L0/L1  core/{dtype,place,flags,generator}  <- phi/common + backends
+  L2     core/tensor + ops/ (yaml registry)  <- phi kernels + api yaml codegen
+  L4a    autograd/                           <- fluid/eager
+  L4b    jit/                                <- PIR + new_executor + CINN (XLA)
+  L6     nn/, optimizer/, io/, amp/          <- python/paddle/*
+  L3/L7  distributed/                        <- phi/core/distributed + fleet
+  L8     vision/, hapi/                      <- python/paddle/vision, hapi
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core.tensor import Tensor, is_tensor, to_tensor  # noqa: F401
+from paddle_tpu.core.dtype import (  # noqa: F401
+    DType, dtype, bool_ as bool8, uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+    get_default_dtype, set_default_dtype,
+)
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, CustomPlace, Place, TPUPlace, get_device, set_device,
+    is_compiled_with_tpu,
+)
+from paddle_tpu.core.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.core.generator import (  # noqa: F401
+    Generator, get_rng_state, seed, set_rng_state,
+)
+
+# op surface: every registry op becomes a paddle_tpu.<op> function
+from paddle_tpu import ops  # noqa: F401
+from paddle_tpu.ops.registry import API as _OPS_API
+
+globals().update(_OPS_API)
+
+from paddle_tpu.autograd import grad, no_grad, enable_grad, set_grad_enabled  # noqa: F401,E402
+from paddle_tpu import autograd  # noqa: F401,E402
+from paddle_tpu import nn  # noqa: F401,E402
+from paddle_tpu import optimizer  # noqa: F401,E402
+from paddle_tpu import io  # noqa: F401,E402
+from paddle_tpu import amp  # noqa: F401,E402
+from paddle_tpu import jit  # noqa: F401,E402
+from paddle_tpu import framework  # noqa: F401,E402
+from paddle_tpu.framework.io_utils import save, load  # noqa: F401,E402
+from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401,E402
+from paddle_tpu import vision  # noqa: F401,E402
+from paddle_tpu import metric  # noqa: F401,E402
+
+# numpy-style casting helper used across paddle code
+from paddle_tpu.ops.registry import API as _api
+
+
+def randn_like(x, dtype=None):
+    return _api["randn"](x.shape, dtype=dtype or x.dtype)
+
+
+def add_n(inputs):
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def numel(x):
+    return x.size
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def flops(*a, **k):  # filled by hapi.summary later
+    return 0
+
+
+def in_dynamic_mode() -> bool:
+    from paddle_tpu.jit.trace import in_tracing
+    return not in_tracing()
+
+
+def disable_static():
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph Program mode; use "
+        "paddle_tpu.jit.to_static (trace-to-XLA) instead"
+    )
+
+
+def is_grad_enabled():
+    from paddle_tpu.autograd import engine
+    return engine.is_grad_enabled()
+
+
+def device_count():
+    from paddle_tpu.core.place import device_count as _dc
+    return _dc()

@@ -1,0 +1,215 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast at amp/auto_cast.py:860, O1/O2
+lists amp_lists.py, GradScaler grad_scaler.py). TPU-native: the compute
+dtype is bfloat16, which needs NO loss scaling (same exponent range as
+f32) — GradScaler is provided for API parity and for float16 paths, but
+with bf16 it is an identity. auto_cast works by intercepting op dispatch:
+inputs of white-listed ops are cast to the compute dtype at the registry
+boundary (the same point where the reference's generated AMP branch sits,
+eager_gen.py:1885).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtype import convert_dtype, to_jax
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_bfloat16_supported",
+           "is_float16_supported", "white_list", "black_list"]
+
+_state = threading.local()
+
+# O1 lists (reference: python/paddle/amp/amp_lists.py)
+WHITE_LIST = {
+    "matmul", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "nll_loss",
+    "kl_div", "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "rms_norm", "mean", "sum", "cumsum", "var", "std", "norm",
+}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity; level O1 = per-op lists, O2 = cast
+    everything floating to the compute dtype (except black list)."""
+    prev = amp_state()
+    if enable:
+        wl = set(WHITE_LIST)
+        bl = set(BLACK_LIST)
+        if custom_white_list:
+            wl |= set(custom_white_list)
+        if custom_black_list:
+            bl |= set(custom_black_list)
+        _state.amp = {
+            "dtype": convert_dtype(dtype),
+            "level": level,
+            "white": wl,
+            "black": bl,
+        }
+    else:
+        _state.amp = None
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_for_op(op_name, datas):
+    """Called by the op registry before emission: returns datas cast per the
+    active AMP policy."""
+    st = amp_state()
+    if st is None:
+        return datas
+    dt = to_jax(st["dtype"])
+    level = st["level"]
+    if op_name in st["black"]:
+        # compute in f32
+        return [d.astype(jnp.float32)
+                if hasattr(d, "dtype") and jnp.issubdtype(d.dtype,
+                                                          jnp.floating)
+                else d for d in datas]
+    if level == "O2" or op_name in st["white"]:
+        return [d.astype(dt)
+                if hasattr(d, "dtype") and d.dtype == jnp.float32
+                else d for d in datas]
+    return datas
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, **kw):
+    """O2 decoration: cast model params to the compute dtype (reference:
+    paddle.amp.decorate). Master weights: for bf16 on TPU we keep f32 master
+    copies inside optimizer slots when master_weight=True."""
+    from paddle_tpu.nn.layer import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Loss scaler (reference: python/paddle/amp/grad_scaler.py). With
+    bfloat16 this is an identity pass-through (bf16 needs no scaling);
+    dynamic scaling logic is kept for fp16 parity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._already_unscaled:
+            return
+        import jax.numpy as jnp_
+
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                if bool(jnp_.any(~jnp_.isfinite(g))):
+                    found = True
+                p.grad._data = g
+        self._found_inf = found
+        self._already_unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._already_unscaled = False
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        from paddle_tpu.core.tensor import Tensor as T
+        return T(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True
+
+
+# register the dispatch-boundary hook
+from paddle_tpu.ops import registry as _registry  # noqa: E402
+
+_registry.set_amp_hook(cast_for_op)

@@ -1,0 +1,6 @@
+"""Autograd public API (reference: python/paddle/autograd/)."""
+from paddle_tpu.autograd.engine import (  # noqa: F401
+    backward, enable_grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
+from paddle_tpu.autograd.functional import grad, jacobian, hessian, vjp, jvp  # noqa: F401

@@ -1,0 +1,143 @@
+"""Functional / higher-order autodiff.
+
+Reference: python/paddle/autograd/autograd.py (Jacobian/Hessian) and
+python/paddle/incubate/autograd/functional.py:22,80 (vjp/jvp). Here these are
+direct bridges to JAX's transforms — forward-mode (jvp), reverse (vjp/grad),
+and composed jacfwd/jacrev — which is the whole point of building on a
+functional substrate: the reference needed a separate "prim" system
+(paddle/fluid/primitive/) to get composable transforms; XLA-first we inherit
+them.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Union
+
+import jax
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["grad", "jacobian", "hessian", "vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False, no_grad_vars=None):
+    """paddle.grad: grads of ``outputs`` wrt ``inputs`` without touching
+    ``.grad`` accumulators."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+
+    stash = [(t.grad, t._acc_node) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._acc_node = None
+    try:
+        engine.backward(outputs, grad_outputs,
+                        retain_graph=retain_graph or create_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    results.append(
+                        Tensor._from_data(jax.numpy.zeros_like(t._data)))
+                else:
+                    results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, (g, acc) in zip(inputs, stash):
+            t.grad = g
+            t._acc_node = acc
+
+
+def _functionalize(func: Callable):
+    """Wrap a Tensor->Tensor function as a pure jax-array function."""
+
+    def fn(*datas):
+        ins = [Tensor._from_data(d, stop_gradient=False) for d in datas]
+        out = func(*ins) if len(ins) > 1 else func(ins[0])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return fn
+
+
+def _unpack(xs):
+    single = isinstance(xs, Tensor)
+    datas = [xs._data] if single else [x._data for x in xs]
+    return single, datas
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) — reference: incubate/autograd/functional.py:22."""
+    single, datas = _unpack(xs)
+    out, vjp_fn = jax.vjp(_functionalize(func), *datas)
+    if v is None:
+        import jax.numpy as jnp
+        v_data = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_data = v._data if isinstance(v, Tensor) else tuple(
+            t._data for t in v)
+    grads = vjp_fn(v_data)
+    out_t = _wrap(out)
+    grads_t = [Tensor._from_data(g) for g in grads]
+    return out_t, grads_t[0] if single else grads_t
+
+
+def jvp(func, xs, v=None):
+    single, datas = _unpack(xs)
+    if v is None:
+        import jax.numpy as jnp
+        tangents = tuple(jnp.ones_like(d) for d in datas)
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = tuple(t._data for t in vs)
+    out, tang = jax.jvp(_functionalize(func), tuple(datas), tangents)
+    return _wrap(out), _wrap(tang)
+
+
+def _wrap(out):
+    if isinstance(out, tuple):
+        return tuple(Tensor._from_data(o) for o in out)
+    return Tensor._from_data(out)
+
+
+def jacobian(func, xs, batch_axis=None):
+    """Dense Jacobian (lazy in the reference, eager here)."""
+    single, datas = _unpack(xs)
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(datas))))(
+        *datas)
+    if single:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+        return _wrap(jac)
+    return [_wrap(j) for j in jac]
+
+
+def hessian(func, xs, batch_axis=None):
+    single, datas = _unpack(xs)
+    hes = jax.hessian(_functionalize(func), argnums=tuple(range(len(datas))))(
+        *datas)
+    if single:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return _wrap(h)
+    return [[_wrap(c) for c in row] for row in hes]
+
+
+# class-style API parity (paddle.autograd.Jacobian / Hessian)
+class Jacobian:
+    def __init__(self, func, xs, is_batched=False):
+        self._value = jacobian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Hessian(Jacobian):
+    def __init__(self, func, xs, is_batched=False):
+        self._value = hessian(func, xs)

@@ -1,0 +1,107 @@
+"""PyLayer: user-defined forward/backward.
+
+Reference: python/paddle/autograd/py_layer.py + paddle/fluid/eager/pylayer/.
+The user's backward is spliced into the tape as a custom GradNode, exactly
+where a vjp closure would sit.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self._attrs: dict = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # arbitrary attribute stashing, paddle-compatible
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def mark_not_inplace(self, *a):  # API parity no-ops
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, v):
+        pass
+
+
+class PyLayer:
+    """Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    staticmethods; call via ``MyLayer.apply(*args)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        # run forward without tape recording; user ops inside are opaque
+        with engine.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if multi else [outputs]
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        # differentiable inputs, in forward-arg order
+        diff_inputs = [
+            a for a in list(args) + list(kwargs.values())
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+
+        if engine.is_grad_enabled() and diff_inputs:
+            import jax
+
+            out_avals = [
+                jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+                for o in out_tensors
+            ]
+
+            def vjp_fn(cotangents):
+                cots = (
+                    list(cotangents)
+                    if isinstance(cotangents, (tuple, list))
+                    else [cotangents]
+                )
+                grad_tensors = [Tensor._from_data(c) for c in cots]
+                with engine.no_grad():
+                    in_grads = cls.backward(ctx, *grad_tensors)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                return tuple(
+                    g._data if isinstance(g, Tensor) else g for g in in_grads
+                )
+
+            node = engine.GradNode(cls.__name__, vjp_fn, diff_inputs, out_avals)
+            for i, o in enumerate(out_tensors):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._output_index = i
+        return outputs
+
+    # paddle naming parity
+    once_differentiable = staticmethod(lambda f: f)
+
+
+def once_differentiable(f):
+    return f

@@ -1,0 +1,2 @@
+from paddle_tpu.core import dtype, flags, generator, place  # noqa: F401
+from paddle_tpu.core.tensor import Tensor, is_tensor, to_tensor  # noqa: F401

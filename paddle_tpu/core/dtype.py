@@ -1,0 +1,162 @@
+"""Data types for paddle_tpu.
+
+TPU-native analog of the reference's dtype layer
+(``paddle/phi/common/data_type.h``, ``float16.h``, ``bfloat16.h``,
+``type_promotion.h``): a small enum-like DType wrapper over JAX/XLA dtypes.
+bfloat16 is a first-class citizen (it is THE TPU compute dtype); float64 is
+supported only when explicitly enabled since TPUs emulate it slowly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DType", "dtype",
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128",
+    "convert_dtype", "get_default_dtype", "set_default_dtype",
+    "is_floating_point", "is_integer", "is_complex", "promote_types",
+]
+
+
+class DType:
+    """A framework dtype: thin, hashable wrapper over a numpy/JAX dtype.
+
+    Mirrors ``phi::DataType`` (reference: paddle/phi/common/data_type.h) but
+    delegates all semantics to XLA's type system.
+    """
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if name != "bfloat16" else jnp.bfloat16
+        DType._registry[name] = self
+
+    # -- identity ---------------------------------------------------------
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return convert_dtype(other) is self
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    # -- queries ----------------------------------------------------------
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def itemsize(self) -> int:
+        return 2 if self.name == "bfloat16" else self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", None)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+# alias matching paddle's `paddle.dtype`
+dtype = DType
+
+_STR_ALIASES = {
+    "bool": bool_, "bool_": bool_,
+    "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64,
+    "float16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "float": float32,
+    "float64": float64, "double": float64,
+    "complex64": complex64, "complex128": complex128,
+}
+
+
+def convert_dtype(d) -> DType:
+    """Normalize str / numpy dtype / jnp dtype / DType into a DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _STR_ALIASES:
+            return _STR_ALIASES[d]
+        raise ValueError(f"unknown dtype string: {d!r}")
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return float32
+    # numpy/jax dtype objects
+    nd = jnp.dtype(d)
+    name = nd.name
+    if name in _STR_ALIASES:
+        return _STR_ALIASES[name]
+    raise ValueError(f"unsupported dtype: {d!r}")
+
+
+def to_jax(d) -> "jnp.dtype":
+    """DType -> jnp dtype object usable in jnp calls."""
+    d = convert_dtype(d)
+    if d is bfloat16:
+        return jnp.bfloat16
+    return d.np_dtype
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating:
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+def is_floating_point(d) -> bool:
+    return convert_dtype(d).is_floating
+
+
+def is_integer(d) -> bool:
+    return convert_dtype(d).is_integer
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d).is_complex
+
+
+def promote_types(a, b) -> DType:
+    """Binary type promotion; delegates to XLA/jnp promotion rules, which
+    match the reference's promotion table (paddle/phi/common/type_promotion.h)
+    for the common cases."""
+    return convert_dtype(jnp.promote_types(to_jax(a), to_jax(b)))

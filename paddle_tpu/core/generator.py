@@ -1,0 +1,147 @@
+"""Random number generation.
+
+TPU-native analog of ``phi::Generator`` (reference: paddle/phi/core/generator.h:32)
+and the TP-aware ``RNGStatesTracker`` (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py:34).
+
+Design: counter-based threefry keys (the JAX/XLA-native RNG). A Generator holds
+a root key and a monotonically increasing counter; every draw is
+``fold_in(root, counter++)`` so the state is tiny, checkpointable, and — unlike
+a Philox offset — trivially replayable for recompute (activation checkpointing
+re-draws the same keys by restoring the counter).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = [
+    "Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+    "RNGStatesTracker", "get_rng_tracker", "rng_state",
+]
+
+
+class Generator:
+    """Stateful RNG facade over JAX's functional threefry keys."""
+
+    def __init__(self, seed_: int = 0):
+        self._seed = int(seed_)
+        self._root = jax.random.key(self._seed)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed_: int) -> "Generator":
+        with self._lock:
+            self._seed = int(seed_)
+            self._root = jax.random.key(self._seed)
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Draw the next PRNG key (threadsafe, replayable via state)."""
+        with self._lock:
+            c = self._counter
+            self._counter += 1
+        return jax.random.fold_in(self._root, c)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        with self._lock:
+            self._seed, self._counter = int(state[0]), int(state[1])
+            self._root = jax.random.key(self._seed)
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """Global manual seed (parity with ``paddle.seed``)."""
+    default_generator.manual_seed(s)
+    get_rng_tracker().reset(s)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor-parallel correctness.
+
+    Dropout inside TP regions must differ across tp ranks; outside, it must
+    match. The reference solves this with named generator states
+    (mpu/random.py:34). Here each named stream is its own Generator; meshes
+    register a stream per (name, tp_rank) by offsetting the seed.
+    """
+
+    def __init__(self):
+        self._streams: dict[str, Generator] = {}
+        self._base_seed = 0
+
+    def reset(self, base_seed: int = 0):
+        self._streams.clear()
+        self._base_seed = base_seed
+
+    def add(self, name: str, seed_: int):
+        if name in self._streams:
+            raise ValueError(f"rng stream {name!r} already exists")
+        self._streams[name] = Generator(seed_)
+
+    def get(self, name: str) -> Generator:
+        if name not in self._streams:
+            # deterministic per-name default stream
+            self._streams[name] = Generator(self._base_seed + _stable_hash(name))
+        return self._streams[name]
+
+    def states(self):
+        return {k: g.get_state() for k, g in self._streams.items()}
+
+    def set_states(self, states):
+        for k, st in states.items():
+            self.get(k).set_state(st)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global"):
+        """Context that redirects default draws to the named stream."""
+        global _active_generator
+        prev = _active_generator
+        _active_generator = self.get(name)
+        try:
+            yield
+        finally:
+            _active_generator = prev
+
+
+def _stable_hash(name: str) -> int:
+    h = 0
+    for ch in name:
+        h = (h * 131 + ord(ch)) % (2 ** 31)
+    return h
+
+
+_tracker = RNGStatesTracker()
+_active_generator = default_generator
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def rng_state(name: str = "global"):
+    return _tracker.rng_state(name)
+
+
+def active_key():
+    """The key for the currently active stream (respects rng_state ctx)."""
+    return _active_generator.next_key()

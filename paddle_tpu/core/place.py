@@ -1,0 +1,118 @@
+"""Device placement.
+
+TPU-native analog of ``phi::Place`` (reference: paddle/phi/common/place.h) and
+``paddle.device.set_device`` (reference: python/paddle/device/__init__.py:189).
+A Place names a logical device; the actual runtime object is a ``jax.Device``.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "Place", "TPUPlace", "CPUPlace", "CustomPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_tpu",
+]
+
+
+class Place:
+    """A logical device: ``(device_type, device_id)``."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def is_tpu_place(self) -> bool:
+        return self.device_type == "tpu"
+
+    def is_cpu_place(self) -> bool:
+        return self.device_type == "cpu"
+
+    # -- runtime ----------------------------------------------------------
+    def jax_device(self) -> "jax.Device":
+        """Resolve to the concrete jax.Device."""
+        platform = {"tpu": None, "cpu": "cpu"}.get(self.device_type, self.device_type)
+        if self.device_type == "tpu":
+            # default platform ordering puts accelerators first
+            devs = jax.devices()
+        else:
+            devs = jax.devices(platform)
+        if self.device_id >= len(devs):
+            raise RuntimeError(
+                f"device {self.device_type}:{self.device_id} not available "
+                f"({len(devs)} {self.device_type} device(s) present)"
+            )
+        return devs[self.device_id]
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def CustomPlace(device_type: str, device_id: int = 0) -> Place:
+    return Place(device_type, device_id)
+
+
+_current_place: Place | None = None
+
+
+def _default_place() -> Place:
+    """TPU if any accelerator is present, else CPU."""
+    global _current_place
+    if _current_place is None:
+        backend = jax.default_backend()
+        _current_place = CPUPlace() if backend == "cpu" else Place("tpu", 0)
+    return _current_place
+
+
+def set_device(device: str) -> Place:
+    """``set_device('tpu:0')`` / ``set_device('cpu')``.
+
+    Parity with paddle.device.set_device (reference:
+    python/paddle/device/__init__.py:189 `_convert_to_place`).
+    """
+    global _current_place
+    if ":" in device:
+        dev_type, _, idx = device.partition(":")
+        place = Place(dev_type, int(idx))
+    else:
+        place = Place(device, 0)
+    place.jax_device()  # validate
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    p = _default_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    return jax.default_backend() != "cpu"

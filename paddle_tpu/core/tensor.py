@@ -1,0 +1,323 @@
+"""The eager Tensor.
+
+TPU-native analog of the reference's public ``paddle::Tensor``
+(paddle/phi/api/include/tensor.h:82) + eager ``AutogradMeta``
+(paddle/fluid/eager/autograd_meta.h:61). The storage is a ``jax.Array``
+(an XLA/PJRT buffer — possibly sharded across a mesh, which is how DistTensor
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39) is unified with
+the dense tensor here: a Tensor whose jax.Array carries a NamedSharding IS a
+DistTensor).
+
+Op methods (``t.matmul``, ``t.sum``, ...) are bound onto this class by the op
+registry (paddle_tpu/ops/registry.py) at import time — the analog of the
+yaml-generated tensor methods in the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as _dtype_mod
+from paddle_tpu.core.dtype import convert_dtype, to_jax
+from paddle_tpu.core.place import Place, _default_place
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "grad", "_grad_node", "_output_index",
+        "_acc_node", "name", "persistable", "_placements", "_process_mesh",
+        "__weakref__", "__dict__",
+    )
+
+    _next_id = 0
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if data is not None:
+            if isinstance(data, Tensor):
+                data = data._data
+            elif not isinstance(data, jax.Array):
+                data = _np_to_jax(data, dtype)
+            if dtype is not None and data.dtype != to_jax(dtype):
+                data = data.astype(to_jax(dtype))
+            if place is not None and isinstance(place, Place):
+                data = jax.device_put(data, place.jax_device())
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._output_index = 0
+        self._acc_node = None
+        self.persistable = False
+        self._placements = None
+        self._process_mesh = None
+        if name is None:
+            name = f"tensor_{Tensor._next_id}"
+            Tensor._next_id += 1
+        self.name = name
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _from_data(cls, data, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._data = data
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._grad_node = None
+        t._output_index = 0
+        t._acc_node = None
+        t.persistable = False
+        t._placements = None
+        t._process_mesh = None
+        t.name = name or f"tensor_{Tensor._next_id}"
+        Tensor._next_id += 1
+        return t
+
+    # -- metadata ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    # paddle alias
+    @property
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return convert_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._data.devices()))
+            return Place(dev.platform if dev.platform != "cpu" else "cpu", dev.id)
+        except Exception:
+            return _default_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from paddle_tpu import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from paddle_tpu import ops
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.transpose(self, perm)
+
+    # -- conversion ----------------------------------------------------
+    def numpy(self):
+        d = self._data
+        if d.dtype == jnp.bfloat16:
+            return np.asarray(d.astype(jnp.float32)).astype(np.float32)
+        return np.asarray(d)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def astype(self, dt):
+        from paddle_tpu import ops
+        return ops.cast(self, dt)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        """to(dtype) / to(place) / to('tpu:0')."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, _dtype_mod.DType)) and _is_dtype_like(a):
+                out = out.astype(a)
+            elif isinstance(a, (str, Place)):
+                place = a if isinstance(a, Place) else _parse_place(a)
+                out = Tensor._from_data(
+                    jax.device_put(out._data, place.jax_device()),
+                    stop_gradient=out.stop_gradient,
+                )
+        return out
+
+    def cpu(self):
+        return self.to(Place("cpu", 0))
+
+    def detach(self):
+        t = Tensor._from_data(self._data, stop_gradient=True)
+        return t
+
+    def clone(self):
+        from paddle_tpu import ops
+        return ops.assign(self)
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd ------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from paddle_tpu.autograd import engine
+        engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """hook(grad Tensor) -> Tensor | None; fires when grad is computed."""
+        from paddle_tpu.autograd import engine
+
+        def _raw_hook(gdata):
+            out = hook(Tensor._from_data(gdata))
+            return out._data if out is not None else gdata
+
+        if self._grad_node is not None:
+            idx = self._output_index
+
+            def node_hook(cotangents):
+                cots = list(cotangents) if isinstance(cotangents, (tuple, list)) else [cotangents]
+                cots[idx] = _raw_hook(cots[idx])
+                return tuple(cots)
+
+            self._grad_node.register_hook(node_hook)
+        else:
+            if self._acc_node is None:
+                self._acc_node = engine.AccumulationNode(self)
+            self._acc_node.hooks.append(_raw_hook)
+        return hook
+
+    # -- in-place helpers ----------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        else:
+            value = _np_to_jax(value, None)
+        self._data = value.astype(self._data.dtype).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other, *_):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # -- dist metadata (semi-auto parallel) -----------------------------
+    @property
+    def process_mesh(self):
+        return self._process_mesh
+
+    @property
+    def placements(self):
+        return self._placements
+
+    def is_dist(self):
+        return self._process_mesh is not None
+
+    # -- python protocol -------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_str},\n"
+            f"       {np.array2string(self.numpy(), threshold=40, precision=6)})"
+        )
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        # jax.Array is immutable; sharing the buffer is a correct deep copy
+        new = Tensor._from_data(self._data, stop_gradient=self.stop_gradient)
+        new.__class__ = type(self)
+        new.persistable = self.persistable
+        memo[id(self)] = new
+        return new
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # indexing / arithmetic dunders are bound by ops.registry at import.
+
+
+def _is_dtype_like(a) -> bool:
+    if isinstance(a, _dtype_mod.DType):
+        return True
+    try:
+        convert_dtype(a)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def _parse_place(s: str) -> Place:
+    if ":" in s:
+        t, _, i = s.partition(":")
+        return Place(t, int(i))
+    return Place(s, 0)
+
+
+def _np_to_jax(data, dtype):
+    arr = np.asarray(data)
+    if dtype is not None:
+        return jnp.asarray(arr, dtype=to_jax(dtype))
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    elif arr.dtype == np.int64:
+        # stay int64? TPU prefers int32 but paddle semantics use int64 indices.
+        arr = arr.astype(np.int32)
+    return jnp.asarray(arr)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """Parity with ``paddle.to_tensor``."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

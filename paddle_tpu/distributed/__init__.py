@@ -1,0 +1,9 @@
+"""paddle_tpu.distributed — mesh-first distributed training.
+
+Reference: python/paddle/distributed/. The NCCL ProcessGroup stack is
+replaced by XLA collectives over a jax.sharding.Mesh (ICI within a slice,
+DCN across slices); see SURVEY.md §5 "Distributed communication backend".
+"""
+from paddle_tpu.distributed.env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)

@@ -1,0 +1,94 @@
+"""Process-level distributed environment.
+
+Reference: python/paddle/distributed/parallel.py (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env protocol). On TPU the runtime is single-controller
+per host: ``jax.process_index()`` is the host rank; device-level parallelism
+lives in the mesh (paddle_tpu/distributed/mesh.py), not in processes.
+Env vars keep launcher compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["get_rank", "get_world_size", "is_initialized",
+           "init_parallel_env", "ParallelEnv"]
+
+_initialized = False
+
+
+def get_rank() -> int:
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if r is not None:
+        return int(r)
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    n = os.environ.get("PADDLE_TRAINERS_NUM")
+    if n is not None:
+        return int(n)
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """Initialize multi-host (DCN) distributed runtime if configured.
+
+    Maps the reference's TCPStore rendezvous + ProcessGroup bootstrap
+    (parallel.py:943) onto jax.distributed.initialize, whose coordination
+    service plays the TCPStore role.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR")
+    if coord and get_world_size() > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=get_world_size(),
+            process_id=get_rank(),
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nrings(self):
+        return 1

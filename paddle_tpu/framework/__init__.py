@@ -1,0 +1,3 @@
+"""Framework-level utilities (reference: python/paddle/framework/)."""
+from paddle_tpu.framework.io_utils import load, save  # noqa: F401
+from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401

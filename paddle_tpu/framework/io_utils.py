@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load parity.
+
+Reference: python/paddle/framework/io.py:725 (save), :967 (load) — pickled
+state_dict of params + optimizer state. Tensors are stored as numpy arrays
+(bf16 stored as uint16 view with a dtype tag).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_BF16_TAG = "__bf16__"
+
+
+def _to_picklable(obj):
+    import jax.numpy as jnp
+
+    if isinstance(obj, Tensor):
+        d = obj._data
+        if d.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True,
+                    "data": np.asarray(d.view(jnp.uint16)
+                                       if hasattr(d, "view")
+                                       else np.asarray(d.astype(jnp.float32)))}
+        return np.asarray(d)
+    if isinstance(obj, dict):
+        return {k: _to_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_picklable(v) for v in obj)
+    return obj
+
+
+def _from_picklable(obj):
+    import jax.numpy as jnp
+
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            arr = obj["data"]
+            if arr.dtype == np.uint16:
+                return Tensor._from_data(jnp.asarray(arr).view(jnp.bfloat16))
+            return Tensor._from_data(jnp.asarray(arr, dtype=jnp.bfloat16))
+        return {k: _from_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_picklable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_picklable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_picklable(obj)

@@ -1,0 +1,125 @@
+"""paddle_tpu.jit — trace-to-static compilation (reference:
+python/paddle/jit/api.py:136 to_static; here: trace once, compile with XLA).
+"""
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.jit.trace import TracedFunction, functionalize, in_tracing  # noqa: F401
+from paddle_tpu.jit.train import TrainStep  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "TracedFunction", "TrainStep",
+           "functionalize", "save", "load", "InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Compile a Layer (or use as decorator) into an XLA executable wrapper."""
+    from paddle_tpu.nn.layer import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            return TracedFunction(obj, input_spec, build_strategy)
+        # plain function: jit it through a thin Layer adapter
+        return _FunctionAdapter(obj, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class _FunctionAdapter:
+    """to_static over a free function: jit directly over Tensor->data."""
+
+    def __init__(self, fn, input_spec=None):
+        import jax
+
+        self._fn = fn
+
+        def pure(*datas):
+            from paddle_tpu.autograd import engine
+            from paddle_tpu.core.tensor import Tensor
+            with engine.no_grad():
+                ins = [Tensor._from_data(d) for d in datas]
+                out = fn(*ins)
+            from paddle_tpu.core.tensor import Tensor as T
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, T) else o for o in out)
+            return out._data if isinstance(out, T) else out
+
+        self._jitted = jax.jit(pure)
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+        datas = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                 for i in inputs]
+        out = self._jitted(*datas)
+        if isinstance(out, tuple):
+            return tuple(Tensor._from_data(o) for o in out)
+        return Tensor._from_data(out)
+
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize a Layer for inference: weights + a serialized StableHLO
+    module (the role of the reference's save_inference_model +
+    AnalysisPredictor AOT path)."""
+    import pickle
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.jit.trace import functionalize as _func
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+    payload = {"state_dict": state, "class": type(layer).__name__}
+    if input_spec:
+        from paddle_tpu.core.dtype import to_jax
+
+        apply, (pnames, params), (bnames, buffers) = _func(layer)
+        import jax.numpy as jnp
+
+        example = [jnp.zeros([d if d and d > 0 else 1 for d in s.shape],
+                             to_jax(s.dtype)) for s in input_spec]
+        key = jax.random.key(0)
+
+        def fwd(*ins):
+            out, _ = apply([p._data for p in params],
+                           [b._data for b in buffers], key, *ins)
+            return out
+
+        lowered = jax.jit(fwd).lower(*example)
+        payload["stablehlo"] = lowered.as_text()
+        payload["input_spec"] = [(list(s.shape), str(s.dtype))
+                                 for s in input_spec]
+    with open(path + ".pdmodel" if not path.endswith(".pdmodel") else path,
+              "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load(path, **config):
+    import pickle
+
+    p = path + ".pdmodel" if not path.endswith(".pdmodel") else path
+    with open(p, "rb") as f:
+        payload = pickle.load(f)
+    return payload

@@ -1,0 +1,185 @@
+"""Trace-to-XLA: functionalize eager Layers.
+
+The reference's whole static stack — to_static bytecode/AST capture
+(python/paddle/jit/), PIR program (paddle/pir/), pd_op→kernel lowering,
+PirInterpreter scheduling, and the CINN fusion compiler (paddle/cinn/,
+234K LoC) — collapses here into ONE mechanism: run the eager Layer under
+jax tracing and let XLA fuse/schedule/compile the whole graph.
+
+It works because every registry op is a pure JAX emitter: during trace,
+parameters and buffers are temporarily swapped for tracer values
+(``_swap_state``), the Layer's Python executes once (the define-by-run
+analog of SOT bytecode capture), and the captured jaxpr is compiled by XLA.
+Mutable state (BatchNorm running stats) is threaded functionally: the
+functionalized apply returns (outputs, new_buffer_values).
+
+RNG under trace: a per-call key is threaded in and the global generator
+draws tracer keys from it (see core/generator.py), so dropout masks differ
+per step and per call site while remaining reproducible.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["functionalize", "in_tracing", "TracedFunction"]
+
+_trace_state = threading.local()
+
+
+def in_tracing() -> bool:
+    return getattr(_trace_state, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def _tracing_scope():
+    _trace_state.depth = getattr(_trace_state, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _trace_state.depth -= 1
+
+
+@contextlib.contextmanager
+def _swap_state(params: List[Tensor], values):
+    """Temporarily replace each tensor's buffer with a traced value."""
+    saved = [p._data for p in params]
+    for p, v in zip(params, values):
+        p._data = v
+    try:
+        yield
+    finally:
+        for p, d in zip(params, saved):
+            p._data = d
+
+
+class _TraceKeyStream:
+    """Stateful-at-trace-time key provider: splits a root tracer key once
+    per draw, so each call site gets a distinct, step-dependent key."""
+
+    def __init__(self, root):
+        self._key = root
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+def _collect_state(layer):
+    """(names, tensors) for params + persistable buffers, stable order."""
+    params, buffers = [], []
+    pnames, bnames = [], []
+    for name, p in layer.named_parameters():
+        pnames.append(name)
+        params.append(p)
+    for name, b in layer.named_buffers():
+        bnames.append(name)
+        buffers.append(b)
+    return pnames, params, bnames, buffers
+
+
+def functionalize(layer_or_fn, with_buffers=True):
+    """Return (apply_fn, params, buffers) where
+    ``apply_fn(param_datas, buffer_datas, rng_key, *input_datas)
+        -> (out_datas, new_buffer_datas)``
+    is pure and jittable. ``layer_or_fn`` may be a Layer or a function that
+    closes over Layers (all reachable Layers' state must be passed —
+    functions should be wrapped through Layer for full generality)."""
+    from paddle_tpu.nn.layer import Layer
+
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        fn = layer_or_fn.__call__
+    else:
+        layer = getattr(layer_or_fn, "__self__", None)
+        fn = layer_or_fn
+        if layer is None:
+            raise TypeError(
+                "functionalize expects a Layer or a bound Layer method")
+
+    pnames, params, bnames, buffers = _collect_state(layer)
+
+    def apply(param_datas, buffer_datas, rng_key, *input_datas,
+              training=None):
+        stream = _TraceKeyStream(rng_key)
+        prev_gen_next = gen.Generator.next_key
+        gen.Generator.next_key = lambda self: stream.next()
+        try:
+            with _tracing_scope(), engine.no_grad(), \
+                    _swap_state(params + buffers,
+                                list(param_datas) + list(buffer_datas)):
+                ins = [Tensor._from_data(d) if isinstance(d, jax.Array)
+                       or hasattr(d, "dtype") else d for d in input_datas]
+                out = fn(*ins)
+                new_buffers = [b._data for b in buffers]
+            if isinstance(out, (tuple, list)):
+                out_datas = tuple(o._data if isinstance(o, Tensor) else o
+                                  for o in out)
+            elif isinstance(out, Tensor):
+                out_datas = out._data
+            else:
+                out_datas = out
+            return out_datas, new_buffers
+        finally:
+            gen.Generator.next_key = prev_gen_next
+
+    return apply, (pnames, params), (bnames, buffers)
+
+
+class TracedFunction:
+    """Compiled forward wrapper returned by ``paddle_tpu.jit.to_static``.
+
+    Holds the XLA executable cache keyed by input shapes/dtypes (the role of
+    the reference's OpcodeExecutorCache + Program cache,
+    python/paddle/jit/sot/opcode_translator/executor/executor_cache.py:46).
+    """
+
+    def __init__(self, layer, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._layer = layer
+        self._input_spec = input_spec
+        self._apply, (self._pnames, self._params), \
+            (self._bnames, self._buffers) = functionalize(layer)
+        self._jitted = jax.jit(self._apply_for_jit,
+                               static_argnames=("training",))
+        self._fallback = False
+
+    def _apply_for_jit(self, param_datas, buffer_datas, rng_key,
+                       *input_datas, training=True):
+        return self._apply(param_datas, buffer_datas, rng_key, *input_datas)
+
+    def __call__(self, *inputs):
+        in_datas = tuple(
+            i._data if isinstance(i, Tensor) else jnp.asarray(i)
+            for i in inputs)
+        param_datas = [p._data for p in self._params]
+        buffer_datas = [b._data for b in self._buffers]
+        key = gen.default_generator.next_key()
+        out, new_buffers = self._jitted(param_datas, buffer_datas, key,
+                                        *in_datas,
+                                        training=self._layer.training)
+        # thread mutated buffers (e.g. BN running stats) back to the layer
+        for b, nb in zip(self._buffers, new_buffers):
+            b._data = nb
+        if isinstance(out, tuple):
+            return tuple(Tensor._from_data(o) for o in out)
+        return Tensor._from_data(out)
+
+    # paddle API parity
+    @property
+    def forward(self):
+        return self
+
+    def parameters(self):
+        return self._layer.parameters()
+
+    def state_dict(self):
+        return self._layer.state_dict()

@@ -1,0 +1,131 @@
+"""TrainStep: whole-step compilation.
+
+The reference reaches peak throughput via static graph + CINN fusion
+(SURVEY.md §3.3); the TPU-native equivalent is compiling the entire
+(forward + backward + optimizer) step into one XLA executable. TrainStep
+reuses: the Layer's functionalized apply (jit/trace.py), the optimizer's
+pure ``_rule`` (optimizer/optimizer.py), and ClipGradByGlobalNorm's pure
+``clip_fn`` — so eager and compiled training are numerically identical.
+
+Buffer donation on params + optimizer slots gives in-place updates in HBM
+(the role of the reference's buffer reuse / inplace pass).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trace import functionalize
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["TrainStep"]
+
+
+class TrainStep:
+    def __init__(self, model, loss_fn: Callable, optimizer,
+                 accumulate_steps: int = 1, sharding=None):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._apply, (self._pnames, self._params), \
+            (self._bnames, self._buffers) = functionalize(model)
+        if optimizer._parameter_list is None:
+            optimizer._parameter_list = list(self._params)
+        # init optimizer slots eagerly so they are part of the carried state
+        self._slots = []
+        for p in self._params:
+            s = optimizer._slots.get(id(p))
+            if s is None:
+                s = optimizer._init_slots(p._data)
+                optimizer._slots[id(p)] = s
+            self._slots.append(s)
+        self._trainable = [not p.stop_gradient for p in self._params]
+        self._sharding = sharding
+
+        def step_fn(param_datas, slot_list, buffer_datas, step, lr, key,
+                    *batch):
+            def loss_of(trainable_params):
+                full = _merge(param_datas, trainable_params, self._trainable)
+                out, new_buf = self._apply(full, buffer_datas, key,
+                                           *batch[: self._n_inputs])
+                outs = out if isinstance(out, tuple) else (out,)
+                ins = [Tensor._from_data(o) for o in outs]
+                loss = self._compute_loss(ins, batch)
+                return loss._data if isinstance(loss, Tensor) else loss, \
+                    new_buf
+
+            trainable_params = [p for p, t in zip(param_datas,
+                                                  self._trainable) if t]
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(trainable_params)
+
+            clip = optimizer._grad_clip
+            clip_fn = getattr(clip, "clip_fn", None)
+            if clip_fn is not None:
+                grads = clip_fn(list(grads))
+
+            new_params = list(param_datas)
+            new_slots = list(slot_list)
+            gi = 0
+            for i, t in enumerate(self._trainable):
+                if not t:
+                    continue
+                g = grads[gi]
+                gi += 1
+                # per-param decay exclusion is trace-time static
+                optimizer._current_decay_enabled = optimizer._decay_enabled(
+                    self._params[i])
+                np_, ns = optimizer._rule(param_datas[i], g, slot_list[i],
+                                          lr, step)
+                optimizer._current_decay_enabled = True
+                new_params[i] = np_
+                new_slots[i] = ns
+            return loss, new_params, new_slots, new_buffers
+
+        self._n_inputs = 1
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _compute_loss(self, model_outs, batch):
+        """loss_fn(outputs..., labels...) — by convention the model consumes
+        the leading batch elements and loss_fn the trailing ones; we pass
+        (model_out, *remaining) where remaining = batch[n_model_inputs:]."""
+        labels = [Tensor._from_data(b) for b in batch[self._n_inputs:]]
+        outs = list(model_outs)
+        return self._loss_fn(*(outs + labels))
+
+    def __call__(self, *batch, n_model_inputs: Optional[int] = None):
+        """batch = (model_inputs..., labels...). By default the model takes
+        one input and the rest are labels."""
+        self._n_inputs = 1 if n_model_inputs is None else n_model_inputs
+        datas = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch)
+        model_datas = datas[: self._n_inputs]
+        self._opt._step_count += 1
+        lr = jnp.asarray(self._opt.get_lr(), dtype=jnp.float32)
+        step = jnp.asarray(float(self._opt._step_count), dtype=jnp.float32)
+        key = gen.default_generator.next_key()
+        param_datas = [p._data for p in self._params]
+        buffer_datas = [b._data for b in self._buffers]
+        loss, new_params, new_slots, new_buffers = self._jitted(
+            param_datas, self._slots, buffer_datas, step, lr, key, *datas)
+        for p, np_ in zip(self._params, new_params):
+            p._data = np_
+        for b, nb in zip(self._buffers, new_buffers):
+            b._data = nb
+        self._slots = new_slots
+        for p, s in zip(self._params, new_slots):
+            self._opt._slots[id(p)] = s
+        return Tensor._from_data(loss)
+
+
+def _merge(full, trainable_vals, mask):
+    out = list(full)
+    it = iter(trainable_vals)
+    for i, t in enumerate(mask):
+        if t:
+            out[i] = next(it)
+    return out

@@ -1,0 +1,175 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        pred_np = np.asarray(pred.numpy() if isinstance(pred, Tensor)
+                             else pred)
+        label_np = np.asarray(label.numpy() if isinstance(label, Tensor)
+                              else label)
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        maxk = max(self.topk)
+        topk_idx = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        correct = topk_idx == label_np[..., None]
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct.numpy() if isinstance(correct, Tensor)
+                             else correct)
+        n = correct.shape[0]
+        for i, k in enumerate(self.topk):
+            c = correct[..., :k].any(axis=-1).sum()
+            self.total[i] += float(c)
+            self.count[i] += n
+        accs = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        accs = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return accs[0] if len(accs) == 1 else accs
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        pred_cls = (preds > 0.5).astype(np.int64).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (labels == 1)).sum())
+        self.fp += int(((pred_cls == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        pred_cls = (preds > 0.5).astype(np.int64).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (labels == 1)).sum())
+        self.fn += int(((pred_cls == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor)
+                           else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        if preds.ndim == 2:
+            preds = preds[:, 1]
+        labels = labels.reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds descending
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from paddle_tpu import ops
+
+    topk_vals, topk_idx = ops.topk(input, k)
+    lbl = label
+    if lbl.ndim < topk_idx.ndim:
+        lbl = ops.unsqueeze(lbl, -1)
+    correct_t = ops.any(ops.equal(topk_idx, lbl), axis=-1)
+    return ops.mean(ops.cast(correct_t, "float32"))

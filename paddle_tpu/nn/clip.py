@@ -1,0 +1,86 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def clip_fn(self, grads):
+        """Pure: list[jax array] -> list[jax array] (reused by jit steps)."""
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+    def __call__(self, params_grads):
+        return _apply_pairwise(self.clip_fn, params_grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def clip_fn(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+    def __call__(self, params_grads):
+        return _apply_pairwise(self.clip_fn, params_grads)
+
+
+def _apply_pairwise(clip_fn, params_grads):
+    gs = [g._data if isinstance(g, Tensor) else g
+          for _, g in params_grads if g is not None]
+    if not gs:
+        return params_grads
+    clipped = clip_fn(gs)
+    out = []
+    i = 0
+    for p, g in params_grads:
+        if g is None:
+            out.append((p, g))
+        else:
+            out.append((p, Tensor._from_data(clipped[i])))
+            i += 1
+    return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference semantics: scale = clip_norm / max(global_norm, clip_norm).
+    The functional core (``clip_fn``) is reused inside jitted train steps and
+    the distributed hybrid optimizer (TP/PP-aware clipping sums the norm
+    across model-parallel groups there)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    @staticmethod
+    def global_norm(grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        return jnp.sqrt(sq)
+
+    def clip_fn(self, grads):
+        """Pure: list[jax array] -> list[jax array]."""
+        gn = self.global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]
+
+    def __call__(self, params_grads):
+        return _apply_pairwise(self.clip_fn, params_grads)

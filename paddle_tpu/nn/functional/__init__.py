@@ -1,0 +1,78 @@
+"""paddle_tpu.nn.functional — re-export of the op surface under the
+functional namespace (reference: python/paddle/nn/functional/)."""
+from paddle_tpu.ops.registry import API as _API
+
+_F_OPS = [
+    # activations
+    "relu", "relu6", "gelu", "sigmoid", "silu", "swish", "mish", "softplus",
+    "softsign", "hardswish", "hardsigmoid", "hardtanh", "leaky_relu", "elu",
+    "selu", "celu", "prelu", "glu", "tanhshrink", "hardshrink", "softshrink",
+    "thresholded_relu", "softmax", "log_softmax", "gumbel_softmax", "tanh",
+    # linear/conv/pool
+    "linear", "embedding", "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "max_pool1d", "max_pool2d", "avg_pool1d", "avg_pool2d",
+    "adaptive_avg_pool2d", "adaptive_max_pool2d", "unfold", "pixel_shuffle",
+    "interpolate", "pad",
+    # norms
+    "batch_norm", "layer_norm", "rms_norm", "group_norm", "instance_norm",
+    "local_response_norm", "normalize",
+    # dropout
+    "dropout",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "kl_div", "hinge_loss",
+    "margin_ranking_loss", "cosine_similarity", "cosine_embedding_loss",
+    "sigmoid_focal_loss",
+    # attention
+    "scaled_dot_product_attention",
+    # misc
+    "one_hot",
+]
+
+globals().update({k: _API[k] for k in _F_OPS})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return _API["interpolate"](x, size=size, scale_factor=scale_factor,
+                               mode=mode, align_corners=align_corners)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """Parity with paddle.nn.functional.flash_attention (reference:
+    python/paddle/nn/functional/flash_attention.py). Dispatches to the
+    Pallas flash kernel on TPU when available, else the XLA fused softmax
+    path. Layout: [batch, seqlen, nheads, head_dim]."""
+    from paddle_tpu.ops import pallas_attention
+
+    out = pallas_attention.flash_attention(query, key, value, causal=causal,
+                                           dropout=dropout, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+    from paddle_tpu.core.dtype import to_jax
+    from paddle_tpu.core.tensor import Tensor
+
+    ldata = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(
+        lengths)
+    m = int(maxlen) if maxlen is not None else int(ldata.max())
+    mask = jnp.arange(m)[None, :] < ldata[..., None]
+    return Tensor._from_data(mask.astype(to_jax(dtype)))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return label * (1 - epsilon) + epsilon * prior_dist
+    return label * (1 - epsilon) + epsilon / n
+
+
+__all__ = _F_OPS + ["upsample", "flash_attention", "sequence_mask",
+                    "label_smooth"]

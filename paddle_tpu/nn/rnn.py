@@ -1,0 +1,349 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-idiomatic: the time loop is a single ``lax.scan`` per layer (one compiled
+loop body, not a Python unroll), which is how XLA wants recurrence expressed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import register_emitter as op_emitter
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN"]
+
+
+# ---- scan-based sequence kernels (registered as ops so autograd works) ----
+@op_emitter
+def lstm_seq(x, w_ih, w_hh, b_ih, b_hh, h0, c0):
+    """x: [T, B, I] (time-major inside); returns (out [T,B,H], h_n, c_n)."""
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hn, cn), out = lax.scan(step, (h0, c0), x)
+    return out, hn, cn
+
+
+@op_emitter
+def gru_seq(x, w_ih, w_hh, b_ih, b_hh, h0):
+    def step(h, xt):
+        gi = xt @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+
+    hn, out = lax.scan(step, h0, x)
+    return out, hn
+
+
+@op_emitter
+def rnn_seq(x, w_ih, w_hh, b_ih, b_hh, h0, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        h2 = act(xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+        return h2, h2
+
+    hn, out = lax.scan(step, h0, x)
+    return out, hn
+
+
+from paddle_tpu.ops import registry as _registry  # noqa: E402
+
+for _name, _targs in [("lstm_seq", ["x", "w_ih", "w_hh", "b_ih", "b_hh",
+                                    "h0", "c0"]),
+                      ("gru_seq", ["x", "w_ih", "w_hh", "b_ih", "b_hh",
+                                   "h0"]),
+                      ("rnn_seq", ["x", "w_ih", "w_hh", "b_ih", "b_hh",
+                                   "h0"])]:
+    _registry.build_registry([{"op": _name, "tensor_args": _targs,
+                               "methods": []}])
+
+
+def _seq_op(name):
+    return _registry.API[name]
+
+
+class _RNNBase(Layer):
+    MODE = "RNN"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        k = 1.0 / (hidden_size ** 0.5)
+        u = init.Uniform(-k, k)
+        g = self.GATES
+        for layer in range(num_layers):
+            for d in range(ndir):
+                isz = input_size if layer == 0 else hidden_size * ndir
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    f"weight_ih_l{sfx}",
+                    self.create_parameter([g * hidden_size, isz],
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"weight_hh_l{sfx}",
+                    self.create_parameter([g * hidden_size, hidden_size],
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"bias_ih_l{sfx}",
+                    self.create_parameter([g * hidden_size],
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"bias_hh_l{sfx}",
+                    self.create_parameter([g * hidden_size],
+                                          default_initializer=u))
+
+    def _params(self, layer, reverse):
+        sfx = f"{layer}" + ("_reverse" if reverse else "")
+        return (self._parameters[f"weight_ih_l{sfx}"],
+                self._parameters[f"weight_hh_l{sfx}"],
+                self._parameters[f"bias_ih_l{sfx}"],
+                self._parameters[f"bias_hh_l{sfx}"])
+
+    def forward(self, inputs, initial_states=None):
+        from paddle_tpu import ops
+
+        x = inputs
+        if not self.time_major:
+            x = ops.transpose(x, [1, 0, 2])  # -> [T, B, I]
+        T, B = x.shape[0], x.shape[1]
+        H = self.hidden_size
+        ndir = self.num_directions
+        L = self.num_layers
+
+        states = self._init_states(initial_states, B)
+        final_states = []
+        out = x
+        for layer in range(L):
+            outs_dir = []
+            for d in range(ndir):
+                seq = ops.flip(out, [0]) if d else out
+                res = self._run_dir(seq, layer, d, states)
+                y = res[0]
+                final_states.append(res[1:])
+                if d:
+                    y = ops.flip(y, [0])
+                outs_dir.append(y)
+            out = (ops.concat(outs_dir, axis=-1) if ndir == 2
+                   else outs_dir[0])
+            if self.dropout > 0 and layer < L - 1:
+                out = ops.dropout(out, self.dropout, training=self.training)
+        if not self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, self._pack_final(final_states)
+
+    def _init_states(self, initial_states, batch):
+        raise NotImplementedError
+
+    def _run_dir(self, seq, layer, d, states):
+        raise NotImplementedError
+
+    def _pack_final(self, finals):
+        raise NotImplementedError
+
+
+class SimpleRNN(_RNNBase):
+    GATES = 1
+
+    def _init_states(self, initial_states, batch):
+        from paddle_tpu import ops
+        if initial_states is None:
+            z = ops.zeros([self.num_layers * self.num_directions, batch,
+                           self.hidden_size])
+            return z
+        return initial_states
+
+    def _run_dir(self, seq, layer, d, states):
+        idx = layer * self.num_directions + d
+        h0 = states[idx]
+        w_ih, w_hh, b_ih, b_hh = self._params(layer, d)
+        return _seq_op("rnn_seq")(seq, w_ih, w_hh, b_ih, b_hh, h0,
+                                  activation=self.activation)
+
+    def _pack_final(self, finals):
+        from paddle_tpu import ops
+        return ops.stack([f[0] for f in finals], axis=0)
+
+
+class GRU(_RNNBase):
+    GATES = 3
+
+    _init_states = SimpleRNN._init_states
+
+    def _run_dir(self, seq, layer, d, states):
+        idx = layer * self.num_directions + d
+        h0 = states[idx]
+        w_ih, w_hh, b_ih, b_hh = self._params(layer, d)
+        return _seq_op("gru_seq")(seq, w_ih, w_hh, b_ih, b_hh, h0)
+
+    _pack_final = SimpleRNN._pack_final
+
+
+class LSTM(_RNNBase):
+    GATES = 4
+
+    def _init_states(self, initial_states, batch):
+        from paddle_tpu import ops
+        if initial_states is None:
+            shape = [self.num_layers * self.num_directions, batch,
+                     self.hidden_size]
+            return (ops.zeros(shape), ops.zeros(shape))
+        return initial_states
+
+    def _run_dir(self, seq, layer, d, states):
+        idx = layer * self.num_directions + d
+        h0, c0 = states[0][idx], states[1][idx]
+        w_ih, w_hh, b_ih, b_hh = self._params(layer, d)
+        return _seq_op("lstm_seq")(seq, w_ih, w_hh, b_ih, b_hh, h0, c0)
+
+    def _pack_final(self, finals):
+        from paddle_tpu import ops
+        h = ops.stack([f[0] for f in finals], axis=0)
+        c = ops.stack([f[1] for f in finals], axis=0)
+        return (h, c)
+
+
+# ---- cells ---------------------------------------------------------------
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__()
+        k = 1.0 / (hidden_size ** 0.5)
+        u = init.Uniform(-k, k)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size],
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu import ops
+        if states is None:
+            states = ops.zeros([inputs.shape[0], self.hidden_size])
+        pre = (ops.matmul(inputs, self.weight_ih.T) +
+               ops.matmul(states, self.weight_hh.T) +
+               self.bias_ih + self.bias_hh)
+        h = ops.tanh(pre) if self.activation == "tanh" else ops.relu(pre)
+        return h, h
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        k = 1.0 / (hidden_size ** 0.5)
+        u = init.Uniform(-k, k)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size],
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu import ops
+        if states is None:
+            z = ops.zeros([inputs.shape[0], self.hidden_size])
+            states = (z, z)
+        h, c = states
+        gates = (ops.matmul(inputs, self.weight_ih.T) +
+                 ops.matmul(h, self.weight_hh.T) +
+                 self.bias_ih + self.bias_hh)
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        i, f, o = ops.sigmoid(i), ops.sigmoid(f), ops.sigmoid(o)
+        g = ops.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * ops.tanh(c2)
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        k = 1.0 / (hidden_size ** 0.5)
+        u = init.Uniform(-k, k)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu import ops
+        if states is None:
+            states = ops.zeros([inputs.shape[0], self.hidden_size])
+        gi = ops.matmul(inputs, self.weight_ih.T) + self.bias_ih
+        gh = ops.matmul(states, self.weight_hh.T) + self.bias_hh
+        i_r, i_z, i_n = ops.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = ops.split(gh, 3, axis=-1)
+        r = ops.sigmoid(i_r + h_r)
+        z = ops.sigmoid(i_z + h_z)
+        n = ops.tanh(i_n + r * h_n)
+        h2 = (1.0 - z) * n + z * states
+        return h2, h2
+
+
+class RNN(Layer):
+    """Wrap a cell into a sequence runner (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        from paddle_tpu import ops
+        x = inputs if self.time_major else ops.transpose(inputs, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        state = initial_states
+        outs = [None] * T
+        for ti in steps:
+            y, state = self.cell(x[ti], state)
+            outs[ti] = y
+        out = ops.stack(outs, axis=0)
+        if not self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, state

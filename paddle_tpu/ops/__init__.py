@@ -1,0 +1,74 @@
+"""Operator surface: load emitters, build the registry from ops.yaml, and
+export the functional API as module attributes (``paddle_tpu.ops.matmul``...).
+"""
+from __future__ import annotations
+
+import os
+
+# emitter modules must be imported before building the registry
+from paddle_tpu.ops import (  # noqa: F401
+    creation, linalg, logic, manipulation, math, nn_ops, random_ops,
+)
+from paddle_tpu.ops import registry as _registry
+from paddle_tpu.ops.registry import OPS, get_op
+
+
+def _load_yaml(path):
+    try:
+        import yaml as _yaml
+
+        with open(path) as f:
+            return _yaml.safe_load(f)
+    except ImportError:
+        return _parse_flow_yaml(path)
+
+
+def _parse_flow_yaml(path):
+    """Minimal parser for this file's restricted flow-style yaml (each entry
+    is one ``- {k: v, ...}`` line) so we don't depend on pyyaml."""
+    import ast
+    import re
+
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("- {"):
+                continue
+            body = line[3:-1]
+            ent = {}
+            # split on commas not inside brackets
+            parts = re.split(r",\s*(?![^\[]*\])", body)
+            for p in parts:
+                k, _, v = p.partition(":")
+                k = k.strip()
+                v = v.strip()
+                if v.startswith("["):
+                    items = [s.strip().strip('"\'')
+                             for s in v[1:-1].split(",") if s.strip()]
+                    ent[k] = items
+                elif v in ("true", "false"):
+                    ent[k] = v == "true"
+                else:
+                    ent[k] = v.strip('"\'')
+            entries.append(ent)
+    return entries
+
+
+_yaml_path = os.path.join(os.path.dirname(__file__), "ops.yaml")
+_API = _registry.build_registry(_load_yaml(_yaml_path))
+
+globals().update(_API)
+
+# in-place __setitem__ on Tensor: record as an op then rebind the buffer
+from paddle_tpu.core.tensor import Tensor as _Tensor  # noqa: E402
+
+
+def _tensor_setitem(self, index, value):
+    out = _API["setitem"](self, value, index=index)
+    return _registry.rebind_inplace(self, out)
+
+
+_Tensor.__setitem__ = _tensor_setitem
+
+__all__ = sorted(_API.keys())

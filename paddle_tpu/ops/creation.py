@@ -1,0 +1,135 @@
+"""Tensor creation emitters (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtype import get_default_dtype, to_jax
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return to_jax(default) if default is not None else to_jax(get_default_dtype())
+    return to_jax(dtype)
+
+
+@op
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@op
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+@op
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _dt(dtype))
+
+
+@op
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@op
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (int(start), int(end), int(step)) if all(
+            float(v).is_integer() for v in (start, end, step)
+        ) else None
+        dt = jnp.int32 if py is not None else to_jax(get_default_dtype())
+    else:
+        dt = to_jax(dtype)
+    return jnp.arange(start, end, step, dtype=dt)
+
+
+@op
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+@op
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+@op
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+@op
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@op
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@op
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@op
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@op
+def assign(x):
+    return jnp.asarray(x)
+
+
+@op
+def meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+@op
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+@op
+def triu_indices(row, col, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+@op
+def complex(real, imag):
+    return jnp.asarray(real) + 1j * jnp.asarray(imag)
+
+
+@op
+def polar(abs, angle):
+    return abs * jnp.exp(1j * angle)

@@ -1,0 +1,196 @@
+"""Linear algebra emitters (reference: python/paddle/tensor/linalg.py).
+
+matmul goes straight to jnp.matmul → XLA dot_general → MXU. bfloat16 inputs
+stay bf16 on the MXU with f32 accumulation (XLA default), matching TPU best
+practice.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+@op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@op
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@op
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@op
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@op
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+@op
+def dist(x, y, p=2):
+    d = x - y
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@op
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else int(axis))
+
+
+@op
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@op
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@op
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@op
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@op
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@op
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+@op
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@op
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+@op
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+@op
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular,
+    )
+
+
+@op
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op
+def lu(x):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32)
+
+
+@op
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@op
+def householder_product(x, tau):
+    # A = H_1 H_2 ... H_k where H_i = I - tau_i v_i v_i^T
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    q = eye
+    for i in range(n):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
+        v = v.at[i].set(1.0)
+        q = q @ (eye - tau[i] * jnp.outer(v, v))
+    return q[..., :, :n]
+
+
+@op
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

@@ -1,0 +1,96 @@
+"""Comparison / logical emitters (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+@op
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@op
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@op
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@op
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@op
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@op
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@op
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@op
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@op
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@op
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@op
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@op
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@op
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@op
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@op
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@op
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op
+def equal_all(x, y):
+    return jnp.array_equal(x, y)

@@ -1,0 +1,445 @@
+"""Shape / layout manipulation emitters.
+
+Reference: python/paddle/tensor/manipulation.py and the stride/view kernels
+(paddle/phi/kernels/stride/). XLA has no strided views — reshape/slice/
+transpose emit HLO that the compiler folds into layout changes or fusions, so
+"view semantics" are recovered at compile time instead of via a stride layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtype import to_jax
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+@op
+def cast(x, dtype):
+    return jnp.asarray(x).astype(to_jax(dtype))
+
+
+@op
+def reshape(x, shape):
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+@op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    sa = start_axis % nd
+    ea = stop_axis % nd
+    new_shape = list(x.shape[:sa]) + [-1] + list(x.shape[ea + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a % max(x.ndim, 1) for a in axis)
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    axis = axis % max(x.ndim, 1)
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@op
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in axis:
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, axis)
+
+
+@op
+def transpose(x, perm):
+    return jnp.transpose(x, axes=[int(p) for p in perm])
+
+
+@op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@op
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+@op
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+@op
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list; -1 means infer
+    sections = list(num_or_sections)
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@op
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+@op
+def unbind(x, axis=0):
+    axis = int(axis)
+    return tuple(
+        jnp.squeeze(s, axis=axis)
+        for s in jnp.split(x, x.shape[axis], axis=axis)
+    )
+
+
+@op
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@op
+def expand(x, shape):
+    shape = list(shape)
+    # paddle semantics: -1 keeps original dim
+    nd_in = x.ndim
+    nd_out = len(shape)
+    xshape = [1] * (nd_out - nd_in) + list(x.shape)
+    out_shape = [
+        xshape[i] if shape[i] == -1 else int(shape[i]) for i in range(nd_out)
+    ]
+    return jnp.broadcast_to(x.reshape(xshape), out_shape)
+
+
+@op
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@op
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+@op
+def broadcast_tensors(xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+@op
+def gather(x, index, axis=0):
+    index = jnp.asarray(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@op
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.asarray(index).reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@op
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@op
+def index_select(x, index, axis=0):
+    return jnp.take(x, jnp.asarray(index).reshape(-1), axis=int(axis))
+
+
+@op
+def index_sample(x, index):
+    index = jnp.asarray(index)
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+@op
+def index_add(x, index, axis, value):
+    index = jnp.asarray(index).reshape(-1)
+    axis = int(axis)
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(jnp.asarray(value), axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@op
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@op
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, jnp.asarray(indices), axis=int(axis))
+
+
+@op
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    indices = jnp.asarray(indices)
+    if reduce == "add":
+        return _put_along_axis_impl(x, indices, values, axis, "add")
+    if reduce in ("mul", "multiply"):
+        return _put_along_axis_impl(x, indices, values, axis, "mul")
+    return _put_along_axis_impl(x, indices, values, axis, "assign")
+
+
+def _put_along_axis_impl(x, indices, values, axis, mode):
+    axis = int(axis) % x.ndim
+    # build full index grid
+    idx = jnp.indices(indices.shape)
+    full = tuple(
+        indices if d == axis else idx[d] for d in range(x.ndim)
+    )
+    values = jnp.broadcast_to(jnp.asarray(values), indices.shape)
+    if mode == "add":
+        return x.at[full].add(values)
+    if mode == "mul":
+        return x.at[full].multiply(values)
+    return x.at[full].set(values)
+
+
+@op
+def masked_select(x, mask):
+    # dynamic output shape: resolved on host (eager only, like the
+    # reference's masked_select which is shape-dynamic too)
+    import numpy as np
+    xm = np.asarray(x)
+    mm = np.asarray(mask)
+    return jnp.asarray(xm[mm])
+
+
+@op
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@op
+def masked_scatter(x, mask, value):
+    import numpy as np
+    xm = np.asarray(x).copy()
+    mm = np.asarray(mask)
+    vals = np.asarray(value).reshape(-1)[: int(mm.sum())]
+    xm[mm] = vals
+    return jnp.asarray(xm)
+
+
+@op
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@op
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts,
+                    axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+@op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad semantics: ``pad`` is a flat list over the
+    last len(pad)//2 dims in reverse order (like torch), or per-dim pairs."""
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full per-dim spec in paddle order (dim0_lo, dim0_hi, ...)? paddle
+        # uses flat [before, after] pairs from the last dims backwards when
+        # len < 2nd; when equal, treat as per-dim forward order pairs.
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(pad) // 2
+        pairs = [(0, 0)] * (nd - k)
+        # reversed: last dim first
+        for i in range(k):
+            lo, hi = pad[2 * i], pad[2 * i + 1]
+            pairs.append((lo, hi))
+        # paddle pads the trailing dims with the list applying from the
+        # last-k dims in order (e.g. NCHW pad=[l,r,t,b] -> H:(t,b), W:(l,r))
+        if k >= 2:
+            tail = pairs[-k:]
+            pairs = pairs[:-k] + tail[::-1]
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=value)
+    return jnp.pad(x, pairs, mode=mode_map[mode])
+
+
+@op
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = lax.top_k(xm, int(k))
+    else:
+        vals, idx = lax.top_k(-xm, int(k))
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int32))
+
+
+@op
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@op
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int32)
+
+
+@op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    """Index dtype note: this framework's index ops return int32 (the
+    TPU-native integer width; int64 costs 2x HBM and jax runs with x64
+    disabled). out_int32=False is accepted for API parity and also yields
+    int32."""
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32)
+
+
+@op
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    nz = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(v) for v in nz)
+    return jnp.asarray(np.stack(nz, axis=-1))
+
+
+@op
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    import numpy as np
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@op
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(jnp.asarray(x), int(num_classes))
+
+
+@op
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int64 if False else jnp.int32)
+
+
+@op
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """Reference: paddle.shard_index (used by parallel cross entropy)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+@op
+def getitem(x, index):
+    return x[index]
+
+
+@op
+def setitem(x, value, index):
+    value = jnp.asarray(value)
+    if value.dtype != x.dtype:
+        value = value.astype(x.dtype)
+    return x.at[index].set(value)
+
+
+@op
+def as_strided(x, shape, stride, offset=0):
+    """Zero-copy view analog (reference: paddle/phi/kernels/stride/
+    as_strided_kernel.cc). XLA has no strides; emit a gather with the same
+    semantics — the compiler turns common cases back into views."""
+    import numpy as np
+    flat = jnp.ravel(x)
+    idx = np.zeros(tuple(shape), dtype=np.int32)
+    grids = np.indices(tuple(shape))
+    for g, s in zip(grids, stride):
+        idx = idx + g * int(s)
+    return flat[offset + jnp.asarray(idx)]
+
+
+@op
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@op
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(jnp.asarray(x).reshape(-1), weights=weights,
+                        minlength=int(minlength))
+
+
+@op
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=int(bins), range=(lo, hi))
+    return hist

@@ -1,0 +1,521 @@
+"""Elementwise / reduction math emitters.
+
+Each function is a pure JAX function emitting XLA HLO — the TPU analog of the
+reference's Phi kernels (paddle/phi/kernels/cpu|gpu/*_kernel.*). Gradients
+come from ``jax.vjp`` over these emitters (see ops/registry.py), replacing the
+reference's backward yaml + grad kernels. Naming and argument conventions
+follow python/paddle/tensor/math.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+@op
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@op
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@op
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@op
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@op
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@op
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+@op
+def elementwise_pow(x, y):
+    return jnp.power(x, y)
+
+
+@op
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@op
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@op
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@op
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@op
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@op
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@op
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@op
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@op
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@op
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@op
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@op
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@op
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+@op
+def exp(x):
+    return jnp.exp(x)
+
+
+@op
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@op
+def log(x):
+    return jnp.log(x)
+
+
+@op
+def log2(x):
+    return jnp.log2(x)
+
+
+@op
+def log10(x):
+    return jnp.log10(x)
+
+
+@op
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@op
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@op
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@op
+def abs(x):
+    return jnp.abs(x)
+
+
+@op
+def neg(x):
+    return jnp.negative(x)
+
+
+@op
+def sign(x):
+    return jnp.sign(x)
+
+
+@op
+def floor(x):
+    return jnp.floor(x)
+
+
+@op
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@op
+def round(x):
+    return jnp.round(x)
+
+
+@op
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@op
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@op
+def sin(x):
+    return jnp.sin(x)
+
+
+@op
+def cos(x):
+    return jnp.cos(x)
+
+
+@op
+def tan(x):
+    return jnp.tan(x)
+
+
+@op
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@op
+def acos(x):
+    return jnp.arccos(x)
+
+
+@op
+def atan(x):
+    return jnp.arctan(x)
+
+
+@op
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@op
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@op
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@op
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@op
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@op
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@op
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@op
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@op
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@op
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@op
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@op
+def square(x):
+    return jnp.square(x)
+
+
+@op
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@op
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """Reference: paddle.scale (python/paddle/tensor/math.py scale)."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@op
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@op
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op(name="isnan")
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@op(name="isinf")
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@op(name="isfinite")
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@op
+def angle(x):
+    return jnp.angle(x)
+
+
+@op
+def conj(x):
+    return jnp.conj(x)
+
+
+@op
+def real(x):
+    return jnp.real(x)
+
+
+@op
+def imag(x):
+    return jnp.imag(x)
+
+
+@op
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@op(name="sum")
+def sum_(x, axis=None, dtype=None, keepdim=False):
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from paddle_tpu.core.dtype import to_jax
+        out = out.astype(to_jax(dtype))
+    return out
+
+
+@op
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="max")
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="min")
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def prod(x, axis=None, keepdim=False, dtype=None):
+    from paddle_tpu.core.dtype import to_jax
+    return jnp.prod(
+        x, axis=_axis(axis), keepdims=keepdim,
+        dtype=to_jax(dtype) if dtype is not None else None,
+    )
+
+
+@op(name="all")
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="any")
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def cumsum(x, axis=None, dtype=None):
+    from paddle_tpu.core.dtype import to_jax
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis,
+                      dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def cumprod(x, dim=None, dtype=None):
+    from paddle_tpu.core.dtype import to_jax
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim,
+                       dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def cummax(x, axis=0):
+    return lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+@op
+def cummin(x, axis=0):
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@op
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.int32)
+
+
+@op
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.int32)
+
+
+@op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    from paddle_tpu.core.dtype import to_jax
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim,
+                      dtype=to_jax(dtype) if dtype is not None else None)
+
+
+@op
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)

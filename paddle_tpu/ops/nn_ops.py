@@ -1,0 +1,826 @@
+"""NN primitive emitters: activations, conv/pool, norms, losses, attention.
+
+TPU analog of the reference's gpudnn/cudnn kernels
+(paddle/phi/kernels/gpudnn/, kernels/gpu/) — conv/pool lower to
+``lax.conv_general_dilated``/``lax.reduce_window`` which XLA tiles onto the
+MXU; norms and softmax are fused by XLA instead of handwritten kernels.
+Layouts follow paddle's NCHW default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+@op
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@op
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@op
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@op
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@op
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@op
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@op
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@op
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+@op
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@op
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@op
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+@op
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@op
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+@op
+def prelu(x, weight):
+    return jnp.where(x > 0, x, weight * x)
+
+
+@op
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@op
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@op
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@op
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@op
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@op
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from paddle_tpu.core import generator as gen
+    key = gen.active_key()
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = (jnp.arange(y.shape[axis]) == idx).astype(y.dtype) \
+            if axis in (-1, y.ndim - 1) else jnp.zeros_like(y).at[...].set(
+                jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis))
+        y = lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+@op
+def linear(x, weight, bias=None):
+    """weight layout: [in_features, out_features] (paddle convention,
+    python/paddle/nn/functional/common.py linear)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, jnp.asarray(x), axis=0)
+    if padding_idx is not None:
+        mask = (jnp.asarray(x) == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conv / pool  (NCHW)
+# ---------------------------------------------------------------------------
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(a) for a in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, k, stride, dilation, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+@op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Reference kernel: paddle/phi/kernels/gpudnn/conv_kernel.cu — here a
+    single lax.conv_general_dilated that XLA maps onto the MXU."""
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, weight.shape[-2:], stride, dilation, 2)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=int(groups),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, weight.shape[-1:], stride, dilation, 1)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=int(groups),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, weight.shape[-3:], stride, dilation, 3)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=int(groups),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    p = _conv_padding(padding, weight.shape[-2:], stride, dilation, 2)
+    kh = (weight.shape[2] - 1) * dilation[0] + 1
+    kw = (weight.shape[3] - 1) * dilation[1] + 1
+    pad_t = [(kh - 1 - p[0][0], kh - 1 - p[0][1] + opad[0]),
+             (kw - 1 - p[1][0], kw - 1 - p[1][1] + opad[1])]
+    # weight layout for transpose in paddle: [in, out/groups, kh, kw]
+    w = jnp.flip(weight, axis=(-2, -1))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)  # -> [out, in, kh, kw]
+    else:
+        ci, cog = w.shape[0], w.shape[1]
+        w = w.reshape(groups, ci // groups, cog, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * cog, ci // groups,
+                                          *w.shape[3:])
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad_t,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=int(groups),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@op
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    if isinstance(pad, str):
+        padding_cfg = pad
+    else:
+        padding_cfg = [(0, 0), (0, 0)] + list(pad)
+    # -inf init keeps XLA's max-pool pattern (and its reverse-mode rule)
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return lax.reduce_window(
+        x, neg, lax.max, (1, 1) + k, (1, 1) + s,
+        padding_cfg if isinstance(padding_cfg, str) else padding_cfg,
+    )
+
+
+@op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    padding_cfg = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                               padding_cfg)
+    if exclusive and not isinstance(padding_cfg, str):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                                   padding_cfg)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+@op
+def adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x4.mean(axis=(3, 5))
+    # general case: interpolate-style averaging
+    out = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
+    rows = [(int(jnp.floor(i * h / oh)), int(jnp.ceil((i + 1) * h / oh)))
+            for i in range(oh)]
+    cols = [(int(jnp.floor(j * w / ow)), int(jnp.ceil((j + 1) * w / ow)))
+            for j in range(ow)]
+    blocks = [
+        x[:, :, r0:r1, c0:c1].mean(axis=(2, 3)) for r0, r1 in rows
+        for c0, c1 in cols
+    ]
+    return jnp.stack(blocks, axis=-1).reshape(n, c, oh, ow)
+
+
+@op
+def adaptive_max_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x4.max(axis=(3, 5))
+    rows = [(int(i * h // oh), int(-(-((i + 1) * h) // oh))) for i in range(oh)]
+    cols = [(int(j * w // ow), int(-(-((j + 1) * w) // ow))) for j in range(ow)]
+    blocks = [
+        x[:, :, r0:r1, c0:c1].max(axis=(2, 3)) for r0, r1 in rows
+        for c0, c1 in cols
+    ]
+    return jnp.stack(blocks, axis=-1).reshape(n, c, oh, ow)
+
+
+@op
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride, 1) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1,), 1)
+    padding_cfg = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, neg, lax.max, (1, 1) + k, (1, 1) + s,
+                             padding_cfg)
+
+
+@op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride, 1) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1,), 1)
+    padding_cfg = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                               padding_cfg)
+    if exclusive and not isinstance(padding_cfg, str):
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                   (1, 1) + k, (1, 1) + s, padding_cfg)
+        return summed / counts
+    return summed / k[0]
+
+
+@op
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: paddle/phi/kernels/impl/unfold_kernel_impl.h)."""
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _conv_padding(paddings, k, s, d, 2)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), p[0], p[1]])
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        rhs_dilation=d, dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, *k), ("NCHW", "OIHW", "NCHW")),
+    )
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+@op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@op
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (
+            scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = int(size[0]), int(size[1])
+    if align_corners and mode in ("bilinear", "linear") and oh > 1 and ow > 1:
+        # corner-aligned sampling: src = dst * (in-1)/(out-1); gather + lerp
+        return _bilinear_align_corners(x, oh, ow)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "linear": "linear", "area": "linear"}[mode]
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def _bilinear_align_corners(x, oh, ow):
+    n, c, h, w = x.shape
+    ys = jnp.linspace(0.0, h - 1.0, oh)
+    xs = jnp.linspace(0.0, w - 1.0, ow)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(x.dtype)[:, None]
+    wx = (xs - x0).astype(x.dtype)[None, :]
+    g = lambda yi, xi: x[:, :, yi][:, :, :, xi]
+    top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@op
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    """Functional BN. Returns (out, batch_mean, batch_var) — the Layer is
+    responsible for the running-stat update (like the reference's
+    batch_norm kernel outputs mean_out/variance_out,
+    paddle/phi/kernels/gpu/batch_norm_kernel.cu)."""
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    inv = lax.rsqrt(var + epsilon).reshape(bshape)
+    out = (x - mean.reshape(bshape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    if training:
+        return out, mean, var
+    return out, running_mean, running_var
+
+
+@op
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
+               begin_norm_axis=None, normalized_shape=None):
+    if normalized_shape is not None:
+        nd = len(normalized_shape) if isinstance(normalized_shape, (list, tuple)) else 1
+        axes = tuple(range(x.ndim - nd, x.ndim))
+    elif begin_norm_axis is not None:
+        axes = tuple(range(begin_norm_axis, x.ndim))
+    else:
+        axes = (x.ndim - 1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Fused RMSNorm analog (reference:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py). Computed in f32
+    for bf16 inputs, the TPU-standard recipe."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + epsilon)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@op
+def group_norm(x, groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    n, c = x.shape[0], x.shape[1]
+    g = int(groups)
+    xs = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, xs.ndim))
+    mean = jnp.mean(xs, axis=axes, keepdims=True)
+    var = jnp.var(xs, axis=axes, keepdims=True)
+    out = ((xs - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@op
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@op
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    n, c = x.shape[0], x.shape[1]
+    pad = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] +
+                  [(0, 0)] * (x.ndim - 2))
+    acc = sum(pad[:, i:i + c] for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+@op
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                            keepdims=True), 1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# dropout & random
+# ---------------------------------------------------------------------------
+@op
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
+    if not training or p == 0.0:
+        # downscale_in_infer trains with out = x*mask (no upscale), so
+        # inference must compensate by (1-p)
+        if mode == "downscale_in_infer" and p > 0.0:
+            return x * (1.0 - p)
+        return x
+    from paddle_tpu.core import generator as gen
+    key = gen.active_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+@op
+def bernoulli(x):
+    from paddle_tpu.core import generator as gen
+    return jax.random.bernoulli(gen.active_key(), x, x.shape).astype(x.dtype)
+
+
+@op
+def multinomial(x, num_samples=1, replacement=False):
+    from paddle_tpu.core import generator as gen
+    key = gen.active_key()
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*x.shape[:-1], int(num_samples)))
+    else:
+        z = jax.random.gumbel(key, x.shape) + logits
+        _, out = lax.top_k(z, int(num_samples))
+    return out.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """Reference: paddle.nn.functional.cross_entropy
+    (python/paddle/nn/functional/loss.py)."""
+    logp = jax.nn.log_softmax(input, axis=axis) if use_softmax else jnp.log(
+        jnp.maximum(input, 1e-30))
+    if soft_label:
+        lbl = jnp.asarray(label, dtype=logp.dtype)
+        if label_smoothing > 0.0:
+            n = lbl.shape[axis]
+            lbl = lbl * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(lbl * logp, axis=axis)
+        return _reduce(loss, reduction)
+    label = jnp.asarray(label)
+    if label.ndim == logp.ndim:
+        label = jnp.squeeze(label, axis=axis)
+    n_classes = logp.shape[axis]
+    valid = label != ignore_index
+    safe_label = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_label, axis).astype(jnp.int32), axis=axis
+    )
+    nll = -jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(logp, axis=axis)
+        nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+    if weight is not None:
+        w = jnp.take(weight, safe_label)
+        nll = nll * w
+        if reduction == "mean":
+            return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)),
+                                          1.0)
+    return _reduce(nll, reduction)
+
+
+@op
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(jnp.asarray(label, logp.dtype) * logp, axis=axis,
+                        keepdims=True)
+    else:
+        lbl = jnp.asarray(label)
+        squeeze = lbl.ndim == logits.ndim
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                     axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, 0.0)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+@op
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    label = jnp.asarray(label)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0).astype(jnp.int32)
+    picked = jnp.take_along_axis(input, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(valid, -picked, 0.0)
+    if weight is not None:
+        loss = loss * jnp.take(weight, safe)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)),
+                                           1.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    softplus_neg_abs = jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            softplus_neg_abs + jnp.maximum(-logit, 0.0))
+    else:
+        loss = jnp.maximum(logit, 0.0) - logit * label + softplus_neg_abs
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@op
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op
+def hinge_loss(input, label):
+    return jnp.mean(jnp.maximum(0.0, 1.0 - input * label))
+
+
+@op
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _reduce(jnp.maximum(0.0, -label * (input - other) + margin),
+                   reduction)
+
+
+@op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+@op
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label > 0, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@op
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# attention (naive emitters; pallas flash kernels live in ops/pallas_kernels)
+# ---------------------------------------------------------------------------
+@op
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    """[batch, seq, heads, head_dim] layout (paddle flash_attention
+    convention, python/paddle/nn/functional/flash_attention.py)."""
+    q = jnp.swapaxes(query, 1, 2)  # b h s d
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    d = q.shape[-1]
+    scores = jnp.matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
+        jnp.asarray(d, dtype=jnp.float32)).astype(q.dtype)
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, jnp.asarray(-1e9, scores.dtype))
+        else:
+            scores = scores + attn_mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from paddle_tpu.core import generator as gen
+        mask = jax.random.bernoulli(gen.active_key(), 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(mask, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.matmul(probs, v)
+    return jnp.swapaxes(out, 1, 2)

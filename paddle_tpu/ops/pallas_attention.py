@@ -1,0 +1,30 @@
+"""Flash attention entry point.
+
+Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu (CUDA
+flash-attn). TPU-native plan: a Pallas blockwise-softmax kernel for the hot
+path (ops/pallas/flash_attention.py), with this XLA fallback (fused by XLA
+into a reasonably good attention already) used on CPU and for verification.
+
+Layout convention (paddle flash_attention): [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _API
+
+
+def flash_attention(query, key, value, causal=False, dropout=0.0,
+                    training=True):
+    use_pallas = False
+    try:
+        from paddle_tpu.ops.pallas import flash_attention as _fa
+        use_pallas = _fa.available() and dropout == 0.0
+    except Exception:
+        use_pallas = False
+    if use_pallas:
+        return _fa.flash_attention_op(query, key, value, causal=causal)
+    return _API["scaled_dot_product_attention"](
+        query, key, value, is_causal=causal, dropout_p=dropout,
+        training=training)

@@ -1,0 +1,78 @@
+"""Random sampling emitters.
+
+Reference: python/paddle/tensor/random.py backed by phi::Generator
+(paddle/phi/core/generator.h:32). Here every draw consumes a threefry key
+from the active Generator stream (see core/generator.py), so results are
+deterministic under seeds and replayable for recompute.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.dtype import get_default_dtype, to_jax
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+def _dt(dtype):
+    return to_jax(dtype) if dtype is not None else to_jax(get_default_dtype())
+
+
+@op
+def rand(shape, dtype=None):
+    return jax.random.uniform(gen.active_key(), tuple(shape), dtype=_dt(dtype))
+
+
+@op
+def randn(shape, dtype=None):
+    return jax.random.normal(gen.active_key(), tuple(shape), dtype=_dt(dtype))
+
+
+@op
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(gen.active_key(), tuple(shape), int(low),
+                             int(high))
+    return out.astype(jnp.int32)
+
+
+@op
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(gen.active_key(), tuple(shape), dtype=_dt(dtype),
+                              minval=min, maxval=max)
+
+
+@op
+def normal(mean=0.0, std=1.0, shape=None):
+    out = jax.random.normal(gen.active_key(), tuple(shape),
+                            dtype=to_jax(get_default_dtype()))
+    return out * std + mean
+
+
+@op
+def standard_normal(shape, dtype=None):
+    return jax.random.normal(gen.active_key(), tuple(shape), dtype=_dt(dtype))
+
+
+@op
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(gen.active_key(), int(n)).astype(jnp.int32)
+
+
+@op
+def shuffle(x, axis=0):
+    return jax.random.permutation(gen.active_key(), x, axis=int(axis),
+                                  independent=False)
+
+
+@op
+def poisson(x):
+    return jax.random.poisson(gen.active_key(), x).astype(x.dtype)
+
+
+@op
+def exponential(x, lam=1.0):
+    return jax.random.exponential(gen.active_key(), x.shape,
+                                  dtype=x.dtype) / lam

@@ -1,0 +1,307 @@
+"""Yaml-driven operator registry.
+
+The reference's central architectural idea is a single yaml op manifest
+(paddle/phi/api/yaml/ops.yaml) from which the C++ API, autograd nodes, python
+bindings and IR defs are generated (api_gen.py, eager_gen.py, python_c_gen.py,
+op_gen.py). This module is the TPU-native equivalent: ``ops.yaml`` declares
+the op surface; each op's *emitter* is a pure JAX function (the analog of a
+Phi kernel, but emitting XLA HLO instead of launching CUDA); the registry
+wraps emitters with
+
+  * eager dispatch (Tensor in / Tensor out),
+  * autograd recording via ``jax.vjp`` over the emitter (replacing the
+    reference's generated GradNodes + handwritten grad kernels),
+  * Tensor method + operator-overload binding,
+  * synthesized in-place variants (``add_`` etc., rebinding the buffer the
+    way the reference's inplace ops reuse allocations),
+  * nan/inf checking (FLAGS_check_nan_inf parity,
+    paddle/fluid/eager/nan_inf_utils.h).
+
+Because emitters are traceable JAX functions, the same registry serves both
+eager mode and the trace-to-static path (paddle_tpu.jit) with zero extra code
+— where the reference needs a separate static-graph op path (PIR dialect +
+kernel lowering), here XLA tracing subsumes it.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import flags
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.autograd import engine
+
+__all__ = ["OpDef", "register_emitter", "build_registry", "get_op", "OPS"]
+
+
+class OpDef:
+    __slots__ = (
+        "name", "emitter", "tensor_args", "list_args", "methods", "magic",
+        "inplace", "diff", "n_outputs", "sig",
+    )
+
+    def __init__(self, name, emitter, tensor_args, list_args, methods, magic,
+                 inplace, diff):
+        self.name = name
+        self.emitter = emitter
+        self.tensor_args = tuple(tensor_args)
+        self.list_args = frozenset(list_args)
+        self.methods = methods or []
+        self.magic = magic or []
+        self.inplace = inplace
+        self.diff = diff
+        self.sig = inspect.signature(emitter)
+
+
+# emitter functions registered by the emitter modules, keyed by op name
+_EMITTERS: Dict[str, Callable] = {}
+# built OpDefs
+OPS: Dict[str, OpDef] = {}
+# public functional API (op name -> wrapped callable)
+API: Dict[str, Callable] = {}
+
+
+def register_emitter(name=None):
+    """Decorator marking a pure-JAX function as the emitter for op ``name``."""
+
+    def deco(fn):
+        _EMITTERS[name or fn.__name__] = fn
+        return fn
+
+    if callable(name):
+        fn, name = name, name.__name__
+        _EMITTERS[name] = fn
+        return fn
+    return deco
+
+
+def _as_data(v, ref_dtype=None):
+    """Convert a single op input to something the emitter accepts."""
+    if isinstance(v, Tensor):
+        return v._data
+    return v  # scalars / numpy / None pass through; jnp handles them
+
+
+def _is_diff_dtype(d) -> bool:
+    return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating)
+
+
+def _check_nan_inf(name, outs):
+    for o in outs:
+        if _is_diff_dtype(o.dtype) and not isinstance(o, jax.core.Tracer):
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(f"op {name!r} produced nan/inf")
+
+
+# AMP hook: set by paddle_tpu.amp at import (avoids a circular import).
+# Signature: cast_for_op(op_name, datas_list) -> datas_list
+_AMP_HOOK = None
+
+
+def set_amp_hook(fn):
+    global _AMP_HOOK
+    _AMP_HOOK = fn
+
+
+def make_api(opdef: OpDef) -> Callable:
+    """Build the eager+autograd wrapper for one op."""
+
+    emitter = opdef.emitter
+    name = opdef.name
+    tset = set(opdef.tensor_args)
+
+    def run_emitter(call_args):
+        # AMP autocast at the dispatch boundary (the reference's generated
+        # AMP branch in eager_gen.py:1885 sits at the same point)
+        if _AMP_HOOK is not None:
+            for an in opdef.tensor_args:
+                v = call_args.get(an)
+                if an in opdef.list_args:
+                    if v:
+                        call_args[an] = _AMP_HOOK(name, list(v))
+                elif v is not None and hasattr(v, "dtype"):
+                    call_args[an] = _AMP_HOOK(name, [v])[0]
+        return emitter(**call_args)
+
+    def api(*args, **kwargs):
+        bound = opdef.sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        arguments = bound.arguments
+
+        # --- collect tensor inputs (flattened) ---------------------------
+        primal_tensors: List[Tensor] = []  # diff Tensors, order of primals
+        primal_paths: List = []  # (argname, None | list-index)
+        for an in opdef.tensor_args:
+            v = arguments.get(an)
+            if an in opdef.list_args:
+                items = list(v) if v is not None else []
+                datas = []
+                for i, item in enumerate(items):
+                    d = _as_data(item)
+                    datas.append(d)
+                    if (
+                        isinstance(item, Tensor)
+                        and not item.stop_gradient
+                        and _is_diff_dtype(item._data.dtype)
+                    ):
+                        primal_tensors.append(item)
+                        primal_paths.append((an, i))
+                arguments[an] = datas
+            else:
+                d = _as_data(v)
+                arguments[an] = d
+                if (
+                    isinstance(v, Tensor)
+                    and not v.stop_gradient
+                    and _is_diff_dtype(v._data.dtype)
+                ):
+                    primal_tensors.append(v)
+                    primal_paths.append((an, None))
+        # non-tensor-arg Tensors (e.g. attr passed as Tensor) -> raw data
+        for k, v in list(arguments.items()):
+            if k not in tset and isinstance(v, Tensor):
+                arguments[k] = v._data
+            elif k not in tset and isinstance(v, (list, tuple)):
+                arguments[k] = type(v)(
+                    x._data if isinstance(x, Tensor) else x for x in v
+                )
+
+        want_grad = (
+            opdef.diff
+            and engine.is_grad_enabled()
+            and len(primal_tensors) > 0
+        )
+
+        if not want_grad:
+            out = run_emitter(dict(arguments))
+        else:
+            # pure function over the diff primals only; everything else is
+            # closed over (ints/bools/attrs are constants to XLA anyway)
+            def pure(*primals):
+                call_args = dict(arguments)
+                for p, (an, li) in zip(primals, primal_paths):
+                    if li is None:
+                        call_args[an] = p
+                    else:
+                        lst = list(call_args[an])
+                        lst[li] = p
+                        call_args[an] = lst
+                return run_emitter(call_args)
+
+            out, vjp_fn = jax.vjp(pure, *(t._data for t in primal_tensors))
+
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        if flags.flag("check_nan_inf"):
+            _check_nan_inf(name, outs)
+
+        out_tensors = [
+            Tensor._from_data(o, stop_gradient=not want_grad) for o in outs
+        ]
+        if want_grad:
+            engine.register_node(out_tensors, name, vjp_fn, primal_tensors)
+        return tuple(out_tensors) if multi else out_tensors[0]
+
+    api.__name__ = name
+    api.__qualname__ = name
+    api.__doc__ = emitter.__doc__
+    api._opdef = opdef
+    return api
+
+
+def rebind_inplace(self, out):
+    """Rebind ``self`` to the result of an out-of-place op, preserving
+    autograd correctness: the recorded node's input must keep pointing at
+    the PRE-op value of ``self`` (otherwise the node references itself and
+    backward silently drops the gradient). A detached snapshot carrying the
+    old producer takes self's place in the node's input list."""
+    node = out._grad_node
+    if node is not None and not engine.is_grad_enabled():
+        node = None
+    if node is not None and any(inp is self for inp in node.inputs):
+        if self._grad_node is None and not self.stop_gradient:
+            raise RuntimeError(
+                "a leaf Tensor that requires grad is being used in an "
+                "in-place operation; detach() it first or wrap in no_grad()")
+        snap = Tensor._from_data(self._data,
+                                 stop_gradient=self.stop_gradient)
+        snap._grad_node = self._grad_node
+        snap._output_index = self._output_index
+        node.inputs = [snap if inp is self else inp for inp in node.inputs]
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._output_index = out._output_index
+    self.stop_gradient = out.stop_gradient and self.stop_gradient
+    return self
+
+
+def _make_inplace(opdef, api):
+    def inplace(self, *args, **kwargs):
+        return rebind_inplace(self, api(self, *args, **kwargs))
+
+    inplace.__name__ = opdef.name + "_"
+    return inplace
+
+
+_MAGIC_REFLECTED = {
+    "__add__": "__radd__", "__sub__": "__rsub__", "__mul__": "__rmul__",
+    "__truediv__": "__rtruediv__", "__floordiv__": "__rfloordiv__",
+    "__mod__": "__rmod__", "__pow__": "__rpow__", "__matmul__": "__rmatmul__",
+}
+
+
+def build_registry(yaml_entries: Sequence[dict]):
+    """Instantiate OpDefs from the yaml manifest + registered emitters,
+    export the functional API, and bind Tensor methods."""
+    for ent in yaml_entries:
+        name = ent["op"]
+        if name not in _EMITTERS:
+            raise RuntimeError(f"ops.yaml declares {name!r} but no emitter is registered")
+        emitter = _EMITTERS[name]
+        params = list(inspect.signature(emitter).parameters)
+        targs = ent.get("tensor_args")
+        if targs is None:
+            targs = [params[0]] if params else []
+        list_args = [a[1:] for a in targs if a.startswith("*")]
+        targs = [a.lstrip("*") for a in targs]
+        opdef = OpDef(
+            name=name,
+            emitter=emitter,
+            tensor_args=targs,
+            list_args=list_args,
+            methods=ent.get("methods", [name]),
+            magic=ent.get("magic", []),
+            inplace=ent.get("inplace", False),
+            diff=ent.get("diff", True),
+        )
+        OPS[name] = opdef
+        api = make_api(opdef)
+        API[name] = api
+        _bind_tensor(opdef, api)
+    return API
+
+
+def _bind_tensor(opdef: OpDef, api: Callable):
+    for m in opdef.methods:
+        if m and not hasattr(Tensor, m):
+            setattr(Tensor, m, api)
+    for mg in opdef.magic:
+        setattr(Tensor, mg, api)
+        refl = _MAGIC_REFLECTED.get(mg)
+        if refl:
+            def reflected(self, other, _api=api):
+                return _api(other if isinstance(other, Tensor)
+                            else Tensor(other, dtype=self.dtype), self)
+            setattr(Tensor, refl, reflected)
+    if opdef.inplace:
+        setattr(Tensor, opdef.name + "_", _make_inplace(opdef, api))
+
+
+def get_op(name: str) -> Callable:
+    return API[name]

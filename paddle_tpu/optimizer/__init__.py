@@ -1,0 +1,5 @@
+from paddle_tpu.optimizer.optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
+    Optimizer, RAdam, RMSProp, SGD,
+)
+from paddle_tpu.optimizer import lr  # noqa: F401

@@ -1,0 +1,395 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/optimizer.py + adam.py/adamw.py/....
+Design: each optimizer defines a *pure functional rule*
+``_rule(param, grad, slots, lr, step) -> (new_param, new_slots)`` over jax
+arrays. Eager ``step()`` applies it per parameter; the jit path
+(paddle_tpu/jit/train.py) applies the same rule inside the traced step so
+eager and compiled training share one implementation — where the reference
+needs separate eager ops and static-graph optimizer passes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        from paddle_tpu.optimizer.lr import LRScheduler
+
+        self._lr_scheduler: Optional[LRScheduler] = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            self._base_lr = None
+        else:
+            self._base_lr = float(learning_rate)
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list: Optional[List[Tensor]] = parameters
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._slots: Dict[int, dict] = {}
+        self._step_count = 0
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return self._base_lr
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._base_lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr_scheduler if self._lr_scheduler is not None \
+            else self._base_lr
+
+    # -- functional core (override) ----------------------------------------
+    def _init_slots(self, p) -> dict:
+        return {}
+
+    def _rule(self, p, g, slots, lr, step):
+        raise NotImplementedError
+
+    # weight decay applied as decoupled or L2 depending on optimizer.
+    # _current_decay_enabled is set per-parameter before each _rule call
+    # (False when apply_decay_param_fun / exclude_from_weight_decay_fn
+    # excludes the parameter); it is trace-time static so the jit TrainStep
+    # sees the right branch per parameter.
+    _current_decay_enabled = True
+
+    def _decay_enabled(self, param) -> bool:
+        return True
+
+    def _apply_weight_decay_to_grad(self, p, g):
+        wd = self._weight_decay
+        if wd and self._current_decay_enabled:
+            coeff = wd.coeff if hasattr(wd, "coeff") else float(wd)
+            return g + coeff * p
+        return g
+
+    # -- eager step --------------------------------------------------------
+    @engine.no_grad()
+    def step(self):
+        params = self._parameter_list or []
+        grads = [(p, p.grad) for p in params
+                 if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g in grads])
+            grads = clipped
+        self._step_count += 1
+        for p, g in grads:
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self._init_slots(p._data)
+                self._slots[id(p)] = slots
+            gdata = g._data if isinstance(g, Tensor) else g
+            if gdata.dtype != p._data.dtype:
+                gdata = gdata.astype(p._data.dtype)
+            self._current_decay_enabled = self._decay_enabled(p)
+            new_p, new_slots = self._rule(p._data, gdata, slots,
+                                          self.get_lr(), self._step_count)
+            self._current_decay_enabled = True
+            p._data = new_p
+            self._slots[id(p)] = new_slots
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        names = self._param_names()
+        for p, name in names.items():
+            for k, v in self._slots.get(p, {}).items():
+                out[f"{name}.{k}"] = Tensor._from_data(v) \
+                    if not isinstance(v, Tensor) else v
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if self._lr_scheduler is not None and "LR_Scheduler" in state:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        names = {v: k for k, v in self._param_names().items()}
+        for key, val in state.items():
+            if key in ("step", "LR_Scheduler"):
+                continue
+            pname, _, slot = key.rpartition(".")
+            pid = names.get(pname)
+            if pid is not None:
+                data = val._data if isinstance(val, Tensor) else jnp.asarray(
+                    val)
+                self._slots.setdefault(pid, {})[slot] = data
+
+    def _param_names(self):
+        return {id(p): p.name for p in (self._parameter_list or [])}
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            p2 = p - lr * (g + self._momentum * v)
+        else:
+            p2 = p - lr * v
+        return p2, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        m = slots["moment"] + jnp.square(g)
+        p2 = p - lr * g / (jnp.sqrt(m) + self._eps)
+        return p2, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _init_slots(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p),
+                "avg_sq_update": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        asg = self._rho * slots["avg_sq_grad"] + (1 - self._rho) * jnp.square(g)
+        update = g * jnp.sqrt(slots["avg_sq_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * slots["avg_sq_update"] + \
+            (1 - self._rho) * jnp.square(update)
+        return p - lr * update, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p),
+             "momentum": jnp.zeros_like(p)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        new["momentum"] = mom
+        return p - mom, new
+
+
+class Adam(Optimizer):
+    """Reference: python/paddle/optimizer/adam.py (L2-into-grad wd)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _decoupled(self):
+        return False
+
+    def _rule(self, p, g, slots, lr, step):
+        if not self._decoupled():
+            g = self._apply_weight_decay_to_grad(p, g)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        if self._decoupled() and self._weight_decay and \
+                self._current_decay_enabled:
+            coeff = (self._weight_decay.coeff
+                     if hasattr(self._weight_decay, "coeff")
+                     else float(self._weight_decay))
+            upd = upd + lr * coeff * p
+        return p - upd, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def _decay_enabled(self, param):
+        if self._apply_decay_param_fun is not None:
+            return bool(self._apply_decay_param_fun(param.name))
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g))
+        p2 = p - lr / (1 - b1 ** step) * m / (u + self._eps)
+        return p2, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference:
+    python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_enabled(self, param):
+        if self._exclude_fn is not None:
+            # exclude_from_weight_decay_fn(param) -> True means EXCLUDE
+            return not bool(self._exclude_fn(param))
+        return True
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = (float(self._weight_decay)
+              if self._weight_decay and self._current_decay_enabled else 0.0)
+        r = r + wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * ratio * r, {"moment1": m, "moment2": v}
+
+
+class NAdam(Adam):
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** (step + 1))
+        vhat = v / (1 - b2 ** step)
+        m_bar = b1 * mhat + (1 - b1) * g / (1 - b1 ** step)
+        return p - lr * m_bar / (jnp.sqrt(vhat) + self._eps), \
+            {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _rule(self, p, g, slots, lr, step):
+        import math
+
+        g = self._apply_weight_decay_to_grad(p, g)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** step)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * step * (b2 ** step) / (1 - b2 ** step)
+        if rho_t > 4.0:
+            vhat = jnp.sqrt(v / (1 - b2 ** step))
+            rt = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                           ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            p2 = p - lr * rt * mhat / (vhat + self._eps)
+        else:
+            p2 = p - lr * mhat
+        return p2, {"moment1": m, "moment2": v}

@@ -1,0 +1,189 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+The image has no network egress, so the download-backed datasets
+(CIFAR/MNIST/...) also provide a deterministic synthetic mode
+(``backend='synthetic'`` or when files are absent) generating class-
+conditional data — enough for pipeline/throughput work and tests; real
+files are used when present at the standard paths.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "DatasetFolder",
+           "ImageFolder", "RandomImageDataset"]
+
+
+class _SyntheticImageMixin:
+    def _make_synthetic(self, n, shape, num_classes, seed=0):
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, num_classes, size=n).astype(np.int64)
+        # class-conditional means so models can actually learn
+        means = rng.uniform(-0.5, 0.5, size=(num_classes,) + shape)
+        data = (means[labels] +
+                rng.normal(0, 0.25, size=(n,) + shape)).astype(np.float32)
+        return data, labels
+
+
+class Cifar10(Dataset, _SyntheticImageMixin):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend or "numpy"
+        n = 50000 if mode == "train" else 10000
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/cifar/cifar-10-python.tar.gz")
+        if os.path.exists(path):
+            self.data, self.labels = self._load_real(path, mode)
+        else:
+            n_synth = min(n, 10000)
+            self.data, self.labels = self._make_synthetic(
+                n_synth, (3, 32, 32), self.NUM_CLASSES,
+                seed=0 if mode == "train" else 1)
+
+    def _load_real(self, path, mode):
+        datas, labels = [], []
+        with tarfile.open(path, "r:gz") as tf:
+            names = [m for m in tf.getmembers()
+                     if ("data_batch" in m.name if mode == "train"
+                         else "test_batch" in m.name)]
+            for m in sorted(names, key=lambda m: m.name):
+                batch = pickle.load(tf.extractfile(m), encoding="bytes")
+                datas.append(batch[b"data"].reshape(-1, 3, 32, 32))
+                labels.extend(batch.get(b"labels", batch.get(b"fine_labels")))
+        data = (np.concatenate(datas).astype(np.float32) / 255.0)
+        return data, np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/cifar/cifar-100-python.tar.gz")
+        super().__init__(data_file, mode, transform, download, backend)
+
+
+class MNIST(Dataset, _SyntheticImageMixin):
+    NUM_CLASSES = 10
+    SHAPE = (1, 28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        if image_path and os.path.exists(image_path):
+            self.data, self.labels = self._load_idx(image_path, label_path)
+        else:
+            self.data, self.labels = self._make_synthetic(
+                min(n, 10000), self.SHAPE, self.NUM_CLASSES,
+                seed=2 if mode == "train" else 3)
+
+    def _load_idx(self, image_path, label_path):
+        import gzip
+
+        with gzip.open(image_path, "rb") as f:
+            f.read(16)
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        data = data.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+        with gzip.open(label_path, "rb") as f:
+            f.read(8)
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return data, labels
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class RandomImageDataset(Dataset):
+    """Pure-random benchmark dataset."""
+
+    def __init__(self, num_samples, shape=(3, 224, 224), num_classes=1000,
+                 seed=0):
+        self.num_samples = num_samples
+        self.shape = shape
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.normal(0, 1, self.shape).astype(np.float32)
+        label = np.int64(rng.randint(self.num_classes))
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    pass
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"), dtype=np.float32) / 255.0
+    except ImportError:
+        raise RuntimeError("PIL unavailable; use .npy images")

@@ -1,0 +1,171 @@
+"""Vision transforms on numpy CHW arrays (reference:
+python/paddle/vision/transforms/)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Transpose", "Resize",
+           "RandomCrop", "CenterCrop", "RandomHorizontalFlip", "Pad",
+           "RandomResizedCrop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (img - m) / s
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if img.ndim == 3 and img.shape[-1] in (1, 3, 4) and \
+                self.data_format == "CHW":
+            img = img.transpose(2, 0, 1)
+        if img.max() > 1.5:
+            img = img / 255.0
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+
+        img = np.asarray(img, dtype=np.float32)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        if chw:
+            c, h, w = img.shape
+            out = jax.image.resize(jnp.asarray(img),
+                                   (c, self.size[0], self.size[1]),
+                                   method="linear")
+        else:
+            h, w, c = img.shape
+            out = jax.image.resize(jnp.asarray(img),
+                                   (self.size[0], self.size[1], c),
+                                   method="linear")
+        return np.asarray(out)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            pads = [(0, 0), (p, p), (p, p)] if chw else [(p, p), (p, p),
+                                                         (0, 0)]
+            img = np.pad(img, pads, mode="constant")
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0],
+                                                         img.shape[1])
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th,
+                                                          j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.shape[0] in (1, 3, 4)
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0],
+                                                         img.shape[1])
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th,
+                                                          j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.shape[0] in (1, 3, 4)
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0],
+                                                         img.shape[1])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if th <= h and tw <= w:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                crop = img[:, i:i + th, j:j + tw] if chw else \
+                    img[i:i + th, j:j + tw]
+                return self._resize(crop)
+        return self._resize(img)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            img = np.asarray(img)
+            chw = img.shape[0] in (1, 3, 4)
+            return img[..., ::-1].copy() if chw else img[:, ::-1].copy()
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        p = self.padding
+        chw = img.shape[0] in (1, 3, 4)
+        pads = [(0, 0), (p, p), (p, p)] if chw else [(p, p), (p, p), (0, 0)]
+        return np.pad(img, pads, mode="constant")

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU platform so every test —
+including mesh/sharding/collective tests — runs without TPU hardware
+(the role of the reference's fake_cpu_device / Gloo CPU process groups,
+SURVEY.md §4)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# XLA:CPU's fast matmul path is bf16-like; tests check f32 numerics
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    yield

@@ -1,0 +1,171 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x          # 4
+    z = y * x + y      # 8 + 4 = 12; dz/dx = 3x^2 + 2x = 16
+    z.backward()
+    np.testing.assert_allclose(x.grad.item(), 16.0, rtol=1e-6)
+
+
+def test_branching_accumulation():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2.0
+    b = x * 4.0
+    out = a + b
+    out.backward()
+    np.testing.assert_allclose(x.grad.item(), 6.0)
+
+
+def test_matmul_grad():
+    A = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    B = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.matmul(A, B).sum()
+    out.backward()
+    np.testing.assert_allclose(A.grad.numpy(),
+                               (np.ones((3, 5)) @ B.numpy().T), rtol=1e-5)
+    np.testing.assert_allclose(B.grad.numpy(),
+                               (A.numpy().T @ np.ones((3, 5))), rtol=1e-5)
+
+
+def test_numeric_gradient_check():
+    """Finite-difference gradient check, the OpTest pattern
+    (reference: test/legacy_test/op_test.py:148 get_numeric_gradient)."""
+    def f(x):
+        return (paddle.tanh(x) * x).sum()
+
+    x0 = np.random.randn(4).astype(np.float32)
+    x = paddle.to_tensor(x0, stop_gradient=False)
+    f(x).backward()
+    eps = 1e-3
+    num = np.zeros_like(x0)
+    for i in range(4):
+        xp, xm = x0.copy(), x0.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        num[i] = (f(paddle.to_tensor(xp)).item() -
+                  f(paddle.to_tensor(xm)).item()) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), num, atol=1e-2)
+
+
+def test_no_grad():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    z.backward()
+    assert x.grad is None
+
+
+def test_grad_accumulate_multiple_backward():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.item(), 5.0)
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], dtype=np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * 2
+    seen = []
+
+    def hook(g):
+        seen.append(float(g.item()))
+        return g * 10
+
+    x.register_hook(hook)
+    y.backward()
+    assert seen == [2.0]
+    np.testing.assert_allclose(x.grad.item(), 20.0)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.item(), 4.0)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.item(), 8.0)
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.item(), 6.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.item(), 2.0)
+
+
+def test_functional_vjp_jvp():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(3.0)
+    out, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(g.item(), 6.0)
+    out, t = paddle.autograd.jvp(f, x)
+    np.testing.assert_allclose(t.item(), 6.0)
+
+
+def test_jacobian_hessian():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor([1.0, 2.0])
+    jac = paddle.autograd.jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0])
+    hes = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(), np.eye(2) * 2, atol=1e-6)
+
+
+def test_backward_non_scalar_with_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])

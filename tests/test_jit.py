@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep, to_static
+
+
+def test_to_static_matches_eager():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    x = paddle.randn([3, 4])
+    eager = m(x).numpy()
+    traced = to_static(m)
+    static = traced(x).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function():
+    @to_static
+    def f(a, b):
+        return a * b + a
+
+    a = paddle.to_tensor([2.0])
+    b = paddle.to_tensor([3.0])
+    np.testing.assert_allclose(f(a, b).numpy(), [8.0])
+
+
+def test_to_static_threads_bn_buffers():
+    m = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2))
+    traced = to_static(m)
+    bn = m[1]
+    before = bn._mean.numpy().copy()
+    m.train()
+    traced(paddle.randn([4, 1, 5, 5]))
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+
+
+def test_train_step_descends_and_matches_eager():
+    def build():
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=m.parameters())
+        return m, opt
+
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = (X.sum(-1, keepdims=True) * 0.5).astype(np.float32)
+    loss_fn = nn.MSELoss()
+
+    # eager training
+    m1, opt1 = build()
+    eager_losses = []
+    for _ in range(10):
+        loss = loss_fn(m1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+        eager_losses.append(float(loss.item()))
+
+    # compiled whole-step training
+    m2, opt2 = build()
+    step = TrainStep(m2, loss_fn, opt2)
+    jit_losses = []
+    for _ in range(10):
+        jit_losses.append(float(step(paddle.to_tensor(X),
+                                     paddle.to_tensor(Y)).item()))
+
+    assert jit_losses[-1] < jit_losses[0] * 0.9
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=2e-3,
+                               atol=1e-5)
+
+
+def test_train_step_updates_params_in_layer():
+    m = nn.Linear(2, 1)
+    w0 = m.weight.numpy().copy()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    step = TrainStep(m, nn.MSELoss(), opt)
+    step(paddle.randn([4, 2]), paddle.randn([4, 1]))
+    assert not np.allclose(m.weight.numpy(), w0)
+
+
+def test_jit_save_load(tmp_path):
+    m = nn.Linear(3, 2)
+    path = str(tmp_path / "model")
+    paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 3])])
+    payload = paddle.jit.load(path)
+    assert "state_dict" in payload
+    assert "stablehlo" in payload
+    assert "weight" in payload["state_dict"]

@@ -1,0 +1,157 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_forward_shape():
+    layer = nn.Linear(4, 7)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == [2, 7]
+    np.testing.assert_allclose(
+        out.numpy(),
+        x.numpy() @ layer.weight.numpy() + layer.bias.numpy(), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_parameter_registration():
+    layer = nn.Linear(3, 3)
+    names = [n for n, _ in layer.named_parameters()]
+    assert names == ["weight", "bias"]
+    assert all(not p.stop_gradient for p in layer.parameters())
+
+
+def test_sequential_and_sublayers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(list(m.named_parameters())) == 4
+    out = m(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_batchnorm_running_stats_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([8, 3, 4, 4]) * 3.0 + 1.0
+    bn.train()
+    out = bn(x)
+    assert abs(out.numpy().mean()) < 0.1
+    m_after = bn._mean.numpy().copy()
+    assert not np.allclose(m_after, 0)
+    bn.eval()
+    out_eval = bn(x)
+    # eval uses running stats, not batch stats
+    assert abs(out_eval.numpy().mean()) > 1e-4
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    assert (y.numpy() == 0).mean() > 0.3
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[0, 3], [5, 0]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+
+def test_conv_bn_relu_stack():
+    m = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2, 2))
+    out = m(paddle.randn([2, 3, 8, 8]))
+    assert out.shape == [2, 8, 4, 4]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0), 2)
+    out = enc(paddle.randn([2, 5, 16]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm_shapes():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 6, 8])  # [B, T, I]
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 6, 16]
+    assert h.shape == [2, 4, 16]
+    assert c.shape == [2, 4, 16]
+
+
+def test_bidirectional_gru():
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out, h = gru(paddle.randn([2, 5, 8]))
+    assert out.shape == [2, 5, 32]
+
+
+def test_grad_flows_through_layer():
+    layer = nn.Linear(4, 2)
+    x = paddle.randn([3, 4])
+    loss = layer(x).sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [4, 2]
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    layer.register_forward_pre_hook(lambda l, i: calls.append("pre"))
+    layer.register_forward_post_hook(lambda l, i, o: calls.append("post"))
+    layer(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_apply_and_to_dtype():
+    m = nn.Linear(3, 3)
+    m.to(dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16
+    m.to(dtype="float32")
+    assert m.weight.dtype == paddle.float32
+
+
+def test_clip_grad_by_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+
+    p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    g = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    clip = ClipGradByGlobalNorm(1.0)
+    (p2, g2), = clip([(p, g)])
+    np.testing.assert_allclose(np.linalg.norm(g2.numpy()), 1.0, rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    w = I.XavierUniform()([100, 100], "float32")
+    assert abs(np.asarray(w).mean()) < 0.01
+    k = I.KaimingNormal()([64, 64], "float32")
+    assert 0.1 < np.asarray(k).std() < 0.3
+    c = I.Constant(3.0)([5], "float32")
+    np.testing.assert_allclose(np.asarray(c), 3.0)
+    o = I.Orthogonal()([8, 8], "float32")
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(8),
+                               atol=1e-4)

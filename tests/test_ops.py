@@ -1,0 +1,334 @@
+"""Numpy-referenced op tests (the OpTest pattern,
+reference: test/legacy_test/op_test.py:418)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32),
+                            stop_gradient=sg)
+
+
+class TestElementwise:
+    def test_binary_broadcast(self):
+        a = np.random.randn(3, 1, 4).astype(np.float32)
+        b = np.random.randn(2, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(),
+                                   np.maximum(a, b))
+
+    def test_unary_suite(self):
+        x = np.random.rand(10).astype(np.float32) * 0.8 + 0.1
+        for name, ref in [("exp", np.exp), ("log", np.log),
+                          ("sqrt", np.sqrt), ("tanh", np.tanh),
+                          ("floor", np.floor), ("ceil", np.ceil),
+                          ("abs", np.abs), ("square", np.square)]:
+            got = getattr(paddle, name)(t(x)).numpy()
+            np.testing.assert_allclose(got, ref(x), rtol=1e-3, atol=1e-5,
+                                       err_msg=name)
+
+    def test_scale_clip(self):
+        x = np.array([-2.0, 0.5, 3.0], dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.scale(t(x), scale=2.0, bias=1.0).numpy(), x * 2 + 1)
+        np.testing.assert_allclose(paddle.clip(t(x), -1, 1).numpy(),
+                                   np.clip(x, -1, 1))
+
+    def test_where(self):
+        c = np.array([True, False])
+        np.testing.assert_allclose(
+            paddle.where(paddle.to_tensor(c), t([1.0, 2.0]),
+                         t([3.0, 4.0])).numpy(), [1.0, 4.0])
+
+
+class TestReductions:
+    def test_reductions(self):
+        x = np.random.randn(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(x), axis=1).numpy(),
+                                   x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(t(x), axis=[0, 2], keepdim=True).numpy(),
+            x.mean((0, 2), keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(x), axis=-1).numpy(),
+                                   x.max(-1))
+        assert paddle.argmax(t(x), axis=1).numpy().tolist() == \
+            x.argmax(1).tolist()
+
+    def test_cumsum_logsumexp(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(x), axis=0).numpy(),
+                                   x.cumsum(0), rtol=1e-5)
+        from scipy.special import logsumexp as slse
+        np.testing.assert_allclose(paddle.logsumexp(t(x), axis=1).numpy(),
+                                   slse(x, axis=1), rtol=1e-4)
+
+    def test_var_std(self):
+        x = np.random.randn(10).astype(np.float32)
+        np.testing.assert_allclose(paddle.var(t(x)).numpy(), x.var(ddof=1),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.std(t(x), unbiased=False).numpy(), x.std(), rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_family(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert paddle.reshape(t(x), [4, 6]).shape == [4, 6]
+        assert paddle.flatten(t(x), 1).shape == [2, 12]
+        assert paddle.squeeze(t(x[None]), 0).shape == [2, 3, 4]
+        assert paddle.unsqueeze(t(x), [0, 2]).shape == [1, 2, 1, 3, 4]
+
+    def test_concat_stack_split(self):
+        a, b = np.ones((2, 3), np.float32), np.zeros((2, 3), np.float32)
+        np.testing.assert_allclose(
+            paddle.concat([t(a), t(b)], axis=0).numpy(),
+            np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([t(a), t(b)], -1).numpy(),
+                                   np.stack([a, b], -1))
+        parts = paddle.split(t(np.arange(10, dtype=np.float32)), [3, -1])
+        assert parts[0].shape == [3] and parts[1].shape == [7]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        np.testing.assert_allclose(
+            paddle.gather(t(x), paddle.to_tensor(idx)).numpy(), x[idx])
+        np.testing.assert_allclose(
+            paddle.index_select(t(x), paddle.to_tensor(idx), axis=1).numpy(),
+            x[:, idx])
+        got = paddle.scatter(t(x), paddle.to_tensor(np.array([1])),
+                             t(np.full((1, 3), 9.0))).numpy()
+        ref = x.copy()
+        ref[1] = 9
+        np.testing.assert_allclose(got, ref)
+
+    def test_topk_sort(self):
+        x = np.random.randn(5, 6).astype(np.float32)
+        vals, idx = paddle.topk(t(x), 3, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t(x), axis=0).numpy(),
+                                   np.sort(x, axis=0))
+
+    def test_pad(self):
+        x = np.ones((1, 2, 3, 3), np.float32)
+        out = paddle.ops.pad(t(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 5]
+
+    def test_tile_expand(self):
+        x = np.array([[1.0, 2.0]], dtype=np.float32)
+        assert paddle.tile(t(x), [2, 3]).shape == [2, 6]
+        assert paddle.expand(t(x), [4, 2]).shape == [4, 2]
+        assert paddle.broadcast_to(t(x), [5, 2]).shape == [5, 2]
+
+    def test_take_put_along_axis(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        idx = np.array([[0, 1], [2, 0], [1, 3]])
+        np.testing.assert_allclose(
+            paddle.take_along_axis(t(x), paddle.to_tensor(idx), 1).numpy(),
+            np.take_along_axis(x, idx, 1))
+
+    def test_one_hot_unique(self):
+        oh = paddle.one_hot(paddle.to_tensor(np.array([0, 2])), 3).numpy()
+        np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 3, 2]))).numpy()
+        assert u.tolist() == [1, 2, 3]
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)),
+                          transpose_y=True).numpy(), a @ b, rtol=1e-5)
+
+    def test_solve_inverse_det(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(paddle.inverse(t(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(paddle.det(t(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-3)
+
+    def test_norm(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.ops.norm(t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.ops.norm(t(x), p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+
+    def test_einsum_free(self):
+        a = np.random.randn(5, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.ops.trace(t(a)).numpy(),
+                                   np.trace(a), rtol=1e-5)
+
+
+class TestNNOps:
+    def test_softmax_logsoftmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        sm = paddle.softmax(t(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.ops.log_softmax(t(x)).numpy(),
+                                   np.log(sm), rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_vs_naive(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+        out = paddle.ops.conv2d(t(x), t(w), stride=1, padding=1).numpy()
+        assert out.shape == (2, 5, 8, 8)
+        # check one output position against the direct sum
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        ref = np.einsum("cij,ocij->o", xp[0, :, 3:6, 3:6], w)
+        np.testing.assert_allclose(out[0, :, 3, 3], ref, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_conv_groups(self):
+        x = np.random.randn(1, 4, 6, 6).astype(np.float32)
+        w = np.random.randn(8, 2, 3, 3).astype(np.float32)
+        out = paddle.ops.conv2d(t(x), t(w), padding=1, groups=2)
+        assert out.shape == [1, 8, 6, 6]
+
+    def test_pools(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        mp = paddle.ops.max_pool2d(t(x), 2, 2).numpy()
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(mp, ref)
+        ap = paddle.ops.avg_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap, x.reshape(1, 2, 2, 2, 2, 2).mean(
+            (3, 5)), rtol=1e-6)
+        aap = paddle.ops.adaptive_avg_pool2d(t(x), 1).numpy()
+        np.testing.assert_allclose(aap[..., 0, 0], x.mean((2, 3)), rtol=1e-6)
+
+    def test_batch_norm_training_stats(self):
+        x = np.random.randn(8, 3, 4, 4).astype(np.float32)
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        out, m, v = paddle.ops.batch_norm(t(x), t(rm), t(rv),
+                                          training=True)
+        np.testing.assert_allclose(m.numpy(), x.mean((0, 2, 3)), rtol=1e-4,
+                                    atol=1e-5)
+        np.testing.assert_allclose(out.numpy().mean((0, 2, 3)),
+                                   np.zeros(3), atol=1e-5)
+
+    def test_layer_norm(self):
+        x = np.random.randn(2, 5).astype(np.float32)
+        out = paddle.ops.layer_norm(t(x)).numpy()
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        w = np.random.randn(8).astype(np.float32)
+        out = paddle.ops.rms_norm(t(x), t(w)).numpy()
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 7).astype(np.float32)
+        labels = np.array([0, 3, 6, 2])
+        loss = paddle.ops.cross_entropy(t(logits),
+                                        paddle.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 7).astype(np.float32)
+        labels = np.array([0, -100, 6, -100])
+        loss = paddle.ops.cross_entropy(t(logits),
+                                        paddle.to_tensor(labels),
+                                        ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 6]]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-4)
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        idx = np.array([[1, 3], [0, 9]])
+        out = paddle.ops.embedding(paddle.to_tensor(idx), t(w)).numpy()
+        np.testing.assert_allclose(out, w[idx])
+
+    def test_dropout_eval_and_scale(self):
+        x = np.ones((100, 100), np.float32)
+        out_eval = paddle.ops.dropout(t(x), p=0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), x)
+        out = paddle.ops.dropout(t(x), p=0.5, training=True).numpy()
+        assert abs(out.mean() - 1.0) < 0.05  # upscale_in_train keeps E[x]
+        assert (out == 0).mean() > 0.4
+
+    def test_attention_causal(self):
+        q = np.random.randn(2, 6, 2, 8).astype(np.float32)
+        out = paddle.ops.scaled_dot_product_attention(
+            t(q), t(q), t(q), is_causal=True)
+        assert out.shape == [2, 6, 2, 8]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestGradThroughOps:
+    def test_conv_grad_shape(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 5, 5).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.random.randn(3, 2, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        out = paddle.ops.conv2d(x, w, padding=1)
+        out.sum().backward()
+        assert x.grad.shape == x.shape
+        assert w.grad.shape == w.shape
+
+    def test_softmax_ce_grad_rowsum_zero(self):
+        logits = paddle.to_tensor(
+            np.random.randn(3, 5).astype(np.float32), stop_gradient=False)
+        loss = paddle.ops.cross_entropy(
+            logits, paddle.to_tensor(np.array([1, 2, 3])))
+        loss.backward()
+        np.testing.assert_allclose(logits.grad.numpy().sum(-1),
+                                   np.zeros(3), atol=1e-6)
+
+    def test_gather_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32),
+                             stop_gradient=False)
+        out = paddle.gather(x, paddle.to_tensor(np.array([1, 1, 4])))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 2, 0, 0, 1, 0])
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert u.min() >= 2.0 and u.max() <= 3.0
+        r = paddle.randint(0, 5, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+
+def test_yaml_registry_complete():
+    """Every yaml op must resolve and be callable; registry is authoritative."""
+    from paddle_tpu.ops.registry import API, OPS
+    assert len(OPS) > 200
+    for name in OPS:
+        assert callable(API[name])

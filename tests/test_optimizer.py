@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_steps(opt_cls, steps=150, lr=0.1, **kw):
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                         stop_gradient=False)
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (optimizer.SGD, {}),
+    (optimizer.Momentum, {"momentum": 0.9}),
+    (optimizer.Adam, {}),
+    (optimizer.AdamW, {"weight_decay": 0.01}),
+    (optimizer.RMSProp, {}),
+    (optimizer.Adagrad, {"lr": 1.0}),
+    (optimizer.Adamax, {}),
+    (optimizer.Lamb, {}),
+    (optimizer.NAdam, {}),
+    (optimizer.RAdam, {}),
+])
+def test_optimizers_converge_on_quadratic(opt_cls, kw):
+    final = _quadratic_steps(opt_cls, **kw)
+    assert final < 1.0, f"{opt_cls.__name__} did not descend: {final}"
+
+
+def test_sgd_exact_update():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=0.5, parameters=[w])
+    (w * 3.0).backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.5 * 3.0])
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = optimizer.AdamW(learning_rate=0.0, parameters=[w],
+                          weight_decay=0.1)
+    (w * 1.0).backward()
+    opt.step()
+    # lr=0 -> decoupled decay term also 0 (paddle semantics: lr*coeff*p)
+    np.testing.assert_allclose(w.numpy(), [1.0])
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    opt = optimizer.Adam(parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(parameters=[w])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(w)]["moment1"]),
+        np.asarray(opt._slots[id(w)]["moment1"]))
+
+
+def test_grad_clip_in_optimizer():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w],
+                        grad_clip=nn.ClipGradByGlobalNorm(0.1))
+    (w * 100.0).backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1], rtol=1e-5)
+
+
+def test_lr_scheduler_basic():
+    sched = optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched)
+    lrs = []
+    for _ in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+
+def test_cosine_and_warmup():
+    cos = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+    for _ in range(10):
+        cos.step()
+    assert cos() < 1e-6
+    warm = optimizer.lr.LinearWarmup(1.0, warmup_steps=10, start_lr=0.0,
+                                     end_lr=1.0)
+    warm.step(5)
+    assert abs(warm() - 0.5) < 1e-6
+
+
+def test_reduce_on_plateau():
+    s = optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    for loss in [1.0, 1.0, 1.0, 1.0]:
+        s.step(loss)
+    assert s() == 0.5
+
+
+def test_minimize_api():
+    w = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    loss = (w * w).sum()
+    opt.minimize(loss)
+    np.testing.assert_allclose(w.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-6)
+    assert w.grad is None

@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype.is_integer
+    t2 = t.astype("float32")
+    assert t2.dtype == paddle.float32
+    t3 = t2.astype(paddle.bfloat16)
+    assert t3.dtype == paddle.bfloat16
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((1.0 / a).numpy(), [1, 0.5])
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 5.0])
+    b = paddle.to_tensor([2.0, 2.0])
+    assert (a < b).numpy().tolist() == [True, False]
+    assert (a >= b).numpy().tolist() == [False, True]
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[0:2, 1].numpy(), [1, 5])
+    t[0, 0] = 99.0
+    assert t.numpy()[0, 0] == 99.0
+
+
+def test_item_and_len():
+    t = paddle.to_tensor(3.5)
+    assert abs(t.item() - 3.5) < 1e-6
+    t2 = paddle.to_tensor([1, 2, 3])
+    assert len(t2) == 3
+
+
+def test_methods_bound():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert abs(t.sum().item() - 10.0) < 1e-6
+    assert abs(t.mean().item() - 2.5) < 1e-6
+    assert t.reshape([4]).shape == [4]
+    assert t.T.shape == [2, 2]
+    np.testing.assert_allclose(t.T.numpy(), [[1, 3], [2, 4]])
+
+
+def test_inplace_variants():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2, 3])
+    t.scale_(2.0)
+    np.testing.assert_allclose(t.numpy(), [4, 6])
+
+
+def test_clone_detach():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient
+
+
+def test_set_value():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.set_value(np.array([5.0, 6.0]))
+    np.testing.assert_allclose(t.numpy(), [5, 6])
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float32")
+    assert paddle.get_default_dtype() == paddle.float32
+
+
+def test_zero_dim():
+    t = paddle.to_tensor(2.0)
+    assert t.ndim == 0
+    assert t.shape == []
+    out = t * 3
+    assert abs(out.item() - 6.0) < 1e-6
