@@ -7,3 +7,24 @@ DCN across slices); see SURVEY.md §5 "Distributed communication backend".
 from paddle_tpu.distributed.env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
+from paddle_tpu.distributed.mesh import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh, get_mesh,
+    init_mesh, set_mesh,
+)
+from paddle_tpu.distributed.api import (  # noqa: F401
+    dtensor_from_local, dtensor_to_local, reshard, shard_layer,
+    shard_optimizer, shard_tensor, unshard_dtensor,
+)
+from paddle_tpu.distributed.communication import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    barrier, broadcast, get_group, new_group, reduce, reduce_scatter,
+    scatter, stream,
+)
+from paddle_tpu.distributed.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+)
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.parallel_wrapper import DataParallel  # noqa: F401
+from paddle_tpu.distributed.engine import (  # noqa: F401
+    ParallelConfig, ParallelTrainStep, shard_model_parameters,
+)

@@ -1,0 +1,325 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/ (all_reduce.py,
+all_gather.py, all_to_all.py, reduce_scatter.py, send/recv, group.py:22)
+over ProcessGroupNCCL (paddle/fluid/distributed/collective/).
+
+TPU-native: collectives are XLA ops, not eager NCCL calls. Each Group is
+bound to a mesh axis name; inside a compiled SPMD region (shard_map/pjit)
+these functions lower to lax.psum / all_gather / all_to_all /
+ppermute riding ICI. Outside a traced region, collectives on DistTensors
+are placement transitions (reshard); on plain tensors with a size-1 group
+they are identity — matching how the reference degrades on world_size=1.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group",
+           "all_reduce", "all_gather", "all_gather_object", "reduce",
+           "reduce_scatter", "all_to_all", "broadcast", "scatter", "barrier",
+           "send", "recv", "isend", "irecv", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: a set of ranks bound to a mesh axis name."""
+
+    _next_gid = 0
+
+    def __init__(self, ranks: Sequence[int], axis_name: Optional[str] = None,
+                 mesh=None):
+        self.ranks = list(ranks)
+        self.axis_name = axis_name or f"group{Group._next_gid}"
+        self.id = Group._next_gid
+        Group._next_gid += 1
+        self.mesh = mesh
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def rank(self):
+        try:
+            return int(lax.axis_index(self.axis_name))
+        except Exception:
+            return 0
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, ranks={self.ranks})"
+
+
+_groups: dict = {}
+_default_group: Optional[Group] = None
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None,
+              mesh=None) -> Group:
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    g = Group(ranks, axis_name=axis_name, mesh=mesh)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    global _default_group
+    if gid == 0:
+        if _default_group is None:
+            _default_group = new_group(axis_name="world")
+        return _default_group
+    return _groups.get(gid)
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_like(x, data):
+    if isinstance(x, Tensor):
+        out = Tensor._from_data(data, stop_gradient=x.stop_gradient)
+        return out
+    return data
+
+
+def _in_spmd(axis_name: str) -> bool:
+    """True when the axis is bound, i.e. we're inside shard_map/pmap trace."""
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _axis(group: Optional[Group]) -> str:
+    g = group or get_group(0)
+    return g.axis_name
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        d = _data(tensor)
+        if op in (ReduceOp.SUM, "sum"):
+            out = lax.psum(d, ax)
+        elif op in (ReduceOp.MAX, "max"):
+            out = lax.pmax(d, ax)
+        elif op in (ReduceOp.MIN, "min"):
+            out = lax.pmin(d, ax)
+        elif op == ReduceOp.AVG:
+            out = lax.pmean(d, ax)
+        else:  # prod
+            out = jnp.exp(lax.psum(jnp.log(d), ax))
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    # outside SPMD: DistTensor partial -> materialize; else identity (n=1)
+    if isinstance(tensor, Tensor) and tensor.is_dist():
+        from paddle_tpu.distributed.api import reshard
+        from paddle_tpu.distributed.mesh import Replicate
+        mesh = tensor._process_mesh
+        out = reshard(tensor, mesh, [Replicate()] * mesh.ndim)
+        tensor._data = out._data
+        tensor._placements = out._placements
+        return tensor
+    if g.nranks > 1:
+        raise RuntimeError(
+            "eager all_reduce across a multi-rank group requires an SPMD "
+            "context (shard_map/to_static) on TPU; wrap the step or use "
+            "DataParallel/TrainStep which insert the reduction")
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        d = _data(tensor)
+        gathered = lax.all_gather(d, ax)  # [n, ...]
+        if isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(_wrap_like(tensor, gathered[i]))
+            return tensor_list
+        return _wrap_like(tensor, gathered)
+    if g.nranks == 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    raise RuntimeError("eager all_gather requires an SPMD context on TPU")
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        if isinstance(tensor_list, (list, tuple)):
+            stacked = jnp.stack([_data(t) for t in tensor_list])
+        else:
+            stacked = _data(tensor_list if tensor_list is not None
+                            else tensor)
+        # psum then take own chunk == reduce-scatter (XLA fuses this)
+        summed = lax.psum(stacked, ax)
+        idx = lax.axis_index(ax)
+        out = summed[idx] if summed.shape[0] == g.nranks else \
+            lax.dynamic_slice_in_dim(summed, idx * (summed.shape[0] //
+                                                    g.nranks),
+                                     summed.shape[0] // g.nranks, 0)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if g.nranks == 1:
+        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) \
+            else (tensor_list if tensor_list is not None else tensor)
+        if isinstance(tensor, Tensor):
+            tensor._data = _data(src)
+            return tensor
+        return src
+    raise RuntimeError("eager reduce_scatter requires an SPMD context")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        if isinstance(in_tensor_list, (list, tuple)):
+            stacked = jnp.stack([_data(t) for t in in_tensor_list])
+        else:
+            stacked = _data(in_tensor_list)
+        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                             tiled=False)
+        if isinstance(out_tensor_list, list):
+            for i in range(g.nranks):
+                out_tensor_list.append(_wrap_like(
+                    in_tensor_list[0] if isinstance(in_tensor_list,
+                                                    (list, tuple))
+                    else in_tensor_list, out[i]))
+            return out_tensor_list
+        return out
+    if g.nranks == 1:
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(in_tensor_list)
+            return out_tensor_list
+        return in_tensor_list
+    raise RuntimeError("eager all_to_all requires an SPMD context")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        d = _data(tensor)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        # select src's value on every rank: mask + psum
+        idx = lax.axis_index(ax)
+        masked = jnp.where(idx == src_local, d, jnp.zeros_like(d))
+        out = lax.psum(masked, ax)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or get_group(0)
+    ax = g.axis_name
+    if _in_spmd(ax):
+        stacked = jnp.stack([_data(t) for t in tensor_list]) \
+            if isinstance(tensor_list, (list, tuple)) else _data(tensor_list)
+        stacked = broadcast(stacked, src=src, group=g)
+        idx = lax.axis_index(ax)
+        out = stacked[idx]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if g.nranks == 1:
+        src_t = tensor_list[0] if tensor_list else tensor
+        if isinstance(tensor, Tensor):
+            tensor._data = _data(src_t)
+            return tensor
+        return src_t
+    raise RuntimeError("eager scatter requires an SPMD context")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — inside SPMD this is half of a ppermute; we implement
+    send/recv pairs via shift_right/shift_left helpers (see
+    distributed/fleet/pp.py); a bare send outside a schedule is invalid in
+    the compiled model."""
+    raise RuntimeError(
+        "bare send/recv are not expressible in compiled SPMD; use "
+        "p2p helpers (paddle_tpu.distributed.fleet.pp) or batch_isend_irecv")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "bare send/recv are not expressible in compiled SPMD; use "
+        "p2p helpers (paddle_tpu.distributed.fleet.pp) or batch_isend_irecv")
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+# ---- ppermute-based shift helpers (the TPU p2p idiom) ----------------------
+def shift(x, group: Group, offset: int = 1):
+    """Rotate values around the group ring by ``offset`` (SPMD context).
+    This is the collective_permute that replaces NCCL send/recv for
+    pipeline/ring algorithms."""
+    ax = group.axis_name
+    n = group.nranks
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(_data(x), ax, perm)
+
+
+class stream:
+    """paddle.distributed.stream.* parity — on TPU there are no user-visible
+    streams; these forward to the plain collectives."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    all_to_all = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)

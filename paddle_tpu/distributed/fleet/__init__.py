@@ -1,0 +1,10 @@
+"""Fleet: hybrid-parallel training facade (reference:
+python/paddle/distributed/fleet/)."""
+from paddle_tpu.distributed.fleet.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, mark_placements, sharding_constraint,
+)
+from paddle_tpu.distributed.fleet.facade import (  # noqa: F401
+    DistributedStrategy, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, init,
+)

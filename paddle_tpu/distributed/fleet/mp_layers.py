@@ -1,0 +1,165 @@
+"""Tensor-parallel layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:47, ColumnParallelLinear:334, RowParallelLinear:541,
+ParallelCrossEntropy:742.
+
+TPU-native design: weights are GLOBAL-view tensors annotated with mesh
+placements (Shard over the "mp" axis). Under the parallel train step
+(distributed/engine.py) XLA's GSPMD partitioner inserts exactly the
+collectives the reference codes by hand: identity-fwd/allreduce-bwd before a
+column split (_c_identity), allreduce-fwd after a row split (_mp_allreduce),
+allgather for gather_output (_c_concat). The layers also place
+``with_sharding_constraint`` on activations so sequence-parallel layouts
+(Megatron SP) hold between layers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from paddle_tpu import ops
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import Replicate, Shard
+from paddle_tpu.nn import initializer as init
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "mark_placements",
+           "sharding_constraint"]
+
+
+def mark_placements(param, *placements_by_axis, mesh=None, **named):
+    """Attach placement metadata: ``mark_placements(w, mp=Shard(1))`` —
+    unnamed mesh axes default to Replicate. The engine materializes these
+    into NamedShardings at parallelize() time."""
+    param._placement_hints = dict(named)
+    if mesh is not None:
+        param._process_mesh = mesh
+    return param
+
+
+def sharding_constraint(x, spec: dict):
+    """Annotate an activation with a per-tensor-dim axis mapping, e.g.
+    ``{0: "dp", 1: "mp"}``. Under jit this becomes
+    lax.with_sharding_constraint against the ambient mesh; eager it is a
+    no-op (single device)."""
+    from paddle_tpu.distributed.engine import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    ndim = x.ndim if not isinstance(x, Tensor) else x._data.ndim
+    pspec = [None] * ndim
+    for d, ax in spec.items():
+        if ax in mesh.dim_names:
+            pspec[d] = ax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh.jax_mesh(), PartitionSpec(*pspec))
+    data = x._data if isinstance(x, Tensor) else x
+    try:
+        out = jax.lax.with_sharding_constraint(data, sh)
+    except Exception:
+        return x
+    if isinstance(x, Tensor):
+        t = Tensor._from_data(out, stop_gradient=x.stop_gradient)
+        t._grad_node = x._grad_node
+        t._output_index = x._output_index
+        return t
+    return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp (reference
+    mp_layers.py:47). GSPMD turns the masked-lookup+allreduce the reference
+    writes manually into a sharded gather."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        mark_placements(self.weight, mp=Shard(0))
+
+    def forward(self, x):
+        return ops.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over mp (reference
+    mp_layers.py:334). ``gather_output=True`` forces a replicated output
+    (XLA inserts the all-gather)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        mark_placements(self.weight, mp=Shard(1))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            mark_placements(self.bias, mp=Shard(0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = ops.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = sharding_constraint(out, {out.ndim - 1: None})
+        else:
+            out = sharding_constraint(out, {out.ndim - 1: "mp"})
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over mp (reference mp_layers.py:541);
+    the partial-sum allreduce after the local matmul is inserted by GSPMD
+    when the output constraint drops the mp axis."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        mark_placements(self.weight, mp=Shard(0))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = sharding_constraint(x, {x.ndim - 1: "mp"})
+        out = ops.linear(x, self.weight, self.bias)
+        return sharding_constraint(out, {out.ndim - 1: None})
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (reference mp_layers.py:742).
+    The reference shards the softmax by hand (shard_index + masked max +
+    allreduce); with a vocab-sharded logits array GSPMD partitions the
+    standard log-softmax reduction the same way."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        input = sharding_constraint(input, {input.ndim - 1: "mp"})
+        return ops.softmax_with_cross_entropy(
+            input, label, ignore_index=self.ignore_index)

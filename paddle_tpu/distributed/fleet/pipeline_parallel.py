@@ -1,0 +1,255 @@
+"""Pipeline parallelism.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py (PipelineParallel:149, forward_backward_pipeline:459,
+train_batch:693) + parallel_layers/pp_layers.py (LayerDesc:56,
+PipelineLayer:257) + P2P via batched isend/irecv
+(pp_utils/p2p_communication.py:559).
+
+TPU-native design: there is no eager send/recv on ICI — pipeline P2P is
+``lax.ppermute`` (collective permute) inside ONE compiled SPMD program.
+The pipeline body must be stage-homogeneous (the practical case:
+N identical transformer blocks); its per-layer parameters are stacked on a
+leading axis and sharded over the ``pp`` mesh axis, so each pp rank holds
+L/S layers. The schedule is the classic rotation: T = M + S - 1 ticks, each
+tick every stage applies its layers to its current activation and permutes
+it one stage to the right while stage 0 injects the next microbatch.
+``jax.grad`` differentiates straight through (ppermute transposes to the
+reverse ring), giving the backward pipeline for free; remat on the stage
+body keeps activation memory at GPipe levels. Embedding/head run replicated
+across pp ranks (their FLOPs are negligible next to the body).
+
+The eager-style wrapper (PipelineParallel.train_batch) matches the
+reference's API; under the hood it builds one compiled step.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trace import functionalize
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "pipeline_forward"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer across stages (reference pp_layers.py:76) —
+    e.g. tied embeddings. In the replicated-embed TPU design the embedding
+    lives outside the pipeline body, so sharing is just reusing the module.
+    """
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Stage-partitioned model container (reference pp_layers.py:257).
+
+    layers = [pre...(embedding), N x identical LayerDesc (body), post...
+    (norm/head)]. The body segment must be homogeneous; pre/post run
+    replicated on every pp rank.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, **kw):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self.num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self.recompute_interval = recompute_interval
+
+        built = []
+        descs = []
+        for item in layers:
+            if isinstance(item, LayerDesc):
+                descs.append(item)
+                built.append(None)
+            else:
+                descs.append(None)
+                built.append(item)
+
+        # find the longest homogeneous run of LayerDescs = pipeline body
+        best = (0, 0)
+        i = 0
+        while i < len(descs):
+            if descs[i] is None:
+                i += 1
+                continue
+            j = i
+            while (j < len(descs) and descs[j] is not None
+                   and descs[j].layer_func is descs[i].layer_func
+                   and descs[j].inputs == descs[i].inputs
+                   and descs[j].kwargs == descs[i].kwargs):
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        self._body_range = best
+        b0, b1 = best
+        self.n_body_layers = b1 - b0
+        if self.num_stages > 1:
+            if self.n_body_layers == 0:
+                raise ValueError(
+                    "PipelineLayer needs a homogeneous run of LayerDescs "
+                    "to form the pipeline body")
+            if self.n_body_layers % self.num_stages != 0:
+                raise ValueError(
+                    f"body layers ({self.n_body_layers}) must divide "
+                    f"evenly into {self.num_stages} stages")
+
+        from paddle_tpu.nn.layer import LayerList, Sequential
+
+        self.pre_layers = LayerList(
+            [built[k] if built[k] is not None else descs[k].build_layer()
+             for k in range(0, b0)])
+        self.body_layers = LayerList(
+            [descs[k].build_layer() for k in range(b0, b1)])
+        self.post_layers = LayerList(
+            [built[k] if built[k] is not None else descs[k].build_layer()
+             for k in range(b1, len(descs))])
+
+    # eager forward: plain sequential execution (single-device semantics)
+    def forward(self, x):
+        for l in self.pre_layers:
+            x = l(x)
+        for l in self.body_layers:
+            x = l(x)
+        for l in self.post_layers:
+            x = l(x)
+        return x
+
+    def get_loss_fn(self):
+        return self._loss_fn
+
+
+def pipeline_forward(stage_apply: Callable, stacked_params, x_mbs,
+                     n_stages: int, pp_axis: str = "pp"):
+    """The rotation schedule, to be called INSIDE a shard_map manual over
+    ``pp_axis``.
+
+    stage_apply(local_params, h, mb_index_hint) applies this rank's L/S
+    layers. stacked_params: pytree with leading local layer axis.
+    x_mbs: [M, mb, ...] microbatched input activations (replicated over pp).
+    Returns [M, mb, ...] outputs of the last stage, replicated over pp.
+    """
+    M = x_mbs.shape[0]
+    S = n_stages
+    T = M + S - 1
+    idx = lax.axis_index(pp_axis)
+    buf = jnp.zeros_like(x_mbs[0])
+    outs = jnp.zeros_like(x_mbs)
+
+    def tick(carry, t):
+        buf, outs = carry
+        x_t = lax.dynamic_index_in_dim(x_mbs, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        inp = jnp.where(idx == 0, x_t, buf)
+        h = stage_apply(stacked_params, inp)
+        # last stage records microbatch t-(S-1)
+        om = jnp.clip(t - (S - 1), 0, M - 1)
+        take = jnp.logical_and(idx == S - 1, t >= S - 1)
+        cur = lax.dynamic_index_in_dim(outs, om, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(take, h, cur), om, 0)
+        if S > 1:
+            nxt = lax.ppermute(h, pp_axis,
+                               [(i, i + 1) for i in range(S - 1)])
+        else:
+            nxt = h
+        return (buf if S == 1 else nxt, outs), None
+
+    (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
+    # replicate last stage's outputs to every pp rank
+    outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+                    pp_axis)
+    return outs
+
+
+class PipelineParallel(Layer):
+    """train_batch-compatible wrapper (reference pipeline_parallel.py:149).
+
+    Builds one compiled hybrid step: pre (replicated) → pipelined body
+    (manual pp) → post + loss (replicated), backward + optimizer inside.
+    """
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.micro_batches = 1
+        if strategy is not None:
+            self.micro_batches = strategy.pipeline_configs.get(
+                "accumulate_steps", 1)
+        self._step = None
+        self._mesh = hcg.mesh
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if scaler is not None and scaler.is_enable():
+            raise NotImplementedError(
+                "loss scaling inside the compiled pipeline step is not "
+                "supported; train in bfloat16 (needs no scaling) or pass "
+                "GradScaler(enable=False)")
+        x, y = data
+        if self._step is None:
+            from paddle_tpu.distributed.fleet.pp_engine import (
+                PipelineTrainStep,
+            )
+
+            self._step = PipelineTrainStep(
+                self._layers, self._layers.get_loss_fn(), optimizer,
+                self._mesh, n_microbatches=max(self.micro_batches,
+                                               self.num_stages))
+        loss = self._step(x, y)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss and self._layers.get_loss_fn() is not None:
+            return self._layers.get_loss_fn()(out, y)
+        return out
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

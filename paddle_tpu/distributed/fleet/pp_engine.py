@@ -1,0 +1,282 @@
+"""Compiled pipeline-parallel train step.
+
+Builds ONE XLA program for: replicated pre (embedding) → ppermute-rotated
+pipeline body over the ``pp`` mesh axis → replicated post (norm/head) →
+loss → backward → optimizer. dp/mp axes remain GSPMD-auto inside, so
+TP×PP×DP hybrid comes out of a single jit (reference equivalent: the whole
+of meta_parallel/pipeline_parallel.py + p2p_communication.py + the
+interleaved schedules, SURVEY.md §2.3 PP row).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.engine import set_current_mesh
+from paddle_tpu.distributed.fleet.pipeline_parallel import pipeline_forward
+from paddle_tpu.distributed.mesh import ProcessMesh, Shard
+from paddle_tpu.jit.trace import functionalize
+
+__all__ = ["PipelineTrainStep"]
+
+
+def _functionalize_layerlist(layers):
+    """functionalize a LayerList as one sequential apply."""
+    from paddle_tpu.nn.layer import Sequential
+
+    seq = Sequential(*list(layers))
+    return functionalize(seq)
+
+
+class PipelineTrainStep:
+    def __init__(self, pipe_layer, loss_fn: Callable, optimizer,
+                 mesh: ProcessMesh, n_microbatches: int = None,
+                 pp_axis: str = "pp", dp_axis: str = "dp",
+                 remat_body: bool = True):
+        self._pipe = pipe_layer
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._mesh = mesh
+        self._pp_axis = pp_axis
+        self._dp_axis = dp_axis
+        self.S = mesh.get_dim_size(pp_axis) if pp_axis in mesh.dim_names \
+            else 1
+        self.M = n_microbatches or self.S
+        self._remat = remat_body
+
+        # ---- functionalize the three sections --------------------------
+        self._pre_apply, (_, self._pre_params), (_, self._pre_buffers) = \
+            _functionalize_layerlist(pipe_layer.pre_layers)
+        self._post_apply, (_, self._post_params), (_, self._post_buffers) = \
+            _functionalize_layerlist(pipe_layer.post_layers)
+
+        body = list(pipe_layer.body_layers)
+        self._body_template_apply, (_, tmpl_params), (_, tmpl_buf) = \
+            functionalize(body[0])
+        if tmpl_buf:
+            raise NotImplementedError(
+                "pipeline body layers with buffers (e.g. BatchNorm) are "
+                "not supported; use LayerNorm/RMSNorm in the body")
+        # stack each param position across body layers: [L, ...]
+        per_layer: List[List] = []
+        for layer in body:
+            _, (_, ps), _ = functionalize(layer)
+            per_layer.append(ps)
+        self._body_layer_params = per_layer  # Tensor refs, [L][n_leaves]
+        self._n_leaves = len(tmpl_params)
+        self._body_hints = [getattr(p, "_placement_hints", None) or {}
+                            for p in tmpl_params]
+        stacked = [jnp.stack([per_layer[l][i]._data
+                              for l in range(len(body))])
+                   for i in range(self._n_leaves)]
+        self._stacked_body = stacked
+
+        from paddle_tpu.distributed.engine import _pspec_from_hints
+
+        jmesh = mesh.jax_mesh()
+        self._repl = NamedSharding(jmesh, PartitionSpec())
+
+        self._pre_sh = [NamedSharding(jmesh, _pspec_from_hints(p, mesh))
+                        for p in self._pre_params]
+        self._post_sh = [NamedSharding(jmesh, _pspec_from_hints(p, mesh))
+                         for p in self._post_params]
+        self._body_sh = [
+            NamedSharding(jmesh, _pspec_from_hints(
+                tmpl_params[i], mesh, offset=1,
+                lead=pp_axis if self.S > 1 else None))
+            for i in range(self._n_leaves)]
+        # place params on mesh
+        for p, sh in zip(self._pre_params, self._pre_sh):
+            p._data = jax.device_put(p._data, sh)
+        for p, sh in zip(self._post_params, self._post_sh):
+            p._data = jax.device_put(p._data, sh)
+        self._stacked_body = [jax.device_put(s, sh)
+                              for s, sh in zip(stacked, self._body_sh)]
+
+        # optimizer slots: pre/post per param; body per stacked leaf
+        if optimizer._parameter_list is None:
+            optimizer._parameter_list = list(self._pre_params) + \
+                list(self._post_params)
+        self._pre_slots = [optimizer._init_slots(p._data)
+                           for p in self._pre_params]
+        self._post_slots = [optimizer._init_slots(p._data)
+                            for p in self._post_params]
+        self._body_slots = [
+            {k: jax.device_put(v, sh) for k, v in
+             optimizer._init_slots(s).items()}
+            for s, sh in zip(self._stacked_body, self._body_sh)]
+
+        self._jitted = None
+
+    # ------------------------------------------------------------------
+    def _make_step_fn(self):
+        mesh = self._mesh
+        jmesh = mesh.jax_mesh()
+        S, M = self.S, self.M
+        pp_axis = self._pp_axis
+        body_apply = self._body_template_apply
+        pre_apply = self._pre_apply
+        post_apply = self._post_apply
+        loss_fn = self._loss_fn
+        opt = self._opt
+        remat = self._remat
+
+        def body_block(params_leaves, h, key):
+            def layer_step(hh, leaves):
+                out, _ = body_apply(list(leaves), [], key, hh)
+                return out, None
+
+            step = jax.checkpoint(layer_step) if remat else layer_step
+            h, _ = lax.scan(step, h, tuple(params_leaves))
+            return h
+
+        def step_fn(pre_p, body_p, post_p, pre_s, body_s, post_s,
+                    pre_b, post_b, step, lr, key, x, y):
+            set_current_mesh(mesh)
+
+            def loss_of(diff):
+                pre_pd, body_pd, post_pd = diff
+                k1, k2, k3 = jax.random.split(key, 3)
+                h, new_pre_b = pre_apply(pre_pd, pre_b, k1, x)
+                # microbatch: [B, ...] -> [M, B/M, ...]
+                B = h.shape[0]
+                h_mbs = h.reshape((M, B // M) + h.shape[1:])
+
+                if S > 1:
+                    def spmd_body(body_leaves, mbs):
+                        return pipeline_forward(
+                            lambda lp, hh: body_block(lp, hh, k2),
+                            body_leaves, mbs, S, pp_axis)
+
+                    body_specs = tuple(
+                        PartitionSpec(pp_axis) for _ in body_pd)
+                    out_mbs = jax.shard_map(
+                        spmd_body, mesh=jmesh,
+                        in_specs=(body_specs, PartitionSpec()),
+                        out_specs=PartitionSpec(),
+                        axis_names={pp_axis},
+                        check_vma=False)(tuple(body_pd), h_mbs)
+                else:
+                    out_mbs = jax.vmap(
+                        lambda mb: body_block(body_pd, mb, k2))(h_mbs)
+                h2 = out_mbs.reshape((B,) + out_mbs.shape[2:])
+                out, new_post_b = post_apply(post_pd, post_b, k3, h2)
+                outs = out if isinstance(out, tuple) else (out,)
+                ins = [Tensor._from_data(o) for o in outs]
+                loss = loss_fn(*(ins + [Tensor._from_data(y)]))
+                ld = loss._data if isinstance(loss, Tensor) else loss
+                if ld.ndim > 0:
+                    ld = jnp.mean(ld)
+                return ld, (new_pre_b, new_post_b)
+
+            (loss, (new_pre_b, new_post_b)), (g_pre, g_body, g_post) = \
+                jax.value_and_grad(loss_of, has_aux=True)(
+                    (list(pre_p), list(body_p), list(post_p)))
+
+            clip_fn = getattr(opt._grad_clip, "clip_fn", None)
+            if clip_fn is not None:
+                flat = list(g_pre) + list(g_body) + list(g_post)
+                flat = clip_fn(flat)
+                g_pre = flat[:len(g_pre)]
+                g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
+                g_post = flat[len(g_pre) + len(g_body):]
+
+            def upd(ps, gs, ss):
+                nps, nss = [], []
+                for p, g, s in zip(ps, gs, ss):
+                    np_, ns = opt._rule(p, g, s, lr, step)
+                    nps.append(np_)
+                    nss.append(ns)
+                return nps, nss
+
+            npre, npre_s = upd(pre_p, g_pre, pre_s)
+            nbody, nbody_s = upd(body_p, g_body, body_s)
+            npost, npost_s = upd(post_p, g_post, post_s)
+            set_current_mesh(None)
+            return (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
+                    new_pre_b, new_post_b)
+
+        return step_fn
+
+    def __call__(self, x, y):
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        jmesh = self._mesh.jax_mesh()
+        dp = self._dp_axis if self._dp_axis in self._mesh.dim_names else None
+
+        def bsh(ndim):
+            spec = [None] * ndim
+            if dp:
+                spec[0] = dp
+            return NamedSharding(jmesh, PartitionSpec(*spec))
+
+        xd = jax.device_put(xd, bsh(xd.ndim))
+        yd = jax.device_put(yd, bsh(yd.ndim))
+        if self._jitted is None:
+            step_fn = self._make_step_fn()
+            slot_sh = lambda shs, slots: [
+                {k: sh for k in s} for sh, s in zip(shs, slots)]
+            self._jitted = jax.jit(
+                step_fn,
+                in_shardings=(self._pre_sh, self._body_sh, self._post_sh,
+                              slot_sh(self._pre_sh, self._pre_slots),
+                              slot_sh(self._body_sh, self._body_slots),
+                              slot_sh(self._post_sh, self._post_slots),
+                              [self._repl] * len(self._pre_buffers),
+                              [self._repl] * len(self._post_buffers),
+                              self._repl, self._repl, self._repl,
+                              bsh(xd.ndim), bsh(yd.ndim)),
+                out_shardings=(self._repl, self._pre_sh, self._body_sh,
+                               self._post_sh,
+                               slot_sh(self._pre_sh, self._pre_slots),
+                               slot_sh(self._body_sh, self._body_slots),
+                               slot_sh(self._post_sh, self._post_slots),
+                               [self._repl] * len(self._pre_buffers),
+                               [self._repl] * len(self._post_buffers)),
+                donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._opt._step_count += 1
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        stp = jnp.asarray(float(self._opt._step_count), jnp.float32)
+        key = gen.default_generator.next_key()
+        set_current_mesh(self._mesh)
+        try:
+            (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
+             npre_b, npost_b) = \
+                self._jitted([p._data for p in self._pre_params],
+                             self._stacked_body,
+                             [p._data for p in self._post_params],
+                             self._pre_slots, self._body_slots,
+                             self._post_slots,
+                             [b._data for b in self._pre_buffers],
+                             [b._data for b in self._post_buffers],
+                             stp, lr, key, xd, yd)
+        finally:
+            set_current_mesh(None)
+        for p, d in zip(self._pre_params, npre):
+            p._data = d
+        for p, d in zip(self._post_params, npost):
+            p._data = d
+        for b, d in zip(self._pre_buffers, npre_b):
+            b._data = d
+        for b, d in zip(self._post_buffers, npost_b):
+            b._data = d
+        self._stacked_body = nbody
+        self._pre_slots, self._body_slots, self._post_slots = \
+            npre_s, nbody_s, npost_s
+        return Tensor._from_data(loss)
+
+    def sync_params_to_model(self):
+        """Write stacked body params back into the Layer objects (for
+        state_dict / checkpointing)."""
+        L = len(self._body_layer_params)
+        for i in range(self._n_leaves):
+            leaf = self._stacked_body[i]
+            for l in range(L):
+                self._body_layer_params[l][i]._data = leaf[l]

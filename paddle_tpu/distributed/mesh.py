@@ -1,0 +1,223 @@
+"""ProcessMesh and placements.
+
+Reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34,
+placement_types.h:68/108/132 (Shard/Replicate/Partial) and python
+python/paddle/distributed/auto_parallel/process_mesh.py.
+
+TPU-native: a ProcessMesh wraps jax.sharding.Mesh; placements map to
+NamedSharding PartitionSpecs, so a "DistTensor" is simply a jax.Array with a
+NamedSharding — reshard is a sharding change that XLA lowers to the same
+collective lattice the reference implements by hand (s_to_r = all-gather,
+p_to_r = all-reduce, s_to_s = all-to-all; reshard/*.cc).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "Placement",
+           "get_mesh", "set_mesh", "init_mesh", "auto_mesh"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is split across the corresponding mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Value is a partial sum over the mesh dim (pending all-reduce).
+
+    jax.Array has no native 'partial' state; we track partial-ness as
+    metadata on the Tensor and materialize the reduction on reshard to
+    Replicate/Shard (see distributed/api.py reshard) — same lattice
+    semantics as the reference's p_to_r/p_to_s functions.
+    """
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """N-D device mesh with named dims.
+
+    ``ProcessMesh([[0,1],[2,3]], dim_names=["dp","mp"])`` — the device ids
+    index ``jax.devices()``.
+    """
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None):
+        arr = np.asarray(mesh)
+        if shape is not None:
+            shape = tuple(int(s) for s in shape)
+            if arr.size != int(np.prod(shape)):
+                raise ValueError(
+                    f"mesh has {arr.size} process ids but shape {shape} "
+                    f"needs {int(np.prod(shape))}")
+            arr = arr.reshape(shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # -- metadata -------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def size(self):
+        return int(self._ids.size)
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        pos = np.argwhere(self._ids == process_id)
+        if len(pos) == 0:
+            return -1
+        return int(pos[0][axis])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    # -- jax bridge -----------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev_arr = np.empty(self._ids.shape, dtype=object)
+            for idx in np.ndindex(self._ids.shape):
+                did = int(self._ids[idx])
+                if did >= len(devices):
+                    raise RuntimeError(
+                        f"mesh references device {did} but only "
+                        f"{len(devices)} devices are present")
+                dev_arr[idx] = devices[did]
+            self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+    def sharding_for(self, placements: Sequence[Placement], ndim: int
+                     ) -> NamedSharding:
+        """placements (one per mesh dim) -> NamedSharding over tensor dims."""
+        spec = [None] * ndim
+        for mesh_dim, pl in enumerate(placements):
+            if isinstance(pl, Shard):
+                d = pl.dim % ndim
+                if spec[d] is None:
+                    spec[d] = self._dim_names[mesh_dim]
+                elif isinstance(spec[d], tuple):
+                    spec[d] = spec[d] + (self._dim_names[mesh_dim],)
+                else:
+                    spec[d] = (spec[d], self._dim_names[mesh_dim])
+        return NamedSharding(self.jax_mesh(), PartitionSpec(*spec))
+
+
+# -- global default mesh (paddle.distributed.auto_parallel get/set_mesh) ----
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def init_mesh(shape: Sequence[int], dim_names: Sequence[str]) -> ProcessMesh:
+    """Build a mesh over all visible devices with the given logical shape."""
+    n = int(np.prod(shape))
+    ids = np.arange(n).reshape(shape)
+    mesh = ProcessMesh(ids, dim_names=list(dim_names))
+    set_mesh(mesh)
+    return mesh
+
+
+def auto_mesh(*dim_names: str) -> ProcessMesh:
+    """1-D mesh over every device (ICI-ordered)."""
+    name = dim_names[0] if dim_names else "x"
+    return init_mesh([len(jax.devices())], [name])

@@ -1,0 +1,54 @@
+"""DataParallel wrapper.
+
+Reference: python/paddle/distributed/parallel.py:202 (DataParallel) +
+C++ EagerReducer (paddle/fluid/distributed/collective/reducer.h:88 —
+bucketed grad fusion with overlapped allreduce).
+
+TPU-native: under a compiled step with a dp-sharded batch and replicated
+params, XLA inserts the gradient all-reduce itself and overlaps it with
+backward compute (the reducer's whole job). This wrapper exists for API
+parity: it marks the model for dp and provides the no_sync context.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, **kwargs):
+        return self._layers.set_state_dict(state_dict, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss

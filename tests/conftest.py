@@ -1,10 +1,14 @@
 """Test config: force an 8-device virtual CPU platform so every test —
 including mesh/sharding/collective tests — runs without TPU hardware
 (the role of the reference's fake_cpu_device / Gloo CPU process groups,
-SURVEY.md §4)."""
+SURVEY.md §4).
+
+Note: the axon TPU plugin's sitecustomize pins jax_platforms='axon,cpu' via
+jax.config at interpreter start, so env vars alone don't switch platforms —
+we override the config and reset backends here, before any array is built.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,8 +16,21 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 # XLA:CPU's fast matmul path is bf16-like; tests check f32 numerics
 jax.config.update("jax_default_matmul_precision", "highest")
+try:
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+except Exception:
+    pass
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
 
 import pytest  # noqa: E402
 
