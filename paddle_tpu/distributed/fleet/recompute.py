@@ -1,0 +1,79 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction:109, recompute:403, recompute_sequential:567) — PyLayer
+that re-runs forward under restored RNG state during backward.
+
+TPU-native: ``jax.checkpoint`` (remat) does exactly this inside the traced
+graph — XLA drops the activations and re-emits the forward in the backward
+pass; RNG correctness is free because keys are functional values. The eager
+tape path gets the same semantics via a GradNode whose vjp re-runs the
+function under jax.vjp at backward time.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
+    """Run ``function(*args)`` without storing intermediate activations;
+    recompute them in backward."""
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    datas = [t._data for t in tensors]
+
+    def pure(*primals):
+        it = iter(primals)
+        call_args = [next(it) if isinstance(a, Tensor) else a for a in args]
+        wrapped = [Tensor._from_data(d) if not isinstance(d, Tensor)
+                   and hasattr(d, "dtype") else d for d in call_args]
+        with engine.no_grad():
+            out = function(*wrapped, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    want_grad = engine.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensors)
+    if not want_grad:
+        out = pure(*datas)
+    else:
+        out, vjp_fn = jax.vjp(ckpt, *datas)
+
+    multi = isinstance(out, tuple)
+    outs = list(out) if multi else [out]
+    out_tensors = [Tensor._from_data(o, stop_gradient=not want_grad)
+                   for o in outs]
+    if want_grad:
+        diff_inputs = [t if not t.stop_gradient else None for t in tensors]
+        engine.register_node(out_tensors, "recompute", vjp_fn, diff_inputs)
+    return tuple(out_tensors) if multi else out_tensors[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segmented recompute over a Sequential (reference :567)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    seg = max(n // max(segments, 1), 1)
+    out = args[0] if len(args) == 1 else args
+    i = 0
+    while i < n:
+        chunk = layers[i:i + seg]
+
+        def run_chunk(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        out = recompute(run_chunk, out, **kwargs)
+        i += seg
+    return out

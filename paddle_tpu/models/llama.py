@@ -1,0 +1,308 @@
+"""Llama model family — the flagship decoder LM.
+
+Reference capability: test/auto_parallel/hybrid_strategy/
+semi_auto_parallel_llama_model.py (the reference's Llama used for hybrid-
+parallel acceptance tests) + incubate fused ops (fused_rotary_position_
+embedding.py, fused_rms_norm.py, swiglu.py).
+
+TPU-native: bf16-first, RMSNorm in f32, rope precomputed cos/sin, GQA,
+flash attention through ops.pallas_attention (Pallas kernel on TPU, XLA
+fallback elsewhere). Parallelism by construction:
+  tp  — Column/Row parallel projections + vocab-parallel embedding/head
+  sp  — sequence dim constrained to the mp axis between blocks
+  dp/fsdp — via ParallelTrainStep config
+  pp  — LlamaForCausalLMPipe builds a PipelineLayer with homogeneous
+        LayerDesc body
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, mark_placements, sharding_constraint,
+)
+from paddle_tpu.distributed.mesh import Shard
+from paddle_tpu.ops.registry import register_emitter as op_emitter
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaForCausalLMPipe", "LlamaDecoderLayer",
+           "LlamaPretrainingCriterion"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    sequence_parallel: bool = False
+    use_flash_attention: bool = True
+    recompute: bool = False
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama3_8b(**kw):
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=8,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=128, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rope emitter (fused_rotary_position_embedding analog)
+# ---------------------------------------------------------------------------
+@op_emitter
+def rope_apply(q, k, cos, sin):
+    """Rotary embedding on [b, s, h, d] q/k given cos/sin [s, d]."""
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    q2 = q * c + rot(q) * s
+    k2 = k * c + rot(k) * s
+    return q2.astype(q.dtype), k2.astype(k.dtype)
+
+
+from paddle_tpu.ops import registry as _registry  # noqa: E402
+
+if "rope_apply" not in _registry.OPS:
+    _registry.build_registry([
+        {"op": "rope_apply", "tensor_args": ["q", "k", "cos", "sin"],
+         "methods": []}])
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+class LlamaRMSNorm(nn.RMSNorm):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(config.hidden_size, epsilon=config.rms_norm_eps)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.n_heads = config.num_attention_heads
+        self.n_kv = config.num_key_value_heads
+        self.head_dim = h // self.n_heads
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            h, self.n_kv * self.head_dim, has_bias=False,
+            gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            h, self.n_kv * self.head_dim, has_bias=False,
+            gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                        input_is_parallel=True)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        b, s, h = x.shape
+        q = ops.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q, k = _registry.API["rope_apply"](q, k, cos, sin)
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        if self.config.use_flash_attention and attn_mask is None:
+            from paddle_tpu.ops import pallas_attention
+
+            out = pallas_attention.flash_attention(q, k, v, causal=True)
+        else:
+            out = ops.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        # swiglu (reference: incubate/nn/functional/swiglu.py)
+        return self.down_proj(ops.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+        self.mlp = LlamaMLP(config)
+        theta = config.rope_theta
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(config.max_position_embeddings, head_dim,
+                                theta)
+        # plain attributes (not registered buffers): rope tables are pure
+        # functions of the config, baked into the trace as constants —
+        # keeps the pipeline body buffer-free (pp_engine requirement)
+        self.rope_cos = Tensor(cos)
+        self.rope_sin = Tensor(sin)
+
+    def forward(self, x, attn_mask=None):
+        s = x.shape[1]
+        cos = self.rope_cos[:s]
+        sin = self.rope_sin[:s]
+        if self.config.sequence_parallel:
+            x = sharding_constraint(x, {1: "mp"})
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        if self.config.sequence_parallel:
+            out = sharding_constraint(out, {1: "mp"})
+        return out
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.config.recompute and not self.training:
+                x = layer(x, attn_mask)
+            elif self.config.recompute:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+                x = recompute(layer, x, attn_mask)
+            else:
+                x = layer(x, attn_mask)
+        return self.norm(x)
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Shift-label LM loss (vocab-parallel aware)."""
+
+    def __init__(self, config: LlamaConfig = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, logits, labels):
+        loss = self.ce(logits, labels)
+        return ops.mean(loss)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size, has_bias=False,
+            gather_output=False)
+        if config.tie_word_embeddings:
+            self.lm_head.weight = self.llama.embed_tokens.weight
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        return self.lm_head(h)
+
+    @staticmethod
+    def criterion(config=None):
+        return LlamaPretrainingCriterion(config)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0):
+        """Greedy/sampled decoding (eager; full-context recompute per step —
+        a KV-cache decode path is a later milestone)."""
+        from paddle_tpu.core import generator as gen
+        import jax
+
+        out = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(out)
+            nxt_logits = logits[:, -1]
+            if temperature > 0:
+                d = nxt_logits._data / temperature
+                nxt = jax.random.categorical(gen.active_key(), d, axis=-1)
+                nxt_t = Tensor._from_data(nxt.astype(jnp.int32))
+            else:
+                nxt_t = ops.argmax(nxt_logits, axis=-1)
+            out = ops.concat([out, ops.unsqueeze(nxt_t, 1)], axis=1)
+        return out
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int):
+    """Pipeline-ready Llama: embedding/head replicated sections, decoder
+    blocks as the homogeneous pipeline body."""
+    from paddle_tpu.distributed.fleet.pipeline_parallel import (
+        LayerDesc, PipelineLayer,
+    )
+
+    class _Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+
+        def forward(self, input_ids):
+            return self.embed_tokens(input_ids)
+
+    class _Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.norm = LlamaRMSNorm(config)
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+
+        def forward(self, x):
+            return self.lm_head(self.norm(x))
+
+    return PipelineLayer(
+        layers=[_Embed()] +
+               [LayerDesc(LlamaDecoderLayer, config)
+                for _ in range(config.num_hidden_layers)] +
+               [_Head()],
+        num_stages=num_stages,
+        loss_fn=LlamaPretrainingCriterion(config))
