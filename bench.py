@@ -1,0 +1,115 @@
+"""Benchmark entry point (driver contract).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+Configs measured (BASELINE.md):
+  #1 ResNet-50 on CIFAR-10-shaped synthetic data, whole-step compiled
+     (TrainStep) — images/sec.  Primary metric.
+  small-GPT (Llama architecture) LM pretraining step, compiled —
+     tokens/sec/chip.  Reported in "extra".
+
+The reference repo publishes no absolute perf numbers (BASELINE.md), so
+``vs_baseline`` is measured against self-defined targets below — chosen as
+single-accelerator parity bars for the reference's GPU-class hardware.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+# Self-defined targets (reference publishes none — BASELINE.md).
+TARGET_RESNET50_IMG_PER_SEC = 1000.0   # V100-class CIFAR ResNet-50 bar
+TARGET_GPT_TOKENS_PER_SEC = 20000.0    # small-GPT (~60M) single-chip bar
+
+
+def _sync(x):
+    import jax
+
+    jax.block_until_ready(x._data if hasattr(x, "_data") else x)
+
+
+def _timed_steps(step_fn, min_steps=5, budget_s=30.0):
+    """Run warmup (compile) then time steps until budget; return steps/sec."""
+    for _ in range(2):
+        _sync(step_fn())
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        _sync(step_fn())
+        n += 1
+        dt = time.perf_counter() - t0
+        if n >= min_steps and dt > budget_s:
+            break
+        if n >= 200:
+            break
+    return n / (time.perf_counter() - t0)
+
+
+def bench_resnet50(batch=64):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=10)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(
+        rng.randn(batch, 3, 32, 32).astype(np.float32))
+    Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    sps = _timed_steps(lambda: step(X, Y), budget_s=20.0)
+    return sps * batch
+
+
+def bench_gpt(batch=8, seq=512):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.llama import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=512, intermediate_size=1408,
+        num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=seq)
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, LlamaPretrainingCriterion(cfg), opt)
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    Y = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    sps = _timed_steps(lambda: step(X, Y), budget_s=20.0)
+    return sps * batch * seq
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    img_s = bench_resnet50()
+    tok_s = bench_gpt()
+    print(json.dumps({
+        "metric": "resnet50_cifar10_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / TARGET_RESNET50_IMG_PER_SEC, 4),
+        "extra": {
+            "backend": backend,
+            "gpt_small_tokens_per_sec_chip": round(tok_s, 1),
+            "gpt_vs_target": round(tok_s / TARGET_GPT_TOKENS_PER_SEC, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

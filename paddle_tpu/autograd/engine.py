@@ -225,6 +225,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         for hook in node.hooks:
             cotangents = hook(cotangents)
 
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to run backward through the graph a second time, "
+                "but the saved residuals have already been freed. Pass "
+                "retain_graph=True to the first backward() if you need to "
+                "backward through this graph again.")
         in_grads = node.vjp_fn(
             cotangents if len(cotangents) > 1 else cotangents[0]
         )

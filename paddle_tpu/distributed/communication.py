@@ -253,7 +253,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             tensor._data = out
             return tensor
         return out
-    return tensor
+    if g.nranks == 1:
+        return tensor
+    raise RuntimeError("eager broadcast requires an SPMD context")
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):

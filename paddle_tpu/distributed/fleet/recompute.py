@@ -22,27 +22,70 @@ from paddle_tpu.core.tensor import Tensor
 __all__ = ["recompute", "recompute_sequential"]
 
 
+def _owned_parameters(function):
+    """Trainable parameters reachable from ``function`` (a Layer, a bound
+    Layer method, or a closure over Layers) so their gradients flow through
+    the recompute boundary — mirroring how RecomputeFunction treats weights
+    as autograd inputs (reference recompute.py:109)."""
+    owner = None
+    if hasattr(function, "parameters") and callable(
+            getattr(function, "parameters", None)):
+        owner = function
+    elif hasattr(function, "__self__") and hasattr(
+            function.__self__, "parameters"):
+        owner = function.__self__
+    if owner is not None:
+        return [p for p in owner.parameters() if not p.stop_gradient]
+    # closures and default-bound args (e.g. recompute_sequential chunks):
+    # scan cells and __defaults__ for Layers
+    params, seen = [], set()
+    candidates = [c.cell_contents
+                  for c in (getattr(function, "__closure__", None) or ())]
+    candidates += list(getattr(function, "__defaults__", None) or ())
+    for obj in candidates:
+        objs = obj if isinstance(obj, (list, tuple)) else [obj]
+        for o in objs:
+            if hasattr(o, "parameters") and callable(
+                    getattr(o, "parameters", None)):
+                for p in o.parameters():
+                    if not p.stop_gradient and id(p) not in seen:
+                        seen.add(id(p))
+                        params.append(p)
+    return params
+
+
 def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
     """Run ``function(*args)`` without storing intermediate activations;
-    recompute them in backward."""
+    recompute them in backward. Parameter gradients of the recomputed
+    Layer(s) are propagated (they are vjp primals alongside tensor args)."""
     tensors = [a for a in args if isinstance(a, Tensor)]
-    datas = [t._data for t in tensors]
+    params = _owned_parameters(function)
+    datas = [t._data for t in tensors] + [p._data for p in params]
+    n_args = len(tensors)
 
     def pure(*primals):
-        it = iter(primals)
+        arg_vals, param_vals = primals[:n_args], primals[n_args:]
+        it = iter(arg_vals)
         call_args = [next(it) if isinstance(a, Tensor) else a for a in args]
         wrapped = [Tensor._from_data(d) if not isinstance(d, Tensor)
                    and hasattr(d, "dtype") else d for d in call_args]
-        with engine.no_grad():
-            out = function(*wrapped, **kwargs)
+        saved = [p._data for p in params]
+        for p, v in zip(params, param_vals):
+            p._data = v
+        try:
+            with engine.no_grad():
+                out = function(*wrapped, **kwargs)
+        finally:
+            for p, s in zip(params, saved):
+                p._data = s
         if isinstance(out, (tuple, list)):
             return tuple(o._data if isinstance(o, Tensor) else o
                          for o in out)
         return out._data if isinstance(out, Tensor) else out
 
     ckpt = jax.checkpoint(pure)
-    want_grad = engine.is_grad_enabled() and any(
-        not t.stop_gradient for t in tensors)
+    want_grad = engine.is_grad_enabled() and (
+        any(not t.stop_gradient for t in tensors) or bool(params))
     if not want_grad:
         out = pure(*datas)
     else:
@@ -53,7 +96,8 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
     out_tensors = [Tensor._from_data(o, stop_gradient=not want_grad)
                    for o in outs]
     if want_grad:
-        diff_inputs = [t if not t.stop_gradient else None for t in tensors]
+        diff_inputs = [t if not t.stop_gradient else None
+                       for t in tensors] + list(params)
         engine.register_node(out_tensors, "recompute", vjp_fn, diff_inputs)
     return tuple(out_tensors) if multi else out_tensors[0]
 

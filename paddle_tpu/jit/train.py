@@ -46,15 +46,15 @@ class TrainStep:
         self._trainable = [not p.stop_gradient for p in self._params]
         self._sharding = sharding
 
-        def step_fn(param_datas, slot_list, buffer_datas, step, lr, key,
-                    *batch):
+        def step_fn(n_inputs, param_datas, slot_list, buffer_datas, step,
+                    lr, key, *batch):
             def loss_of(trainable_params):
                 full = _merge(param_datas, trainable_params, self._trainable)
                 out, new_buf = self._apply(full, buffer_datas, key,
-                                           *batch[: self._n_inputs])
+                                           *batch[:n_inputs])
                 outs = out if isinstance(out, tuple) else (out,)
                 ins = [Tensor._from_data(o) for o in outs]
-                loss = self._compute_loss(ins, batch)
+                loss = self._compute_loss(ins, batch, n_inputs)
                 return loss._data if isinstance(loss, Tensor) else loss, \
                     new_buf
 
@@ -86,24 +86,25 @@ class TrainStep:
                 new_slots[i] = ns
             return loss, new_params, new_slots, new_buffers
 
-        self._n_inputs = 1
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+        # n_inputs is a static jit arg: calling with a different
+        # n_model_inputs retraces instead of silently reusing a stale split
+        self._jitted = jax.jit(step_fn, static_argnums=(0,),
+                               donate_argnums=(1, 2))
 
-    def _compute_loss(self, model_outs, batch):
+    def _compute_loss(self, model_outs, batch, n_inputs):
         """loss_fn(outputs..., labels...) — by convention the model consumes
         the leading batch elements and loss_fn the trailing ones; we pass
         (model_out, *remaining) where remaining = batch[n_model_inputs:]."""
-        labels = [Tensor._from_data(b) for b in batch[self._n_inputs:]]
+        labels = [Tensor._from_data(b) for b in batch[n_inputs:]]
         outs = list(model_outs)
         return self._loss_fn(*(outs + labels))
 
     def __call__(self, *batch, n_model_inputs: Optional[int] = None):
         """batch = (model_inputs..., labels...). By default the model takes
         one input and the rest are labels."""
-        self._n_inputs = 1 if n_model_inputs is None else n_model_inputs
+        n_inputs = 1 if n_model_inputs is None else n_model_inputs
         datas = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch)
-        model_datas = datas[: self._n_inputs]
         self._opt._step_count += 1
         lr = jnp.asarray(self._opt.get_lr(), dtype=jnp.float32)
         step = jnp.asarray(float(self._opt._step_count), dtype=jnp.float32)
@@ -111,7 +112,8 @@ class TrainStep:
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
         loss, new_params, new_slots, new_buffers = self._jitted(
-            param_datas, self._slots, buffer_datas, step, lr, key, *datas)
+            n_inputs, param_datas, self._slots, buffer_datas, step, lr, key,
+            *datas)
         for p, np_ in zip(self._params, new_params):
             p._data = np_
         for b, nb in zip(self._buffers, new_buffers):

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hot-path custom kernels the reference ships as
+fused CUDA (paddle/phi/kernels/gpu/flash_attn_kernel.cu, fusion/).
+
+Kernels run natively on TPU; everywhere else (CPU tests) they run in
+Pallas interpret mode so numerics are verifiable without hardware.
+"""
+from paddle_tpu.ops.pallas import flash_attention  # noqa: F401

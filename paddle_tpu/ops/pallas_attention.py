@@ -1,15 +1,14 @@
 """Flash attention entry point.
 
 Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu (CUDA
-flash-attn). TPU-native plan: a Pallas blockwise-softmax kernel for the hot
-path (ops/pallas/flash_attention.py), with this XLA fallback (fused by XLA
-into a reasonably good attention already) used on CPU and for verification.
+flash-attn). TPU-native: a Pallas blockwise-softmax kernel
+(ops/pallas/flash_attention.py) used natively on TPU and in interpret
+mode on CPU; the XLA SDPA emitter remains the fallback for shapes the
+kernel doesn't tile (and for dropout).
 
 Layout convention (paddle flash_attention): [batch, seq, heads, head_dim].
 """
 from __future__ import annotations
-
-import jax
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.registry import API as _API
@@ -18,11 +17,16 @@ from paddle_tpu.ops.registry import API as _API
 def flash_attention(query, key, value, causal=False, dropout=0.0,
                     training=True):
     use_pallas = False
-    try:
-        from paddle_tpu.ops.pallas import flash_attention as _fa
-        use_pallas = _fa.available() and dropout == 0.0
-    except Exception:
-        use_pallas = False
+    if dropout == 0.0:
+        try:
+            from paddle_tpu.ops.pallas import flash_attention as _fa
+
+            seq = (query._data if isinstance(query, Tensor)
+                   else query).shape[1]
+            kseq = (key._data if isinstance(key, Tensor) else key).shape[1]
+            use_pallas = _fa.available(seq) and _fa.available(kseq)
+        except Exception:
+            use_pallas = False
     if use_pallas:
         return _fa.flash_attention_op(query, key, value, causal=causal)
     return _API["scaled_dot_product_attention"](

@@ -376,20 +376,20 @@ class NAdam(Adam):
 
 class RAdam(Adam):
     def _rule(self, p, g, slots, lr, step):
-        import math
-
         g = self._apply_weight_decay_to_grad(p, g)
         b1, b2 = self._beta1, self._beta2
         m = b1 * slots["moment1"] + (1 - b1) * g
         v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
         mhat = m / (1 - b1 ** step)
         rho_inf = 2.0 / (1 - b2) - 1.0
+        # step may be a traced value under TrainStep; branch via jnp.where
         rho_t = rho_inf - 2.0 * step * (b2 ** step) / (1 - b2 ** step)
-        if rho_t > 4.0:
-            vhat = jnp.sqrt(v / (1 - b2 ** step))
-            rt = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
-                           ((rho_inf - 4) * (rho_inf - 2) * rho_t))
-            p2 = p - lr * rt * mhat / (vhat + self._eps)
-        else:
-            p2 = p - lr * mhat
+        vhat = jnp.sqrt(v / (1 - b2 ** step))
+        rt = jnp.sqrt(jnp.maximum(
+            ((rho_t - 4.0) * (rho_t - 2.0) * rho_inf) /
+            ((rho_inf - 4.0) * (rho_inf - 2.0) *
+             jnp.maximum(rho_t, self._eps)), 0.0))
+        rectified = p - lr * rt * mhat / (vhat + self._eps)
+        unrectified = p - lr * mhat
+        p2 = jnp.where(rho_t > 4.0, rectified, unrectified)
         return p2, {"moment1": m, "moment2": v}

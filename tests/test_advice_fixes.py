@@ -1,0 +1,125 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_recompute_propagates_param_grads():
+    """high: eager recompute() must populate weight grads of the
+    recomputed Layer (reference RecomputeFunction semantics)."""
+    from paddle_tpu.distributed.fleet.recompute import recompute
+
+    paddle.seed(0)
+    layer = nn.Linear(8, 8)
+    x = paddle.randn([4, 8])
+
+    out = recompute(layer, x)
+    out.sum().backward()
+    assert layer.weight.grad is not None
+    assert layer.bias.grad is not None
+
+    # grads must match the non-recomputed path
+    paddle.seed(0)
+    layer2 = nn.Linear(8, 8)
+    out2 = layer2(paddle.to_tensor(x.numpy()))
+    out2.sum().backward()
+    np.testing.assert_allclose(layer.weight.grad.numpy(),
+                               layer2.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_sequential_propagates_param_grads():
+    from paddle_tpu.distributed.fleet.recompute import recompute_sequential
+
+    paddle.seed(0)
+    seq = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 8))
+    x = paddle.randn([4, 8])
+    out = recompute_sequential({"segments": 2}, seq, x)
+    out.sum().backward()
+    assert seq[0].weight.grad is not None
+    assert seq[2].weight.grad is not None
+
+
+def test_trainstep_n_model_inputs_retrace():
+    """medium: changing n_model_inputs between calls must retrace, not
+    silently reuse the first split."""
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, a, b=None):
+            out = self.fc(a)
+            if b is not None:
+                out = out + b
+            return out
+
+    paddle.seed(0)
+    m = TwoIn()
+    opt = optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    a = paddle.randn([2, 4])
+    b = paddle.zeros([2, 4])
+    y = paddle.zeros([2, 4])
+    l1 = float(step(a, y).item())
+    # same arity of batch, different split: model gets (a, b) now
+    l2 = float(step(a, b, y, n_model_inputs=2).item())
+    # b==0 so the losses agree; the point is no stale-split crash/garbage
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_radam_traceable_in_trainstep():
+    """low: RAdam's rectification branch must be traceable (jnp.where)."""
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.RAdam(learning_rate=0.01, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    x, y = paddle.randn([8, 4]), paddle.randn([8, 4])
+    losses = [float(step(x, y).item()) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_radam_eager_matches_reference_rectification():
+    """RAdam eager: early steps take the unrectified branch, later the
+    rectified one; both must be finite and loss must fall."""
+    paddle.seed(1)
+    m = nn.Linear(4, 1)
+    opt = optimizer.RAdam(learning_rate=0.05, parameters=m.parameters())
+    x = paddle.randn([16, 4])
+    y = paddle.randn([16, 1])
+    loss_fn = nn.MSELoss()
+    losses = []
+    for _ in range(8):
+        loss = loss_fn(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_broadcast_raises_outside_spmd():
+    """low: broadcast on a multi-rank group outside SPMD must raise, like
+    the other collectives, instead of silently no-opping."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.mesh import init_mesh
+
+    init_mesh([8], ["x"])
+    dist.init_parallel_env()
+    t = paddle.ones([4])
+    with pytest.raises(RuntimeError):
+        dist.broadcast(t, src=0)
+
+
+def test_second_backward_raises_clear_error():
+    """low: backward twice without retain_graph -> clear RuntimeError."""
+    x = paddle.randn([4])
+    x.stop_gradient = False
+    y = (x * x).sum()
+    y.backward(retain_graph=False)
+    z = x * 1.0  # reuse freed graph? build second backward through y
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        y.backward()

@@ -1,0 +1,91 @@
+"""Pallas flash attention vs XLA SDPA fallback (interpret mode on the CPU
+mesh — VERDICT.md round-1 item 2: numerics-verify pallas vs fallback)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.registry import API
+
+
+def _sdpa_ref(q, k, v, causal):
+    # plain [B,S,H,D] attention in f32
+    qt = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).astype(jnp.float32)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).astype(jnp.float32)
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.transpose(o, (0, 2, 1, 3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 128, 2, 64), (1, 256, 4, 32)])
+def test_flash_forward_matches_reference(causal, shape):
+    b, s, h, d = shape
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+    out = fa.flash_attention_data(q, k, v, causal=causal, block_q=64,
+                                  block_k=64, interpret=True)
+    ref = _sdpa_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_reference(causal):
+    b, s, h, d = 1, 128, 2, 32
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), dtype=jnp.float32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(fa.flash_attention_data(
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, causal) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_attention_op_on_tape():
+    """Tensor-level op participates in eager autograd."""
+    paddle.seed(0)
+    q = paddle.randn([1, 128, 2, 32])
+    k = paddle.randn([1, 128, 2, 32])
+    v = paddle.randn([1, 128, 2, 32])
+    q.stop_gradient = False
+    out = API["flash_attention"](q, k, v, causal=True)
+    out.sum().backward()
+    assert q.grad is not None
+    assert q.grad.shape == [1, 128, 2, 32]
+
+
+def test_entrypoint_uses_pallas_for_tileable_shapes():
+    from paddle_tpu.ops import pallas_attention
+
+    paddle.seed(0)
+    q = paddle.randn([1, 256, 2, 32])
+    k = paddle.randn([1, 256, 2, 32])
+    v = paddle.randn([1, 256, 2, 32])
+    out = pallas_attention.flash_attention(q, k, v, causal=True)
+    ref = _sdpa_ref(q._data, k._data, v._data, True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                               atol=2e-5)
