@@ -46,7 +46,10 @@ from paddle_tpu import framework  # noqa: F401,E402
 from paddle_tpu.framework.io_utils import save, load  # noqa: F401,E402
 from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401,E402
 from paddle_tpu import vision  # noqa: F401,E402
-from paddle_tpu import metric  # noqa: F401,E402
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import hapi  # noqa: F401,E402
+from paddle_tpu.hapi.model import Model  # noqa: F401,E402
+from paddle_tpu import profiler  # noqa: F401,E402,E402
 
 # numpy-style casting helper used across paddle code
 from paddle_tpu.ops.registry import API as _api
@@ -71,8 +74,18 @@ def tolist(x):
     return x.tolist()
 
 
-def flops(*a, **k):  # filled by hapi.summary later
-    return 0
+def flops(net, input_size=None, inputs=None, **kw):
+    from paddle_tpu.hapi.model_summary import flops as _flops
+
+    return _flops(net, input_size=input_size, inputs=inputs, **kw)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from paddle_tpu.hapi.model_summary import summary as _summary
+
+    return _summary(net, input_size=input_size, dtypes=dtypes, input=input)
+
+
 
 
 def in_dynamic_mode() -> bool:

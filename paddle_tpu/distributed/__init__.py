@@ -23,6 +23,7 @@ from paddle_tpu.distributed.communication import (  # noqa: F401
 from paddle_tpu.distributed.topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, ParallelMode,
 )
+from paddle_tpu.distributed import checkpoint  # noqa: F401
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed.parallel_wrapper import DataParallel  # noqa: F401
 from paddle_tpu.distributed.engine import (  # noqa: F401

@@ -1,0 +1,17 @@
+"""hapi — the Keras-like high-level API.
+
+Reference: python/paddle/hapi/model.py (Model:~870, fit:1750), summary
+(hapi/model_summary.py), callbacks (hapi/callbacks.py). TPU-native: fit's
+inner loop is the whole-step compiled TrainStep (forward+backward+update
+in one XLA executable) rather than per-op dygraph, and evaluate/predict
+run a jitted forward — hapi users get compiled-speed training without
+touching jit themselves.
+"""
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi.model_summary import flops, summary  # noqa: F401
+
+__all__ = ["Model", "summary", "flops", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping"]

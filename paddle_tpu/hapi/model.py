@@ -1,0 +1,253 @@
+"""hapi.Model — prepare/fit/evaluate/predict/save/load.
+
+Reference: python/paddle/hapi/model.py:1052 (fit:1750, evaluate:1910,
+predict:2040, train_batch:1166, save:1310, load:1387)."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.hapi.callbacks import (
+    Callback, CallbackList, ProgBarLogger,
+)
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_loader(data, batch_size, shuffle, num_workers=0):
+    from paddle_tpu.io import DataLoader, Dataset
+
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers)
+    return data  # generic iterable of batches
+
+
+class Model:
+    """High-level training/eval/inference facade over a Layer.
+
+    ``inputs``/``labels`` may be lists of InputSpec-like objects (only
+    their count is used — how many leading batch elements feed the
+    network; the rest feed the loss)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._n_inputs = max(len(_to_list(inputs)), 1) if inputs is not None \
+            else 1
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._train_step = None  # (re)built lazily on first train_batch
+        self._eval_fn = None
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            import paddle_tpu as paddle
+
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError(
+                    "call prepare(optimizer=..., loss=...) before training")
+            self._train_step = paddle.jit.TrainStep(
+                self.network, self._loss, self._optimizer)
+        return self._train_step
+
+    def _ensure_eval_fn(self):
+        if self._eval_fn is None:
+            import paddle_tpu as paddle
+
+            self._eval_fn = paddle.jit.to_static(self.network)
+        return self._eval_fn
+
+    # -- batch-level API (reference model.py:1166,1216,1260) ------------
+    def train_batch(self, inputs, labels=None):
+        step = self._ensure_train_step()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.train()
+        loss = step(*(inputs + labels), n_model_inputs=len(inputs))
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.eval()
+        fn = self._ensure_eval_fn()
+        outs = fn(*inputs)
+        outs_l = _to_list(outs)
+        logs = {}
+        if self._loss is not None and labels:
+            loss = self._loss(*(outs_l + labels))
+            logs["loss"] = [float(loss.item())]
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(*(outs_l + labels))
+            metrics.append(m.update(res))
+        return (logs.get("loss", [0.0]), metrics) if self._metrics \
+            else logs.get("loss", [0.0])
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        fn = self._ensure_eval_fn()
+        outs = fn(*_to_list(inputs))
+        return [o.numpy() for o in _to_list(outs)]
+
+    # -- loops (reference fit:1750) --------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, n_model_inputs=None):
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, num_workers)
+        n_in = n_model_inputs or self._n_inputs
+
+        cbks = CallbackList(_to_list(callbacks) or
+                            [ProgBarLogger(log_freq, verbose=verbose)])
+        cbks.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose, "mode": "train",
+                         "save_dir": save_dir})
+        self.stop_training = False
+        step_obj = self._ensure_train_step()
+        self.network.train()
+
+        cbks.call("on_train_begin", {})
+        history = []
+        for epoch in range(epochs):
+            cbks.call("on_epoch_begin", epoch, {})
+            logs = {}
+            for i, batch in enumerate(loader):
+                batch = _to_list(batch)
+                cbks.call("on_train_batch_begin", i, {})
+                loss = step_obj(*batch, n_model_inputs=n_in)
+                logs = {"loss": float(loss.item())}
+                cbks.call("on_train_batch_end", i, logs)
+            cbks.call("on_epoch_end", epoch, logs)
+            history.append(logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=verbose,
+                    callbacks=cbks, num_workers=num_workers,
+                    n_model_inputs=n_in)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbks.call("on_train_end", logs)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, n_model_inputs=None):
+        loader = _as_loader(eval_data, batch_size, False, num_workers)
+        n_in = n_model_inputs or self._n_inputs
+        own_cbks = not isinstance(callbacks, CallbackList)
+        cbks = callbacks if not own_cbks else CallbackList(
+            _to_list(callbacks) or [ProgBarLogger(log_freq,
+                                                  verbose=verbose)])
+        if own_cbks:
+            cbks.set_model(self)
+            cbks.set_params({"mode": "eval", "verbose": verbose})
+        for m in self._metrics:
+            m.reset()
+        self.network.eval()
+        fn = self._ensure_eval_fn()
+        cbks.call("on_eval_begin", {})
+        losses = []
+        for i, batch in enumerate(loader):
+            batch = _to_list(batch)
+            cbks.call("on_eval_batch_begin", i, {})
+            ins, labels = batch[:n_in], batch[n_in:]
+            outs = _to_list(fn(*ins))
+            logs = {}
+            if self._loss is not None and labels:
+                loss = self._loss(*(outs + labels))
+                v = float(loss.item())
+                losses.append(v)
+                logs["loss"] = v
+            for m in self._metrics:
+                res = m.compute(*(outs + labels))
+                logs[m.name()] = m.update(res)
+            cbks.call("on_eval_batch_end", i, logs)
+        final = {}
+        if losses:
+            final["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            final[m.name()] = m.accumulate()
+        cbks.call("on_eval_end", final)
+        return final
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, num_workers)
+        self.network.eval()
+        fn = self._ensure_eval_fn()
+        outputs: List[List[np.ndarray]] = []
+        for batch in loader:
+            batch = _to_list(batch)
+            outs = _to_list(fn(*batch[: self._n_inputs]))
+            outputs.append([o.numpy() for o in outs])
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- persistence (reference save:1310/load:1387) ---------------------
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+        # drop any compiled step carrying stale param references
+        self._train_step = None
+        self._eval_fn = None
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,121 @@
+"""Model summary + FLOPs estimate.
+
+Reference: python/paddle/hapi/model_summary.py (summary) and
+python/paddle/hapi/dynamic_flops.py (flops). Walks the layer tree with
+forward hooks to capture output shapes and counts params; FLOPs are
+estimated with per-layer-type rules (matmul/conv dominate on the MXU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as _paddle
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _num_params(layer):
+    seen, total, trainable = set(), 0, 0
+    for p in layer.parameters():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    return total, trainable
+
+
+def _layer_flops(layer, inputs, output):
+    """Per-call FLOPs rule by layer type (multiply-accumulate = 2 flops)."""
+    from paddle_tpu import nn
+
+    x = inputs[0] if inputs else None
+    if isinstance(layer, nn.Linear):
+        batch = int(np.prod(x.shape[:-1])) if x is not None else 1
+        return 2 * batch * layer.weight.shape[0] * layer.weight.shape[1]
+    if isinstance(layer, nn.Conv2D):
+        w = layer.weight  # [out_c, in_c/groups, kh, kw]
+        out_elems = int(np.prod(output.shape))  # N*out_c*H*W
+        per_out = 2 * int(np.prod(w.shape[1:]))
+        return out_elems * per_out
+    if isinstance(layer, (nn.BatchNorm2D, nn.LayerNorm, nn.RMSNorm)) \
+            and x is not None:
+        return 2 * int(np.prod(x.shape))
+    if isinstance(layer, nn.Embedding):
+        return 0
+    return 0
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}
+    (reference model_summary.summary contract)."""
+    rows = []
+    hooks = []
+    flop_total = [0]
+
+    leaf_layers = [l for l in net.sublayers(include_self=False)
+                   if not l.sublayers()]
+
+    def make_hook(name):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            total, _ = _num_params(layer)
+            fl = _layer_flops(layer, inputs, out)
+            flop_total[0] += fl
+            rows.append((name, type(layer).__name__, shape, total, fl))
+            return None
+
+        return hook
+
+    names = {id(l): n for n, l in net.named_sublayers()}
+    for l in leaf_layers:
+        hooks.append(l.register_forward_post_hook(
+            make_hook(names.get(id(l), type(l).__name__))))
+
+    if input is not None:
+        xs = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        xs = [_paddle.zeros(list(s), dtype=dt or "float32")
+              for s, dt in zip(sizes, dts)]
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*xs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total, trainable = _num_params(net)
+    header = f"{'Layer':<38}{'Type':<18}{'Output Shape':<22}{'Params':>12}"
+    lines = ["-" * len(header), header, "-" * len(header)]
+    for name, tname, shape, nparam, _ in rows:
+        lines.append(f"{name:<38}{tname:<18}{str(shape):<22}{nparam:>12,}")
+    lines += ["-" * len(header),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              f"Estimated FLOPs (fwd, per batch): {flop_total[0]:,}",
+              "-" * len(header)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable,
+            "flops": flop_total[0]}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """FLOPs estimate for one forward pass (reference dynamic_flops.flops)."""
+    res = summary(net, input_size=input_size, input=inputs)
+    return res["flops"]

@@ -49,6 +49,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     sequence_parallel: bool = False
     use_flash_attention: bool = True
+    # ring-attention context parallelism: sequence sharded over this mesh
+    # axis, KV rotated by ppermute (ops/ring_attention.py)
+    context_parallel: bool = False
+    cp_axis: str = "sp"
+    cp_batch_axis: str = "dp"
     recompute: bool = False
     tie_word_embeddings: bool = False
     dtype: str = "float32"
@@ -134,6 +139,16 @@ class LlamaAttention(nn.Layer):
         k = ops.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
         v = ops.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
         q, k = _registry.API["rope_apply"](q, k, cos, sin)
+        if self.config.context_parallel and attn_mask is None:
+            # ring attention handles GQA internally so only compact
+            # [B,S,n_kv,D] chunks travel the ring (no repeat here)
+            from paddle_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=self.config.cp_axis,
+                                 causal=True,
+                                 batch_axis=self.config.cp_batch_axis)
+            out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
+            return self.o_proj(out)
         if self.n_kv != self.n_heads:
             rep = self.n_heads // self.n_kv
             k = ops.repeat_interleave(k, rep, axis=2)

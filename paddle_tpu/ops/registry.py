@@ -108,6 +108,17 @@ def set_amp_hook(fn):
     _AMP_HOOK = fn
 
 
+# Profiler hook: set by paddle_tpu.profiler while a host tracer is
+# recording (the reference emits RecordEvent scopes throughout eager
+# dispatch — profiler/event_tracing.h). fn(scope_name) -> contextmanager.
+_PROFILER_HOOK = None
+
+
+def set_profiler_hook(fn):
+    global _PROFILER_HOOK
+    _PROFILER_HOOK = fn
+
+
 def make_api(opdef: OpDef) -> Callable:
     """Build the eager+autograd wrapper for one op."""
 
@@ -129,6 +140,13 @@ def make_api(opdef: OpDef) -> Callable:
         return emitter(**call_args)
 
     def api(*args, **kwargs):
+        hook = _PROFILER_HOOK  # snapshot: stop() may clear it concurrently
+        if hook is not None:
+            with hook("op::" + name):
+                return _api_impl(*args, **kwargs)
+        return _api_impl(*args, **kwargs)
+
+    def _api_impl(*args, **kwargs):
         bound = opdef.sig.bind(*args, **kwargs)
         bound.apply_defaults()
         arguments = bound.arguments

@@ -1,0 +1,142 @@
+"""Ring attention — context parallelism over a sequence-sharded mesh axis.
+
+Reference gap: the reference snapshot has SP/SEP wrappers but no ring or
+blockwise attention (SURVEY.md §5 long-context note); VERDICT round-1 item
+2 calls ring attention the idiomatic TPU equivalent. Design: q/k/v are
+sequence-sharded over a mesh axis; each step computes blockwise attention
+of the local q chunk against the currently-held k/v chunk, combines with
+the running (m, l, acc) online-softmax state, then rotates k/v one hop
+around the ring with ``lax.ppermute`` (ICI neighbor exchange). After P
+steps every q chunk has attended to every k/v chunk; per-chunk compute
+overlaps the rotation inside one compiled program.
+
+Causality is handled with global indices (rows r*S+i vs cols src*S+j), so
+chunks entirely in the future contribute nothing and the diagonal chunk is
+lower-triangular — no special cases.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+_NEG_INF = -1e30
+
+
+def ring_attention_inner(q, k, v, axis_name, causal=True, scale=None):
+    """Data-level ring attention; call inside shard_map over ``axis_name``.
+
+    q: [B, S_local, H, D]; k/v: [B, S_local, H_kv, D] with H_kv dividing H
+    (GQA: only the compact KV chunks travel the ring; query heads are
+    grouped over the shared KV head inside the einsum).
+    Returns [B, S_local, H, D].
+    """
+    b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    P = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+
+    # [B,H_kv,rep,S,D] query grouped by shared kv head
+    qt = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32).reshape(
+        b, h_kv, rep, s, d)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    grow = r * s + lax.broadcasted_iota(jnp.int32, (s, s), 0)
+
+    def step(i, carry):
+        k_cur, v_cur, acc, m, l = carry
+        src = (r - i) % P  # global chunk index of the k/v we now hold
+        kt = jnp.transpose(k_cur, (0, 2, 1, 3)).astype(jnp.float32)
+        vt = jnp.transpose(v_cur, (0, 2, 1, 3)).astype(jnp.float32)
+        sc = jnp.einsum("bgrqd,bgkd->bgrqk", qt, kt) * scale
+        if causal:
+            gcol = src * s + lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            mask = grow >= gcol  # [S, S]
+            sc = jnp.where(mask[None, None, None], sc, _NEG_INF)
+        m_c = jnp.max(sc, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_c)
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bgrqk,bgkd->bgrqd", p, vt)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((b, h_kv, rep, s, d), jnp.float32)
+    m0 = jnp.full((b, h_kv, rep, s, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_kv, rep, s, 1), jnp.float32)
+    _, _, acc, m, l = lax.fori_loop(0, P, step, (k, v, acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype).reshape(b, h, s, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sharded(jmesh, axis_name, causal, batch_axis):
+    """shard_map'd ring attention: seq dim sharded over axis_name; batch
+    optionally sharded over batch_axis; heads/dim replicated."""
+    spec = PartitionSpec(batch_axis, axis_name, None, None)
+
+    fn = jax.shard_map(
+        functools.partial(ring_attention_inner, axis_name=axis_name,
+                          causal=causal),
+        mesh=jmesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn
+
+
+def ring_attention_data(q, k, v, mesh, axis_name="sp", causal=True,
+                        batch_axis=None):
+    """Global-view entry: q/k/v are [B, S, H, D] jax arrays; S is sharded
+    over ``axis_name`` of ``mesh`` (a ProcessMesh or jax Mesh)."""
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    fn = _make_sharded(jmesh, axis_name, bool(causal), batch_axis)
+    return fn(q, k, v)
+
+
+def ring_attention(query, key, value, mesh=None, axis_name="sp",
+                   causal=True, batch_axis=None):
+    """Tensor-level ring attention (eager tape + compiled step both work:
+    shard_map composes with jit and with jax.vjp)."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.engine import current_mesh
+    from paddle_tpu.distributed.mesh import get_mesh
+
+    mesh = mesh or current_mesh() or get_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "ring_attention needs a mesh: pass mesh=... or set one via "
+            "distributed.init_mesh/set_mesh")
+    from paddle_tpu.ops.registry import API as _API
+
+    return _API["ring_attention"](query, key, value, mesh=mesh,
+                                  axis_name=axis_name, causal=causal,
+                                  batch_axis=batch_axis)
+
+
+# register as a first-class op (same pattern as flash_attention)
+from paddle_tpu.ops import registry as _registry  # noqa: E402
+from paddle_tpu.ops.registry import register_emitter as _register  # noqa
+
+
+@_register(name="ring_attention")
+def _ring_attention_emitter(q, k, v, mesh=None, axis_name="sp", causal=True,
+                            batch_axis=None):
+    return ring_attention_data(q, k, v, mesh, axis_name, causal, batch_axis)
+
+
+if "ring_attention" not in _registry.OPS:
+    _registry.build_registry([
+        {"op": "ring_attention", "tensor_args": ["q", "k", "v"],
+         "methods": []}])

@@ -146,7 +146,25 @@ class Optimizer:
                 self._slots.setdefault(pid, {})[slot] = data
 
     def _param_names(self):
-        return {id(p): p.name for p in (self._parameter_list or [])}
+        """Stable slot keys: a default auto name (tensor_N, global counter)
+        differs between runs/processes, so substitute the position in the
+        parameter list — deterministic given the same model structure.
+        Explicit user names always win; a positional name that would
+        collide with an explicit name gets an __auto suffix."""
+        import re
+
+        plist = self._parameter_list or []
+        explicit = {p.name for p in plist
+                    if not re.fullmatch(r"tensor_\d+", p.name or "")}
+        out = {}
+        for i, p in enumerate(plist):
+            name = p.name
+            if re.fullmatch(r"tensor_\d+", name or ""):
+                name = f"param_{i}"
+                if name in explicit:
+                    name = f"param_{i}__auto"
+            out[id(p)] = name
+        return out
 
 
 class SGD(Optimizer):

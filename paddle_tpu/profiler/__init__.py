@@ -1,0 +1,320 @@
+"""Profiler: host scopes + TPU trace + chrome export + throughput/MFU.
+
+Reference: python/paddle/profiler/profiler.py:346 (scheduler states :79,
+export_chrome_tracing :215), host tracer
+paddle/fluid/platform/profiler/host_tracer.cc, chrome writer
+profiler/chrometracing_logger.cc, timer profiler/timer.py.
+
+TPU mapping: the host side is a RecordEvent scope recorder threaded
+through op dispatch (ops/registry.py profiler hook) and user code; the
+device side delegates to ``jax.profiler`` trace capture (xplane), the
+TPU's native tracer. ``Profiler.summary()`` aggregates host scopes;
+``benchmark()`` is the hapi throughput timer; ``estimate_mfu`` turns
+step flops + step time into the north-star MFU number (BASELINE gate #4).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "benchmark", "estimate_mfu"]
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget:
+    CPU = 0
+    GPU = 1      # accepted for API parity; no-op
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+# ---------------------------------------------------------------------------
+# host event recorder
+# ---------------------------------------------------------------------------
+class _HostEventRecorder:
+    def __init__(self):
+        self.events: List[dict] = []
+        self.active = False
+        self._lock = threading.Lock()
+
+    def start(self):
+        self.events = []
+        self.active = True
+
+    def stop(self):
+        self.active = False
+
+    def add(self, name, ts_us, dur_us):
+        if not self.active:
+            return
+        with self._lock:
+            self.events.append({
+                "name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+                "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            })
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User-facing host scope (reference profiler/event_tracing.h
+    RecordEvent). Usable as context manager or decorator; records only
+    while a Profiler is in a RECORD state."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        _recorder.add(self.name, self._t0 / 1e3, (t1 - self._t0) / 1e3)
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+# ---------------------------------------------------------------------------
+# scheduler (reference profiler.py:79 — cycle through window states)
+# ---------------------------------------------------------------------------
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], int]:
+    """Returns fn(step)->state cycling CLOSED*closed, READY*ready,
+    RECORD*(record-1), RECORD_AND_RETURN, repeated ``repeat`` times
+    (0 = forever), after ``skip_first`` skipped steps."""
+    assert record > 0, "record window must be positive"
+    span = closed + ready + record
+
+    def fn(step: int) -> int:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * span:
+            return ProfilerState.CLOSED
+        pos = s % span
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos < span - 1:
+            return ProfilerState.RECORD
+        return ProfilerState.RECORD_AND_RETURN
+
+    return fn
+
+
+def _default_scheduler(step: int) -> int:
+    return ProfilerState.RECORD  # record everything between start/stop
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler writing chrome://tracing JSON
+    (reference profiler.py:215)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_step{prof.step_num}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof.host_events}, f)
+        prof.exported_paths.append(path)
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Profiler
+# ---------------------------------------------------------------------------
+class Profiler:
+    """Reference profiler.py:346 contract: targets, scheduler windows,
+    on_trace_ready, start/step/stop, summary."""
+
+    def __init__(self, *, targets=None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 record_op_events: bool = True, trace_dir: Optional[str] = None):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if scheduler is None:
+            self._sched = _default_scheduler
+        elif callable(scheduler):
+            self._sched = scheduler
+        else:  # (start, end) tuple like the reference accepts
+            lo, hi = scheduler
+            self._sched = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.record_op_events = record_op_events
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self.host_events: List[dict] = []
+        self.exported_paths: List[str] = []
+        self._device_tracing = False
+        self._trace_dir = trace_dir or "/tmp/paddle_tpu_trace"
+
+    # -- state transitions ------------------------------------------------
+    def _recording(self, state):
+        return state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN)
+
+    def _enter_record(self):
+        if self.timer_only:
+            return
+        _recorder.start()
+        if self.record_op_events:
+            from paddle_tpu.ops import registry as _registry
+
+            _registry.set_profiler_hook(lambda name: RecordEvent(name))
+        if ProfilerTarget.TPU in self.targets:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self._trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _exit_record(self):
+        if self.timer_only:
+            return
+        _recorder.stop()
+        self.host_events = list(_recorder.events)
+        from paddle_tpu.ops import registry as _registry
+
+        _registry.set_profiler_hook(None)
+        if self._device_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def start(self):
+        self.state = self._sched(self.step_num)
+        if self._recording(self.state):
+            self._enter_record()
+        benchmark().begin()
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        benchmark().step(num_samples)
+        self.step_num += 1
+        new = self._sched(self.step_num)
+        if self._recording(new) and not self._recording(self.state):
+            self._enter_record()
+        elif self._recording(self.state) and not self._recording(new):
+            self._exit_record()
+        self.state = new
+
+    def stop(self):
+        if self._recording(self.state):
+            self._exit_record()
+        self.state = ProfilerState.CLOSED
+        benchmark().end()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting --------------------------------------------------------
+    def export(self, path: str):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.host_events}, f)
+        return path
+
+    def summary(self, sorted_by="total", print_table: bool = True):
+        """Aggregate host events by name -> calls/total/avg/max ms."""
+        agg: Dict[str, List[float]] = {}
+        for e in self.host_events:
+            agg.setdefault(e["name"], []).append(e["dur"] / 1e3)  # ms
+        rows = [(k, len(v), sum(v), sum(v) / len(v), max(v))
+                for k, v in agg.items()]
+        rows.sort(key=lambda r: -r[2])
+        if print_table:
+            hdr = (f"{'Event':<44}{'Calls':>8}{'Total(ms)':>12}"
+                   f"{'Avg(ms)':>10}{'Max(ms)':>10}")
+            print(hdr)
+            print("-" * len(hdr))
+            for nm, c, tot, avg, mx in rows[:40]:
+                print(f"{nm:<44}{c:>8}{tot:>12.3f}{avg:>10.3f}{mx:>10.3f}")
+        return {r[0]: {"calls": r[1], "total_ms": r[2], "avg_ms": r[3],
+                       "max_ms": r[4]} for r in rows}
+
+
+# ---------------------------------------------------------------------------
+# MFU (BASELINE gate #4: >=45% at 8B)
+# ---------------------------------------------------------------------------
+_PEAK_BF16_FLOPS = {
+    # per-chip peak dense bf16 FLOP/s (public spec sheets)
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> float:
+    import jax
+
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for k, v in _PEAK_BF16_FLOPS.items():
+        if k in kind:
+            return v
+    return 197e12  # conservative default
+
+
+def estimate_mfu(flops_per_step: float, step_time_s: float,
+                 peak_flops: Optional[float] = None) -> float:
+    """Model FLOPs utilisation: achieved / peak."""
+    peak = peak_flops or device_peak_flops()
+    return flops_per_step / max(step_time_s, 1e-12) / peak

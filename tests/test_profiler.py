@@ -1,0 +1,94 @@
+"""Profiler: host scopes through dispatch, scheduler windows, chrome
+export, summary, throughput timer, MFU (reference profiler.py:346,79,215)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    estimate_mfu, export_chrome_tracing, make_scheduler,
+)
+
+
+def test_record_event_scopes_through_dispatch():
+    p = Profiler(targets=[ProfilerTarget.CPU]).start()
+    x = paddle.randn([8, 8])
+    y = paddle.matmul(x, x)
+    with RecordEvent("user_scope"):
+        _ = paddle.add(y, y)
+    p.stop()
+    names = {e["name"] for e in p.host_events}
+    assert "op::matmul" in names
+    assert "op::add" in names
+    assert "user_scope" in names
+    # hook removed after stop: no growth
+    n = len(p.host_events)
+    _ = paddle.matmul(x, x)
+    assert len(p.host_events) == n
+
+
+def test_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [ProfilerState.CLOSED, ProfilerState.CLOSED,
+                      ProfilerState.READY, ProfilerState.RECORD,
+                      ProfilerState.RECORD_AND_RETURN,
+                      ProfilerState.CLOSED]
+
+
+def test_scheduler_windows_and_chrome_export(tmp_path):
+    handler = export_chrome_tracing(str(tmp_path))
+    p = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=2,
+                                          repeat=1),
+                 on_trace_ready=handler)
+    p.start()
+    x = paddle.randn([4, 4])
+    for _ in range(4):
+        _ = paddle.matmul(x, x)
+        p.step()
+    p.stop()
+    assert p.exported_paths, "trace was never exported"
+    with open(p.exported_paths[0]) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "op::matmul" for e in trace["traceEvents"])
+
+
+def test_summary_aggregation():
+    p = Profiler().start()
+    x = paddle.randn([8, 8])
+    for _ in range(3):
+        _ = paddle.matmul(x, x)
+    p.stop()
+    stats = p.summary(print_table=False)
+    assert stats["op::matmul"]["calls"] == 3
+    assert stats["op::matmul"]["total_ms"] > 0
+
+
+def test_benchmark_timer():
+    from paddle_tpu.profiler import benchmark
+
+    b = benchmark()
+    b.begin()
+    import time
+
+    for _ in range(5):
+        time.sleep(0.01)
+        b.step(num_samples=32)
+    b.end()
+    rep = b.report()
+    assert rep["steps"] == 5
+    assert 5 < rep["avg_step_ms"] < 100
+    assert rep["ips"] > 0
+
+
+def test_estimate_mfu():
+    # 1 TFLOP step in 10ms on a 197TFLOP/s chip ~= 50.7%
+    mfu = estimate_mfu(1e12, 0.01, peak_flops=197e12)
+    assert abs(mfu - 1e12 / 0.01 / 197e12) < 1e-9
+    assert 0.4 < mfu < 0.6
+    assert profiler.device_peak_flops() > 0
