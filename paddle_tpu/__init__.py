@@ -49,7 +49,8 @@ from paddle_tpu import vision  # noqa: F401,E402
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import hapi  # noqa: F401,E402
 from paddle_tpu.hapi.model import Model  # noqa: F401,E402
-from paddle_tpu import profiler  # noqa: F401,E402,E402
+from paddle_tpu import profiler  # noqa: F401,E402
+from paddle_tpu import incubate  # noqa: F401,E402,E402
 
 # numpy-style casting helper used across paddle code
 from paddle_tpu.ops.registry import API as _api

@@ -201,6 +201,58 @@ class GradScaler:
         self._bad_steps = state.get("bad_steps", 0)
 
 
+# -- compiled-step loss scaling (shared by TrainStep/ParallelTrainStep) ----
+def scaler_init_state(scaler):
+    """[scale, good_steps, bad_steps] as a traced f32 triple, or None when
+    scaling is off (reference HybridParallelGradScaler state)."""
+    import jax.numpy as jnp
+
+    if scaler is None or not scaler.is_enable():
+        return None
+    return jnp.asarray([scaler._scale, float(scaler._good_steps),
+                        float(scaler._bad_steps)], dtype=jnp.float32)
+
+
+def scaler_unscale_and_check(grads, state):
+    """Unscale grads by state's scale; found_inf = any nonfinite grad."""
+    import jax.numpy as jnp
+
+    inv = 1.0 / state[0]
+    gs = [g * inv for g in grads]
+    found = jnp.zeros((), jnp.bool_)
+    for g in gs:
+        found = found | jnp.any(~jnp.isfinite(g))
+    return gs, found
+
+
+def scaler_update_state(scaler, state, found):
+    """Dynamic loss-scale schedule as pure jnp (mirrors GradScaler.update)."""
+    import jax.numpy as jnp
+
+    scale, good, bad = state[0], state[1], state[2]
+    if not scaler._dynamic:
+        return state
+    bad2 = jnp.where(found, bad + 1.0, 0.0)
+    good2 = jnp.where(found, 0.0, good + 1.0)
+    dec = bad2 >= scaler._decr_every
+    inc = good2 >= scaler._incr_every
+    scale2 = jnp.where(dec, jnp.maximum(scale * scaler._decr_ratio, 1.0),
+                       jnp.where(inc & ~found, scale * scaler._incr_ratio,
+                                 scale))
+    return jnp.stack([scale2, jnp.where(inc, 0.0, good2),
+                      jnp.where(dec, 0.0, bad2)])
+
+
+def scaler_sync_from_state(scaler, state):
+    """Write the traced state back onto the python GradScaler (lazy)."""
+    import numpy as np
+
+    s = np.asarray(state)
+    scaler._scale = float(s[0])
+    scaler._good_steps = int(s[1])
+    scaler._bad_steps = int(s[2])
+
+
 def is_bfloat16_supported(place=None):
     return True
 

@@ -135,13 +135,18 @@ class ParallelTrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  mesh: ProcessMesh, config: Optional[ParallelConfig] = None,
-                 n_model_inputs: int = 1):
+                 n_model_inputs: int = 1, scaler=None):
+        from paddle_tpu import amp as _amp
+
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
         self._mesh = mesh
         self._config = config or ParallelConfig()
         self._n_inputs = n_model_inputs
+        self._scaler = scaler if scaler is not None and scaler.is_enable() \
+            else None
+        self._scaler_state = _amp.scaler_init_state(scaler)
         cfg = self._config
 
         shard_model_parameters(model, mesh, cfg)
@@ -192,8 +197,9 @@ class ParallelTrainStep:
         self._batch_sharding = batch_sharding
 
         def step_fn(param_datas, slot_list, buffer_datas, step, lr, key,
-                    *batch):
+                    scaler_state, *batch):
             set_current_mesh(mesh)
+            scaling = scaler_state is not None
 
             def loss_of(trainable_params):
                 full = list(param_datas)
@@ -216,12 +222,23 @@ class ParallelTrainStep:
                 ld = loss._data if isinstance(loss, Tensor) else loss
                 if ld.ndim > 0:
                     ld = jnp.mean(ld)
-                return ld, new_buf
+                scaled = ld * scaler_state[0] if scaling else ld
+                return scaled, (ld, new_buf)
 
             trainable_params = [p for p, t in zip(param_datas,
                                                   self._trainable) if t]
-            (loss, new_buffers), grads = jax.value_and_grad(
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(trainable_params)
+
+            found_inf = None
+            new_scaler_state = scaler_state
+            if scaling:
+                from paddle_tpu import amp as _amp
+
+                grads, found_inf = _amp.scaler_unscale_and_check(
+                    list(grads), scaler_state)
+                new_scaler_state = _amp.scaler_update_state(
+                    self._scaler, scaler_state, found_inf)
 
             clip_fn = getattr(optimizer._grad_clip, "clip_fn", None)
             if clip_fn is not None:
@@ -240,21 +257,28 @@ class ParallelTrainStep:
                 np_, ns = optimizer._rule(param_datas[i], g, slot_list[i],
                                           lr, step)
                 optimizer._current_decay_enabled = True
+                if found_inf is not None:
+                    np_ = jnp.where(found_inf, param_datas[i], np_)
+                    ns = {k: jnp.where(found_inf, slot_list[i][k], v)
+                          for k, v in ns.items()}
                 new_params[i] = np_
                 new_slots[i] = ns
             set_current_mesh(None)
-            return loss, new_params, new_slots, new_buffers
+            return loss, new_params, new_slots, new_buffers, \
+                new_scaler_state
 
         self._step_fn = step_fn
         self._jitted = None  # built lazily at first call (needs batch avals)
 
     def _build_jit(self, batch_datas):
+        scaler_sh = self._repl if self._scaler_state is not None else None
         in_shardings = (
             self._param_sh,
             [{k: self._slot_sh[i] for k in s} for i, s in
              enumerate(self._slots)],
             [self._repl] * len(self._buffers),
             self._repl, self._repl, self._repl,
+            scaler_sh,
             *[self._batch_sharding(b.ndim) for b in batch_datas],
         )
         out_shardings = (
@@ -263,6 +287,7 @@ class ParallelTrainStep:
             [{k: self._slot_sh[i] for k in s} for i, s in
              enumerate(self._slots)],
             [self._repl] * len(self._buffers),
+            scaler_sh,
         )
         self._jitted = jax.jit(self._step_fn,
                                in_shardings=in_shardings,
@@ -287,9 +312,9 @@ class ParallelTrainStep:
         buffer_datas = [b._data for b in self._buffers]
         set_current_mesh(self._mesh)
         try:
-            loss, new_params, new_slots, new_buffers = self._jitted(
-                param_datas, self._slots, buffer_datas, step, lr, key,
-                *datas)
+            loss, new_params, new_slots, new_buffers, new_scaler_state = \
+                self._jitted(param_datas, self._slots, buffer_datas, step,
+                             lr, key, self._scaler_state, *datas)
         finally:
             set_current_mesh(None)
         for p, np_ in zip(self._params, new_params):
@@ -299,4 +324,9 @@ class ParallelTrainStep:
         self._slots = new_slots
         for p, s in zip(self._params, new_slots):
             self._opt._slots[id(p)] = s
+        if new_scaler_state is not None:
+            from paddle_tpu import amp as _amp
+
+            self._scaler_state = new_scaler_state
+            _amp.scaler_sync_from_state(self._scaler, new_scaler_state)
         return Tensor._from_data(loss)

@@ -58,8 +58,12 @@ class LayerDesc:
 
 class SharedLayerDesc(LayerDesc):
     """Weight-shared layer across stages (reference pp_layers.py:76) —
-    e.g. tied embeddings. In the replicated-embed TPU design the embedding
-    lives outside the pipeline body, so sharing is just reusing the module.
+    e.g. tied embedding + lm head. All SharedLayerDescs with the same
+    ``key`` share one Parameter object (``shared_weight_attr``); the
+    optional ``forward_func(layer, x)`` overrides forward for secondary
+    uses (e.g. x @ embedding.T for the head). pp_engine detects the
+    shared Parameter across pre/post sections, accumulates both uses'
+    gradients into one update, and keeps the copies bitwise identical.
     """
 
     def __init__(self, key, layer_func, forward_func=None,
@@ -68,6 +72,20 @@ class SharedLayerDesc(LayerDesc):
         self.layer_name = key
         self.forward_func = forward_func
         self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedForwardAdapter(Layer):
+    """Wraps a shared layer so forward_func(layer, x) drives forward."""
+
+    def __init__(self, layer, forward_func):
+        super().__init__()
+        self.inner = layer
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self.inner, *args, **kwargs)
+        return self.inner(*args, **kwargs)
 
 
 class PipelineLayer(Layer):
@@ -86,33 +104,62 @@ class PipelineLayer(Layer):
         self.num_stages = num_stages or (
             topology.get_dim("pipe") if topology else 1)
         self.recompute_interval = recompute_interval
+        self.shared_layers = {}  # key -> first-built layer (weight owner)
 
         built = []
         descs = []
         for item in layers:
-            if isinstance(item, LayerDesc):
+            if isinstance(item, SharedLayerDesc):
+                # shared layers live in pre/post (replicated sections):
+                # build now, tying same-key weights to the first instance
+                descs.append(None)
+                built.append(self._build_shared(item))
+            elif isinstance(item, LayerDesc):
                 descs.append(item)
                 built.append(None)
             else:
                 descs.append(None)
                 built.append(item)
 
-        # find the longest homogeneous run of LayerDescs = pipeline body
-        best = (0, 0)
-        i = 0
-        while i < len(descs):
-            if descs[i] is None:
-                i += 1
-                continue
+        def _homog_run(i):
             j = i
             while (j < len(descs) and descs[j] is not None
                    and descs[j].layer_func is descs[i].layer_func
                    and descs[j].inputs == descs[i].inputs
                    and descs[j].kwargs == descs[i].kwargs):
                 j += 1
-            if j - i > best[1] - best[0]:
-                best = (i, j)
-            i = j
+            return j
+
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            # reference seg_method="layer:Block": the body is the run of
+            # LayerDescs whose class name matches (pp_layers.py:257)
+            want = seg_method.split(":", 1)[1]
+            best = (0, 0)
+            i = 0
+            while i < len(descs):
+                if descs[i] is None or \
+                        getattr(descs[i].layer_func, "__name__", "") != want:
+                    i += 1
+                    continue
+                j = _homog_run(i)
+                if j - i > best[1] - best[0]:
+                    best = (i, j)
+                i = j
+            if best == (0, 0):
+                raise ValueError(
+                    f"seg_method {seg_method!r} matched no LayerDesc run")
+        else:
+            # uniform: the longest homogeneous run of LayerDescs
+            best = (0, 0)
+            i = 0
+            while i < len(descs):
+                if descs[i] is None:
+                    i += 1
+                    continue
+                j = _homog_run(i)
+                if j - i > best[1] - best[0]:
+                    best = (i, j)
+                i = j
         self._body_range = best
         b0, b1 = best
         self.n_body_layers = b1 - b0
@@ -136,6 +183,28 @@ class PipelineLayer(Layer):
         self.post_layers = LayerList(
             [built[k] if built[k] is not None else descs[k].build_layer()
              for k in range(b1, len(descs))])
+
+    def _build_shared(self, desc: SharedLayerDesc):
+        layer = desc.build_layer()
+        owner = self.shared_layers.get(desc.layer_name)
+        if owner is None:
+            self.shared_layers[desc.layer_name] = layer
+        else:
+            # tie: point this instance's weight at the owner's Parameter
+            attr = desc.shared_weight_attr
+            shared = None
+            for holder in (owner, getattr(owner, "inner", None)):
+                if holder is not None and hasattr(holder, attr):
+                    shared = getattr(holder, attr)
+                    break
+            if shared is None:
+                raise ValueError(
+                    f"shared key {desc.layer_name!r}: owner has no "
+                    f"attribute {attr!r}")
+            setattr(layer, attr, shared)
+        if desc.forward_func is not None:
+            return _SharedForwardAdapter(layer, desc.forward_func)
+        return layer
 
     # eager forward: plain sequential execution (single-device semantics)
     def forward(self, x):

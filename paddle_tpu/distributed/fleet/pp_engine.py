@@ -56,6 +56,13 @@ class PipelineTrainStep:
             _functionalize_layerlist(pipe_layer.pre_layers)
         self._post_apply, (_, self._post_params), (_, self._post_buffers) = \
             _functionalize_layerlist(pipe_layer.post_layers)
+        # tied weights (SharedLayerDesc): the same Parameter object in both
+        # pre and post — use ONE traced value so both uses' grads
+        # accumulate, update once, and mirror the result into post.
+        pre_ids = {id(p): i for i, p in enumerate(self._pre_params)}
+        self._shared_post = {
+            j: pre_ids[id(p)] for j, p in enumerate(self._post_params)
+            if id(p) in pre_ids}
 
         body = list(pipe_layer.body_layers)
         self._body_template_apply, (_, tmpl_params), (_, tmpl_buf) = \
@@ -141,8 +148,13 @@ class PipelineTrainStep:
                     pre_b, post_b, step, lr, key, x, y):
             set_current_mesh(mesh)
 
+            shared_post = self._shared_post
+
             def loss_of(diff):
                 pre_pd, body_pd, post_pd = diff
+                if shared_post:
+                    post_pd = [pre_pd[shared_post[j]] if j in shared_post
+                               else p for j, p in enumerate(post_pd)]
                 k1, k2, k3 = jax.random.split(key, 3)
                 h, new_pre_b = pre_apply(pre_pd, pre_b, k1, x)
                 # microbatch: [B, ...] -> [M, B/M, ...]
@@ -188,9 +200,13 @@ class PipelineTrainStep:
                 g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
                 g_post = flat[len(g_pre) + len(g_body):]
 
-            def upd(ps, gs, ss):
+            def upd(ps, gs, ss, skip=()):
                 nps, nss = [], []
-                for p, g, s in zip(ps, gs, ss):
+                for i, (p, g, s) in enumerate(zip(ps, gs, ss)):
+                    if i in skip:  # tied copy: mirrored after pre update
+                        nps.append(p)
+                        nss.append(s)
+                        continue
                     np_, ns = opt._rule(p, g, s, lr, step)
                     nps.append(np_)
                     nss.append(ns)
@@ -198,7 +214,10 @@ class PipelineTrainStep:
 
             npre, npre_s = upd(pre_p, g_pre, pre_s)
             nbody, nbody_s = upd(body_p, g_body, body_s)
-            npost, npost_s = upd(post_p, g_post, post_s)
+            npost, npost_s = upd(post_p, g_post, post_s,
+                                 skip=set(shared_post))
+            for j, i in shared_post.items():
+                npost[j] = npre[i]
             set_current_mesh(None)
             return (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
                     new_pre_b, new_post_b)

@@ -32,6 +32,18 @@ class DataParallel(Layer):
 
     @contextlib.contextmanager
     def no_sync(self):
+        """Accumulate gradients without cross-rank synchronization.
+
+        In the reference, backward() triggers the EagerReducer's bucketed
+        allreduce and no_sync suppresses it. Here gradient synchronization
+        only ever happens inside a compiled step (XLA inserts the
+        reduction); an eager ``backward()`` accumulates purely local
+        grads, so within no_sync the semantics the reference promises —
+        local accumulation, sync deferred to the next synced step — hold
+        by construction. The context manager therefore only flips the
+        bookkeeping flag; ``tests/test_advice_fixes.py`` pins the
+        accumulation semantics.
+        """
         self._grad_sync_enabled = False
         try:
             yield

@@ -1,0 +1,6 @@
+"""incubate — fused / experimental APIs.
+
+Reference: python/paddle/incubate/ (nn/functional fused ops, MoE under
+incubate/distributed/models/moe)."""
+from paddle_tpu.incubate import moe  # noqa: F401
+from paddle_tpu.incubate import nn  # noqa: F401

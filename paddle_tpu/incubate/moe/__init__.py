@@ -1,0 +1,5 @@
+from paddle_tpu.incubate.moe.moe_layer import (  # noqa: F401
+    GShardGate, MoELayer, NaiveGate, SwitchGate,
+)
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate"]

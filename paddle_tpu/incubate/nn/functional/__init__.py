@@ -1,0 +1,81 @@
+"""Fused-op python APIs.
+
+Reference: python/paddle/incubate/nn/functional/
+(fused_rotary_position_embedding.py, fused_rms_norm.py, swiglu.py).
+On TPU "fused" means: expressed as one registry op whose body XLA fuses
+into neighboring matmuls — no custom kernel needed for these
+bandwidth-bound elementwise chains.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import registry as _registry
+from paddle_tpu.ops.registry import register_emitter as _register
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm", "swiglu"]
+
+
+@_register(name="swiglu")
+def _swiglu_emitter(x, y=None):
+    """silu(x) * y; with y=None, x is split in half on the last axis
+    (reference swiglu.py semantics)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@_register(name="fused_rms_norm")
+def _fused_rms_norm_emitter(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                            begin_norm_axis=-1):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype) * norm_weight
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+if "swiglu" not in _registry.OPS:
+    _registry.build_registry([
+        {"op": "swiglu", "tensor_args": ["x", "y"], "methods": []},
+        {"op": "fused_rms_norm",
+         "tensor_args": ["x", "norm_weight", "norm_bias"], "methods": []},
+    ])
+
+
+def swiglu(x, y=None):
+    return _registry.API["swiglu"](x, y)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    return _registry.API["fused_rms_norm"](x, norm_weight, norm_bias,
+                                           epsilon=epsilon,
+                                           begin_norm_axis=begin_norm_axis)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """Rotary embedding on [B, S, H, D] tensors (reference
+    fused_rotary_position_embedding.py). Reuses the rope_apply op the
+    Llama model registers; v passes through rotated like k when given."""
+    from paddle_tpu.models import llama  # registers rope_apply  # noqa
+
+    if cos is None or sin is None:
+        raise ValueError("cos/sin tables are required")
+    # tables may be [S, D] or [1, S, 1, D]
+    def squeeze(t):
+        d = t._data if hasattr(t, "_data") else t
+        return t.reshape([d.shape[-3], d.shape[-1]]) if d.ndim == 4 else t
+
+    cos2, sin2 = squeeze(cos), squeeze(sin)
+    if k is None:
+        q2, _ = _registry.API["rope_apply"](q, q, cos2, sin2)
+        return q2, None, None
+    q2, k2 = _registry.API["rope_apply"](q, k, cos2, sin2)
+    v2 = v
+    return q2, k2, v2

