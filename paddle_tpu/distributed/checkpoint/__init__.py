@@ -32,8 +32,15 @@ from paddle_tpu.distributed.checkpoint.metadata import (
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata"]
 
-_DATA_FILE = "data_0.npz"
 _META_FILE = "metadata.json"
+
+
+def _data_file(process_index=None):
+    """Per-process data file so multi-host saves never collide
+    (reference uses {rank}_{id}.distcp)."""
+    if process_index is None:
+        process_index = jax.process_index()
+    return f"data_{int(process_index)}.npz"
 
 
 def _flatten(d, prefix=""):
@@ -95,8 +102,16 @@ def _offsets_from_index(index, shape):
 
 def save_state_dict(state_dict: Dict, path: str):
     """Write a (possibly nested) state dict of (possibly sharded) tensors
-    as unique chunks + manifest under directory ``path``."""
+    as unique chunks + manifest under directory ``path``.
+
+    Multi-host: every process writes its addressable shards to its own
+    ``data_{process_index}.npz`` (no filename collisions — reference uses
+    {rank}_{id}.distcp) plus a per-process metadata part; process 0 then
+    merges the parts into the global manifest after a barrier."""
     os.makedirs(path, exist_ok=True)
+    pidx = jax.process_index()
+    pcount = jax.process_count()
+    data_file = _data_file(pidx)
     flat = _flatten(state_dict)
     arrays = {}
     tensors_meta = {}
@@ -121,7 +136,7 @@ def save_state_dict(state_dict: Dict, path: str):
                 key = f"{name}__c{ci}"
                 arrays[key] = stor
                 chunks.append(LocalTensorMetadata(
-                    off, tuple(int(s) for s in loc.shape), _DATA_FILE,
+                    off, tuple(int(s) for s in loc.shape), data_file,
                     key))
                 ci += 1
             logical_dt = dt if chunks else str(data.dtype)
@@ -132,18 +147,46 @@ def save_state_dict(state_dict: Dict, path: str):
             arrays[key] = stor
             chunks.append(LocalTensorMetadata(
                 (0,) * loc.ndim, tuple(int(s) for s in loc.shape),
-                _DATA_FILE, key))
+                data_file, key))
         tensors_meta[name] = TensorMetadata(gshape, logical_dt, chunks)
-    np.savez(os.path.join(path, _DATA_FILE), **arrays)
-    Metadata(tensors_meta).save(os.path.join(path, _META_FILE))
+    np.savez(os.path.join(path, data_file), **arrays)
+    if pcount == 1:
+        Metadata(tensors_meta).save(os.path.join(path, _META_FILE))
+        return
+    # multi-host: write per-process part, barrier, merge on process 0
+    Metadata(tensors_meta).save(
+        os.path.join(path, f"metadata_part{pidx}.json"))
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    if pidx == 0:
+        merged = {}
+        for p in range(pcount):
+            part = Metadata.load(
+                os.path.join(path, f"metadata_part{p}.json"))
+            for name, tm in part.tensors.items():
+                if name not in merged:
+                    merged[name] = tm
+                    continue
+                have = {c.global_offset for c in merged[name].chunks}
+                for c in tm.chunks:
+                    if c.global_offset not in have:
+                        merged[name].chunks.append(c)
+                        have.add(c.global_offset)
+        Metadata(merged).save(os.path.join(path, _META_FILE))
+    multihost_utils.sync_global_devices(f"ckpt_save_done:{path}")
 
 
-def _assemble_slice(npz, meta: TensorMetadata, index):
-    """Assemble the requested global slice from saved chunks."""
+def _assemble_slice(get_npz, meta: TensorMetadata, index):
+    """Assemble the requested global slice from saved chunks; raises
+    unless the chunks exactly tile the requested region (a lost shard
+    file must not silently yield uninitialized memory)."""
     starts = [0 if sl.start is None else int(sl.start) for sl in index]
     stops = [dim if sl.stop is None else int(sl.stop)
              for sl, dim in zip(index, meta.global_shape)]
     shape = [b - a for a, b in zip(starts, stops)]
+    total = int(np.prod(shape)) if shape else 1
+    covered = 0
     out = None
     for ch in meta.chunks:
         c_starts = list(ch.global_offset)
@@ -153,7 +196,7 @@ def _assemble_slice(npz, meta: TensorMetadata, index):
         hi = [min(b, cb) for b, cb in zip(stops, c_stops)]
         if any(l >= h for l, h in zip(lo, hi)) and shape:
             continue
-        chunk = _np_restore(npz[ch.key], meta.dtype)
+        chunk = _np_restore(get_npz(ch.file)[ch.key], meta.dtype)
         if out is None:
             out = np.empty(shape, dtype=chunk.dtype)
         if not shape:  # 0-d
@@ -163,8 +206,13 @@ def _assemble_slice(npz, meta: TensorMetadata, index):
         src = tuple(slice(l - ca, h - ca)
                     for l, h, ca in zip(lo, hi, c_starts))
         out[dst] = chunk[src]
+        covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
     if out is None:
         raise ValueError("no saved chunks cover the requested slice")
+    if covered < total:
+        raise ValueError(
+            f"saved chunks cover only {covered}/{total} elements of the "
+            f"requested slice (missing shard file?)")
     return out
 
 
@@ -173,7 +221,13 @@ def load_state_dict(state_dict: Dict, path: str):
     ``path``, resharding each tensor to its CURRENT sharding (whatever
     mesh/placements the destination tensors live on)."""
     meta = Metadata.load(os.path.join(path, _META_FILE))
-    npz = np.load(os.path.join(path, _DATA_FILE))
+    _npz_cache = {}
+
+    def get_npz(fname):
+        if fname not in _npz_cache:
+            _npz_cache[fname] = np.load(os.path.join(path, fname))
+        return _npz_cache[fname]
+
     flat = _flatten(state_dict)
     missing = []
     for name, v in flat.items():
@@ -190,10 +244,10 @@ def load_state_dict(state_dict: Dict, path: str):
         if sharding is not None:
             new = jax.make_array_from_callback(
                 tm.global_shape, sharding,
-                lambda idx, _tm=tm: _assemble_slice(npz, _tm, idx))
+                lambda idx, _tm=tm: _assemble_slice(get_npz, _tm, idx))
         else:
             full = _assemble_slice(
-                npz, tm, tuple(slice(0, s) for s in tm.global_shape))
+                get_npz, tm, tuple(slice(0, s) for s in tm.global_shape))
             new = jnp.asarray(full)
         new = new.astype(data.dtype)
         if isinstance(v, Tensor):

@@ -140,6 +140,7 @@ class Model:
 
         cbks.call("on_train_begin", {})
         history = []
+        logs = {}
         for epoch in range(epochs):
             cbks.call("on_epoch_begin", epoch, {})
             logs = {}

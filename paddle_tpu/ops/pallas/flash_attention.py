@@ -47,7 +47,7 @@ def _vmem_spec(shape=None, index_map=None):
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                n_k):
+                n_k, mask_off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -57,8 +57,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: skip blocks strictly above the diagonal
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    # causal (bottom-right aligned for sq != sk): skip blocks strictly
+    # above the shifted diagonal row + mask_off >= col
+    run = ((qi * block_q + block_q - 1 + mask_off >= ki * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _():
@@ -69,7 +71,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
+            row = qi * block_q + mask_off + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -106,7 +108,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     grid = (bh, n_q, n_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_k=n_k)
+        block_k=block_k, n_k=n_k, mask_off=sk - sq)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -137,7 +139,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, n_k):
+                   dq_scr, *, scale, causal, block_q, block_k, n_k,
+                   mask_off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -145,7 +148,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    run = ((qi * block_q + block_q - 1 + mask_off >= ki * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _():
@@ -159,7 +163,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
+            row = qi * block_q + mask_off + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -180,7 +184,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, n_q):
+                    block_q, block_k, n_q, mask_off):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -189,7 +193,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    run = ((qi * block_q + block_q - 1 + mask_off >= ki * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _():
@@ -203,7 +208,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
+            row = qi * block_q + mask_off + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -238,7 +243,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_k=n_k),
+                          block_q=block_q, block_k=block_k, n_k=n_k,
+                          mask_off=sk - sq),
         grid=(bh, n_q, n_k),
         in_specs=[
             _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -256,7 +262,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_q=n_q),
+                          block_q=block_q, block_k=block_k, n_q=n_q,
+                          mask_off=sk - sq),
         grid=(bh, n_k, n_q),
         in_specs=[
             _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -311,13 +318,16 @@ def _make_flash(scale, causal, block_q, block_k, interpret):
 def available(seq_len=None, block_q=DEFAULT_BLOCK_Q,
               block_k=DEFAULT_BLOCK_K):
     """Whether the Pallas kernel path applies: native on TPU, interpret
-    elsewhere; sequence must tile evenly."""
+    elsewhere; sequence must tile evenly into blocks that satisfy TPU
+    sublane tiling (block a multiple of 8)."""
     if pltpu is None:
         return False
     if seq_len is not None:
         bq = min(block_q, seq_len)
         bk = min(block_k, seq_len)
         if seq_len % bq or seq_len % bk:
+            return False
+        if bq % 8 or bk % 8:
             return False
     return True
 

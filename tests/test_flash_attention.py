@@ -20,7 +20,8 @@ def _sdpa_ref(q, k, v, causal):
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
     if causal:
         sq, sk = s.shape[-2:]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        # bottom-right aligned (reference FA2 semantics for sq != sk)
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
@@ -40,6 +41,36 @@ def test_flash_forward_matches_reference(causal, shape):
     ref = _sdpa_ref(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("sq,sk", [(64, 128), (128, 256), (64, 256)])
+def test_flash_causal_cross_length_bottom_right(sq, sk):
+    """ADVICE r2 (high): causal mask must be bottom-right aligned when
+    q_seq != k_seq, matching the SDPA fallback and FA2 semantics."""
+    b, h, d = 1, 2, 32
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(b, sq, h, d), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk, h, d), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk, h, d), dtype=jnp.float32)
+    out = fa.flash_attention_data(q, k, v, causal=True, block_q=64,
+                                  block_k=64, interpret=True)
+    ref = _sdpa_ref(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    def f_flash(q, k, v):
+        return jnp.sum(fa.flash_attention_data(
+            q, k, v, causal=True, block_q=64, block_k=64,
+            interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, True) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-4)
 
 
 @pytest.mark.parametrize("causal", [False, True])

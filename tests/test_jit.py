@@ -86,7 +86,9 @@ def test_jit_save_load(tmp_path):
     m = nn.Linear(3, 2)
     path = str(tmp_path / "model")
     paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 3])])
-    payload = paddle.jit.load(path)
-    assert "state_dict" in payload
-    assert "stablehlo" in payload
-    assert "weight" in payload["state_dict"]
+    loaded = paddle.jit.load(path)
+    assert isinstance(loaded, paddle.jit.TranslatedLayer)
+    assert "weight" in loaded.state_dict()
+    x = paddle.randn([1, 3])
+    np.testing.assert_allclose(m(x).numpy(), loaded(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
