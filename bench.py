@@ -3,47 +3,40 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-Configs measured (BASELINE.md):
-  #1 ResNet-50 on CIFAR-10-shaped synthetic data, whole-step compiled
-     (TrainStep) — images/sec.  Primary metric.
-  small-GPT (Llama architecture) LM pretraining step, compiled —
-     tokens/sec/chip.  Reported in "extra".
+Primary metric (BASELINE.md north star, single-chip proxy for gate #4):
+  ~1B-param GPT (Llama architecture) LM pretraining, whole step compiled,
+  bf16 params/compute, Pallas flash attention — tokens/sec/chip and MFU.
+  ``vs_baseline`` = measured MFU / 0.45 (the north-star ≥45%-MFU gate):
+  >= 1.0 means the gate is met. This replaces the round-2 self-picked
+  throughput bars, which VERDICT.md correctly called vanity ratios.
 
-The reference repo publishes no absolute perf numbers (BASELINE.md), so
-``vs_baseline`` is measured against self-defined targets below — chosen as
-single-accelerator parity bars for the reference's GPU-class hardware.
+Also measured (reported in "extra"):
+  ResNet-50 on CIFAR-10-shaped data, whole-step compiled — images/sec
+  (BASELINE config #1), and the round-2 small-GPT config for continuity.
+
+Timing notes: every timed region ends with a host fetch of the loss
+(``float(loss)``) — on remote-tunneled backends ``block_until_ready`` can
+return before the device queue drains, which silently inflates throughput.
 """
 from __future__ import annotations
 
 import json
 import time
 
-# Self-defined targets (reference publishes none — BASELINE.md).
-TARGET_RESNET50_IMG_PER_SEC = 1000.0   # V100-class CIFAR ResNet-50 bar
-TARGET_GPT_TOKENS_PER_SEC = 20000.0    # small-GPT (~60M) single-chip bar
+MFU_GATE = 0.45  # BASELINE gate #4: >= 45% MFU
 
 
-def _sync(x):
-    import jax
-
-    jax.block_until_ready(x._data if hasattr(x, "_data") else x)
-
-
-def _timed_steps(step_fn, min_steps=5, budget_s=30.0):
-    """Run warmup (compile) then time steps until budget; return steps/sec."""
-    for _ in range(2):
-        _sync(step_fn())
+def _timed_steps(step_fn, warmup=2, steps=10):
+    """Compile + warm up, then time `steps` steps; host-fetch the last
+    loss to force the device queue to drain. Returns steps/sec."""
+    for _ in range(warmup):
+        float(step_fn()._data)
     t0 = time.perf_counter()
-    n = 0
-    while True:
-        _sync(step_fn())
-        n += 1
-        dt = time.perf_counter() - t0
-        if n >= min_steps and dt > budget_s:
-            break
-        if n >= 200:
-            break
-    return n / (time.perf_counter() - t0)
+    loss = None
+    for _ in range(steps):
+        loss = step_fn()
+    float(loss._data)
+    return steps / (time.perf_counter() - t0)
 
 
 def bench_resnet50(batch=64):
@@ -54,19 +47,18 @@ def bench_resnet50(batch=64):
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
+    paddle.set_default_dtype("float32")
     model = resnet50(num_classes=10)
     opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                              parameters=model.parameters())
     step = paddle.jit.TrainStep(model, nn.CrossEntropyLoss(), opt)
     rng = np.random.RandomState(0)
-    X = paddle.to_tensor(
-        rng.randn(batch, 3, 32, 32).astype(np.float32))
+    X = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
     Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
-    sps = _timed_steps(lambda: step(X, Y), budget_s=20.0)
-    return sps * batch
+    return _timed_steps(lambda: step(X, Y), steps=40) * batch
 
 
-def bench_gpt(batch=8, seq=512):
+def bench_gpt_small(batch=8, seq=512):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -76,6 +68,7 @@ def bench_gpt(batch=8, seq=512):
     )
 
     paddle.seed(0)
+    paddle.set_default_dtype("float32")
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=512, intermediate_size=1408,
         num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=8,
@@ -88,25 +81,69 @@ def bench_gpt(batch=8, seq=512):
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     Y = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
-    sps = _timed_steps(lambda: step(X, Y), budget_s=20.0)
-    return sps * batch * seq
+    return _timed_steps(lambda: step(X, Y), steps=20) * batch * seq
+
+
+def bench_gpt_1b(batch=4, seq=2048):
+    """~0.95B-param Llama-architecture GPT, bf16, flash attention, no
+    remat (fits v5e HBM at batch 4), AdamW. The chip-saturating config:
+    measured 2026-07 on v5e at ~22.4K tokens/s = ~69% MFU."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, profiler
+    from paddle_tpu.models.llama import (
+        LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+    )
+
+    paddle.seed(0)
+    paddle.set_default_dtype("bfloat16")
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=seq,
+        use_flash_attention=True)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, LlamaPretrainingCriterion(cfg), opt)
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    Y = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    sps = _timed_steps(lambda: step(X, Y), steps=20)
+    tokens_per_sec = sps * batch * seq
+    # model FLOPs (PaLM accounting): 6N per token + causal attention
+    # 12*L*h*s*0.5 per token; recompute is off so no remat multiplier
+    flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq
+    mfu = profiler.estimate_mfu(flops_per_token * batch * seq, 1.0 / sps)
+    paddle.set_default_dtype("float32")
+    return tokens_per_sec, mfu, n_params
 
 
 def main():
     import jax
 
     backend = jax.default_backend()
+    tok_1b, mfu, n_params = bench_gpt_1b()
     img_s = bench_resnet50()
-    tok_s = bench_gpt()
+    tok_small = bench_gpt_small()
     print(json.dumps({
-        "metric": "resnet50_cifar10_images_per_sec",
-        "value": round(img_s, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(img_s / TARGET_RESNET50_IMG_PER_SEC, 4),
+        "metric": "gpt_1b_bf16_tokens_per_sec_chip",
+        "value": round(tok_1b, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / MFU_GATE, 4),
         "extra": {
             "backend": backend,
-            "gpt_small_tokens_per_sec_chip": round(tok_s, 1),
-            "gpt_vs_target": round(tok_s / TARGET_GPT_TOKENS_PER_SEC, 4),
+            "gpt_1b_mfu": round(mfu, 4),
+            "gpt_1b_params": n_params,
+            "gpt_1b_config": "h2048 L16 a16 v32000 seq2048 batch4 bf16 "
+                             "flash-attn adamw",
+            "mfu_gate": MFU_GATE,
+            "resnet50_cifar10_images_per_sec": round(img_s, 1),
+            "gpt_small_tokens_per_sec_chip": round(tok_small, 1),
         },
     }))
 

@@ -175,7 +175,7 @@ class ParallelTrainStep:
         for p, sh in zip(self._params, self._slot_sh):
             s = optimizer._slots.get(id(p))
             if s is None:
-                s = optimizer._init_slots(p._data)
+                s = optimizer._init_slots_mp(p._data)
             s = {k: jax.device_put(v, sh) for k, v in s.items()}
             optimizer._slots[id(p)] = s
             self._slots.append(s)
@@ -254,8 +254,8 @@ class ParallelTrainStep:
                 gi += 1
                 optimizer._current_decay_enabled = optimizer._decay_enabled(
                     self._params[i])
-                np_, ns = optimizer._rule(param_datas[i], g, slot_list[i],
-                                          lr, step)
+                np_, ns = optimizer._rule_mp(param_datas[i], g,
+                                             slot_list[i], lr, step)
                 optimizer._current_decay_enabled = True
                 if found_inf is not None:
                     np_ = jnp.where(found_inf, param_datas[i], np_)

@@ -111,13 +111,13 @@ class PipelineTrainStep:
         if optimizer._parameter_list is None:
             optimizer._parameter_list = list(self._pre_params) + \
                 list(self._post_params)
-        self._pre_slots = [optimizer._init_slots(p._data)
+        self._pre_slots = [optimizer._init_slots_mp(p._data)
                            for p in self._pre_params]
-        self._post_slots = [optimizer._init_slots(p._data)
+        self._post_slots = [optimizer._init_slots_mp(p._data)
                             for p in self._post_params]
         self._body_slots = [
             {k: jax.device_put(v, sh) for k, v in
-             optimizer._init_slots(s).items()}
+             optimizer._init_slots_mp(s).items()}
             for s, sh in zip(self._stacked_body, self._body_sh)]
 
         self._jitted = None
@@ -207,7 +207,7 @@ class PipelineTrainStep:
                         nps.append(p)
                         nss.append(s)
                         continue
-                    np_, ns = opt._rule(p, g, s, lr, step)
+                    np_, ns = opt._rule_mp(p, g, s, lr, step)
                     nps.append(np_)
                     nss.append(ns)
                 return nps, nss

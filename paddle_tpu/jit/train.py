@@ -45,7 +45,7 @@ class TrainStep:
         for p in self._params:
             s = optimizer._slots.get(id(p))
             if s is None:
-                s = optimizer._init_slots(p._data)
+                s = optimizer._init_slots_mp(p._data)
                 optimizer._slots[id(p)] = s
             self._slots.append(s)
         self._trainable = [not p.stop_gradient for p in self._params]
@@ -98,8 +98,8 @@ class TrainStep:
                 # per-param decay exclusion is trace-time static
                 optimizer._current_decay_enabled = optimizer._decay_enabled(
                     self._params[i])
-                np_, ns = optimizer._rule(param_datas[i], g, slot_list[i],
-                                          lr, step)
+                np_, ns = optimizer._rule_mp(param_datas[i], g,
+                                             slot_list[i], lr, step)
                 optimizer._current_decay_enabled = True
                 if found_inf is not None:
                     # skip the update on overflow (reference GradScaler.step)

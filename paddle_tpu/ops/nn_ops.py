@@ -665,6 +665,12 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
                                return_softmax=False):
+    # low-precision logits: softmax reduction in f32 (reference computes
+    # softmax in fp32 for fp16/bf16 inputs — softmax_kernel.cu via
+    # MPTypeTrait); the returned loss is f32, which is what training wants
+    if jnp.issubdtype(jnp.asarray(logits).dtype, jnp.floating) and \
+            jnp.dtype(jnp.asarray(logits).dtype).itemsize < 4:
+        logits = jnp.asarray(logits, jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         loss = -jnp.sum(jnp.asarray(label, logp.dtype) * logp, axis=axis,

@@ -40,6 +40,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._slots: Dict[int, dict] = {}
         self._step_count = 0
+        self._multi_precision = bool(multi_precision)
 
     # -- lr ----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -63,6 +64,45 @@ class Optimizer:
 
     def _rule(self, p, g, slots, lr, step):
         raise NotImplementedError
+
+    # -- dtype-stable / multi-precision wrappers (all call sites use these) --
+    _multi_precision = False
+
+    def _init_slots_mp(self, p) -> dict:
+        """_init_slots plus, under multi_precision, an f32 master-weight
+        slot for low-precision params (reference optimizer.py
+        _multi_precision / master weights: python/paddle/optimizer/
+        optimizer.py _create_master_weight)."""
+        if self._multi_precision and jnp.issubdtype(p.dtype, jnp.floating) \
+                and jnp.dtype(p.dtype).itemsize < 4:
+            # moments/accumulators are created from the f32 master copy so
+            # they accumulate in f32 (reference MPDType); bf16 moments
+            # would freeze once (1-beta2)*g^2 drops below the bf16 quantum
+            master = p.astype(jnp.float32)
+            slots = self._init_slots(master)
+            slots["master_weight"] = master
+            return slots
+        return self._init_slots(p)
+
+    def _rule_mp(self, p, g, slots, lr, step):
+        """dtype-stable _rule: the updated param/slots keep their stored
+        dtypes even when the rule computes in f32 (bf16 params must stay
+        bf16 across steps or every jit step retraces), and the update is
+        applied to the f32 master weight when one exists."""
+        mw = slots.get("master_weight")
+        if mw is not None:
+            inner = {k: v for k, v in slots.items() if k != "master_weight"}
+            new_mw, ns = self._rule(mw, g.astype(mw.dtype), inner, lr, step)
+            ns = {k: (v.astype(inner[k].dtype)
+                      if k in inner and hasattr(v, "astype") else v)
+                  for k, v in ns.items()}
+            ns["master_weight"] = new_mw.astype(jnp.float32)
+            return new_mw.astype(p.dtype), ns
+        new_p, ns = self._rule(p, g, slots, lr, step)
+        ns = {k: (v.astype(slots[k].dtype)
+                  if k in slots and hasattr(v, "astype") else v)
+              for k, v in ns.items()}
+        return new_p.astype(p.dtype), ns
 
     # weight decay applied as decoupled or L2 depending on optimizer.
     # _current_decay_enabled is set per-parameter before each _rule call
@@ -94,14 +134,14 @@ class Optimizer:
         for p, g in grads:
             slots = self._slots.get(id(p))
             if slots is None:
-                slots = self._init_slots(p._data)
+                slots = self._init_slots_mp(p._data)
                 self._slots[id(p)] = slots
             gdata = g._data if isinstance(g, Tensor) else g
             if gdata.dtype != p._data.dtype:
                 gdata = gdata.astype(p._data.dtype)
             self._current_decay_enabled = self._decay_enabled(p)
-            new_p, new_slots = self._rule(p._data, gdata, slots,
-                                          self.get_lr(), self._step_count)
+            new_p, new_slots = self._rule_mp(p._data, gdata, slots,
+                                             self.get_lr(), self._step_count)
             self._current_decay_enabled = True
             p._data = new_p
             self._slots[id(p)] = new_slots
@@ -171,6 +211,7 @@ class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
 
     def _rule(self, p, g, slots, lr, step):
         g = self._apply_weight_decay_to_grad(p, g)
@@ -182,6 +223,7 @@ class Momentum(Optimizer):
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._momentum = momentum
         self._nesterov = use_nesterov
 
@@ -203,6 +245,7 @@ class Adagrad(Optimizer):
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
 
@@ -221,6 +264,7 @@ class Adadelta(Optimizer):
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._eps = epsilon
         self._rho = rho
 
@@ -243,6 +287,7 @@ class RMSProp(Optimizer):
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._rho, self._eps = rho, epsilon
         self._momentum, self._centered = momentum, centered
 
@@ -276,7 +321,9 @@ class Adam(Optimizer):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = bool(multi_precision)
 
     def _init_slots(self, p):
         return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
@@ -312,6 +359,7 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip)
         self._apply_decay_param_fun = apply_decay_param_fun
+        self._multi_precision = bool(multi_precision)
 
     def _decoupled(self):
         return True
@@ -327,6 +375,7 @@ class Adamax(Optimizer):
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def _init_slots(self, p):
@@ -351,6 +400,7 @@ class Lamb(Optimizer):
                  name=None, **kw):
         super().__init__(learning_rate, parameters, lamb_weight_decay,
                          grad_clip)
+        self._multi_precision = bool(kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
 

@@ -112,3 +112,65 @@ def test_minimize_api():
     opt.minimize(loss)
     np.testing.assert_allclose(w.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-6)
     assert w.grad is None
+
+
+def test_bf16_param_dtype_stable_across_steps():
+    """bf16 params must stay bf16 after optimizer updates (the rule
+    computes in f32 internally); a silent f32 upcast retraces every
+    compiled step and doubles param HBM."""
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                         dtype="bfloat16", stop_gradient=False)
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[w])
+    for _ in range(3):
+        loss = (w.astype("float32") ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert w.dtype.name == "bfloat16"
+    slots = opt._slots[id(w)]
+    assert all(v.dtype == np.dtype("bfloat16") or str(v.dtype) == "bfloat16" for v in slots.values())
+
+
+def test_multi_precision_master_weights():
+    """multi_precision=True keeps an f32 master copy for bf16 params and
+    applies updates there (reference optimizer.py _create_master_weight)."""
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                         dtype="bfloat16", stop_gradient=False)
+    opt = optimizer.AdamW(learning_rate=0.05, parameters=[w],
+                          multi_precision=True)
+    for _ in range(120):
+        loss = (w.astype("float32") ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    slots = opt._slots[id(w)]
+    assert "master_weight" in slots
+    assert slots["master_weight"].dtype == np.float32
+    assert w.dtype.name == "bfloat16"
+    # master weights track the true trajectory; bf16 copy mirrors them
+    np.testing.assert_allclose(
+        np.asarray(slots["master_weight"]).astype(np.float32),
+        w.astype("float32").numpy(), rtol=1e-2, atol=1e-2)
+    assert np.abs(w.astype("float32").numpy()).max() < 1.0
+
+
+def test_trainstep_bf16_no_retrace():
+    """Compiled TrainStep with bf16 params: params/slots keep dtype so the
+    second step hits the jit cache (regression: bf16 1B bench retraced)."""
+    paddle.set_default_dtype("bfloat16")
+    try:
+        model = nn.Linear(8, 8)
+        opt = optimizer.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, nn.MSELoss(), opt)
+        X = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32)
+                             ).astype("bfloat16")
+        Y = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32)
+                             ).astype("bfloat16")
+        step(X, Y)
+        p0 = model.parameters()[0]
+        assert p0.dtype.name == "bfloat16"
+        step(X, Y)
+        assert model.parameters()[0].dtype.name == "bfloat16"
+    finally:
+        paddle.set_default_dtype("float32")
