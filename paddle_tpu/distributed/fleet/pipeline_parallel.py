@@ -284,21 +284,17 @@ class PipelineParallel(Layer):
         self._mesh = hcg.mesh
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        if scaler is not None and scaler.is_enable():
-            raise NotImplementedError(
-                "loss scaling inside the compiled pipeline step is not "
-                "supported; train in bfloat16 (needs no scaling) or pass "
-                "GradScaler(enable=False)")
         x, y = data
         if self._step is None:
             from paddle_tpu.distributed.fleet.pp_engine import (
                 PipelineTrainStep,
             )
 
+            M = max(self.micro_batches, self.num_stages)
+            M += (-M) % self.num_stages  # round up to a chunk multiple
             self._step = PipelineTrainStep(
                 self._layers, self._layers.get_loss_fn(), optimizer,
-                self._mesh, n_microbatches=max(self.micro_batches,
-                                               self.num_stages))
+                self._mesh, n_microbatches=M, scaler=scaler)
         loss = self._step(x, y)
         if lr_scheduler is not None:
             lr_scheduler.step()

@@ -1,11 +1,28 @@
 """Compiled pipeline-parallel train step.
 
-Builds ONE XLA program for: replicated pre (embedding) → ppermute-rotated
-pipeline body over the ``pp`` mesh axis → replicated post (norm/head) →
-loss → backward → optimizer. dp/mp axes remain GSPMD-auto inside, so
-TP×PP×DP hybrid comes out of a single jit (reference equivalent: the whole
-of meta_parallel/pipeline_parallel.py + p2p_communication.py + the
+Builds ONE XLA program for: pre (embedding) → ppermute-rotated pipeline
+body over the ``pp`` mesh axis → post (norm/head) → loss → backward →
+optimizer. dp/mp axes remain GSPMD-auto inside, so TP×PP×DP hybrid comes
+out of a single jit (reference equivalent: the whole of
+meta_parallel/pipeline_parallel.py + p2p_communication.py + the
 interleaved schedules, SURVEY.md §2.3 PP row).
+
+Stage placement, TPU-style: the reference places the embedding on the
+first stage and the head on the last (pp_layers.py:257 segmentation) —
+an NCCL-topology artifact whose real goal is not replicating large
+vocab tensors on every pp rank. In a single SPMD program the idiomatic
+equivalent is sharding pre/post parameter STORAGE (and their optimizer
+slots) across the pp axis (``shard_pre_post``): XLA all-gathers weights
+on use and reduce-scatters their grads, so each pp rank holds 1/S of the
+embedding/head + slots — same HBM win, better load balance, and tied
+embeddings (SharedLayerDesc) keep working because both uses reference
+one sharded array.
+
+Activation memory: microbatches are processed in chunks of S via
+gradient accumulation inside the step (lax.scan of value_and_grad), so
+in-flight activations are capped at S microbatches — the 1F1B bound
+(reference pipeline_parallel.py:459) — regardless of accumulate_steps;
+remat on the body keeps per-tick residuals to the block inputs.
 """
 from __future__ import annotations
 
@@ -39,7 +56,10 @@ class PipelineTrainStep:
     def __init__(self, pipe_layer, loss_fn: Callable, optimizer,
                  mesh: ProcessMesh, n_microbatches: int = None,
                  pp_axis: str = "pp", dp_axis: str = "dp",
-                 remat_body: bool = True):
+                 remat_body: bool = True, scaler=None,
+                 shard_pre_post: bool = True):
+        from paddle_tpu import amp as _amp
+
         self._pipe = pipe_layer
         self._loss_fn = loss_fn
         self._opt = optimizer
@@ -48,8 +68,18 @@ class PipelineTrainStep:
         self._dp_axis = dp_axis
         self.S = mesh.get_dim_size(pp_axis) if pp_axis in mesh.dim_names \
             else 1
-        self.M = n_microbatches or self.S
+        M = n_microbatches or self.S
+        if M % self.S:
+            raise ValueError(
+                f"n_microbatches ({M}) must be a multiple of the pipeline "
+                f"stages ({self.S}); microbatches run in chunks of S to "
+                f"cap in-flight activations at the 1F1B bound")
+        self.M = M
+        self.n_chunks = M // self.S
         self._remat = remat_body
+        self._scaler = scaler if scaler is not None and scaler.is_enable() \
+            else None
+        self._scaler_state = _amp.scaler_init_state(self._scaler)
 
         # ---- functionalize the three sections --------------------------
         self._pre_apply, (_, self._pre_params), (_, self._pre_buffers) = \
@@ -77,6 +107,7 @@ class PipelineTrainStep:
             _, (_, ps), _ = functionalize(layer)
             per_layer.append(ps)
         self._body_layer_params = per_layer  # Tensor refs, [L][n_leaves]
+        self._tmpl_params = tmpl_params  # for per-leaf decay exclusions
         self._n_leaves = len(tmpl_params)
         self._body_hints = [getattr(p, "_placement_hints", None) or {}
                             for p in tmpl_params]
@@ -90,10 +121,14 @@ class PipelineTrainStep:
         jmesh = mesh.jax_mesh()
         self._repl = NamedSharding(jmesh, PartitionSpec())
 
-        self._pre_sh = [NamedSharding(jmesh, _pspec_from_hints(p, mesh))
-                        for p in self._pre_params]
-        self._post_sh = [NamedSharding(jmesh, _pspec_from_hints(p, mesh))
-                         for p in self._post_params]
+        # pre/post storage sharded over pp (see module docstring); the
+        # tied post entries reuse the pre array so their specs coincide
+        # (same shape + hints -> same first divisible dim).
+        extra = pp_axis if (shard_pre_post and self.S > 1) else None
+        self._pre_sh = [NamedSharding(jmesh, _pspec_from_hints(
+            p, mesh, extra_axis=extra)) for p in self._pre_params]
+        self._post_sh = [NamedSharding(jmesh, _pspec_from_hints(
+            p, mesh, extra_axis=extra)) for p in self._post_params]
         self._body_sh = [
             NamedSharding(jmesh, _pspec_from_hints(
                 tmpl_params[i], mesh, offset=1,
@@ -107,14 +142,24 @@ class PipelineTrainStep:
         self._stacked_body = [jax.device_put(s, sh)
                               for s, sh in zip(stacked, self._body_sh)]
 
-        # optimizer slots: pre/post per param; body per stacked leaf
+        # optimizer slots: pre/post per param; body per stacked leaf.
+        # Slot shardings follow the param shardings, so embedding/head
+        # moments are pp-sharded too.
         if optimizer._parameter_list is None:
             optimizer._parameter_list = list(self._pre_params) + \
                 list(self._post_params)
-        self._pre_slots = [optimizer._init_slots_mp(p._data)
-                           for p in self._pre_params]
-        self._post_slots = [optimizer._init_slots_mp(p._data)
-                            for p in self._post_params]
+        self._pre_slots = [
+            {k: jax.device_put(v, sh) for k, v in
+             optimizer._init_slots_mp(p._data).items()}
+            for p, sh in zip(self._pre_params, self._pre_sh)]
+        # tied post entries are pass-throughs in upd(): no slots, so no
+        # dead vocab-sized moment buffers are held for the head copy
+        self._post_slots = [
+            {} if j in self._shared_post else
+            {k: jax.device_put(v, sh) for k, v in
+             optimizer._init_slots_mp(p._data).items()}
+            for j, (p, sh) in enumerate(zip(self._post_params,
+                                            self._post_sh))]
         self._body_slots = [
             {k: jax.device_put(v, sh) for k, v in
              optimizer._init_slots_mp(s).items()}
@@ -126,7 +171,7 @@ class PipelineTrainStep:
     def _make_step_fn(self):
         mesh = self._mesh
         jmesh = mesh.jax_mesh()
-        S, M = self.S, self.M
+        S, M, C = self.S, self.M, self.n_chunks
         pp_axis = self._pp_axis
         body_apply = self._body_template_apply
         pre_apply = self._pre_apply
@@ -145,21 +190,25 @@ class PipelineTrainStep:
             return h
 
         def step_fn(pre_p, body_p, post_p, pre_s, body_s, post_s,
-                    pre_b, post_b, step, lr, key, x, y):
+                    pre_b, post_b, step, lr, key, scaler_state, x, y):
             set_current_mesh(mesh)
+            from paddle_tpu import amp as _amp
 
+            scaling = scaler_state is not None
             shared_post = self._shared_post
 
-            def loss_of(diff):
+            def chunk_loss(diff, bufs, xc, yc, k):
+                """fwd + loss for ONE chunk of S microbatches."""
                 pre_pd, body_pd, post_pd = diff
+                pre_bufs, post_bufs = bufs
                 if shared_post:
                     post_pd = [pre_pd[shared_post[j]] if j in shared_post
                                else p for j, p in enumerate(post_pd)]
-                k1, k2, k3 = jax.random.split(key, 3)
-                h, new_pre_b = pre_apply(pre_pd, pre_b, k1, x)
-                # microbatch: [B, ...] -> [M, B/M, ...]
+                k1, k2, k3 = jax.random.split(k, 3)
+                h, new_pre_b = pre_apply(pre_pd, pre_bufs, k1, xc)
+                # microbatch: [B, ...] -> [S, B/S, ...]
                 B = h.shape[0]
-                h_mbs = h.reshape((M, B // M) + h.shape[1:])
+                h_mbs = h.reshape((S, B // S) + h.shape[1:])
 
                 if S > 1:
                     def spmd_body(body_leaves, mbs):
@@ -179,18 +228,61 @@ class PipelineTrainStep:
                     out_mbs = jax.vmap(
                         lambda mb: body_block(body_pd, mb, k2))(h_mbs)
                 h2 = out_mbs.reshape((B,) + out_mbs.shape[2:])
-                out, new_post_b = post_apply(post_pd, post_b, k3, h2)
+                out, new_post_b = post_apply(post_pd, post_bufs, k3, h2)
                 outs = out if isinstance(out, tuple) else (out,)
                 ins = [Tensor._from_data(o) for o in outs]
-                loss = loss_fn(*(ins + [Tensor._from_data(y)]))
+                loss = loss_fn(*(ins + [Tensor._from_data(yc)]))
                 ld = loss._data if isinstance(loss, Tensor) else loss
                 if ld.ndim > 0:
                     ld = jnp.mean(ld)
-                return ld, (new_pre_b, new_post_b)
+                # scale BEFORE backward (fp16 underflow); grads are
+                # unscaled once after accumulation
+                scaled = ld * scaler_state[0] if scaling else ld
+                return scaled, (ld, (new_pre_b, new_post_b))
 
-            (loss, (new_pre_b, new_post_b)), (g_pre, g_body, g_post) = \
-                jax.value_and_grad(loss_of, has_aux=True)(
-                    (list(pre_p), list(body_p), list(post_p)))
+            diff0 = (list(pre_p), list(body_p), list(post_p))
+            # chunked gradient accumulation: lax.scan of value_and_grad
+            # caps in-flight activations at one chunk (S microbatches)
+            x_c = x.reshape((C, x.shape[0] // C) + x.shape[1:])
+            y_c = y.reshape((C, y.shape[0] // C) + y.shape[1:])
+            keys = jax.random.split(key, C)
+            zero_g = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, _grad_dtype(p.dtype)), diff0)
+
+            def chunk_body(carry, xyk):
+                gsum, bufs, lsum = carry
+                xc, yc, k = xyk
+                (_, (ld, new_bufs)), g = jax.value_and_grad(
+                    chunk_loss, has_aux=True)(diff0, bufs, xc, yc, k)
+                gsum = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(a.dtype), gsum, g)
+                return (gsum, new_bufs, lsum + ld), None
+
+            bufs0 = (list(pre_b), list(post_b))
+            if C == 1:
+                (gsum, bufs, lsum), _ = chunk_body(
+                    (zero_g, bufs0, jnp.float32(0.0)),
+                    (x_c[0], y_c[0], keys[0]))
+            else:
+                (gsum, bufs, lsum), _ = lax.scan(
+                    chunk_body, (zero_g, bufs0, jnp.float32(0.0)),
+                    (x_c, y_c, keys))
+            new_pre_b, new_post_b = bufs
+            loss = lsum / C
+            g_pre, g_body, g_post = jax.tree_util.tree_map(
+                lambda g: g / C, gsum)
+
+            found_inf = None
+            new_scaler_state = scaler_state
+            if scaling:
+                flat = list(g_pre) + list(g_body) + list(g_post)
+                flat, found_inf = _amp.scaler_unscale_and_check(
+                    flat, scaler_state)
+                new_scaler_state = _amp.scaler_update_state(
+                    self._scaler, scaler_state, found_inf)
+                g_pre = flat[:len(g_pre)]
+                g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
+                g_post = flat[len(g_pre) + len(g_body):]
 
             clip_fn = getattr(opt._grad_clip, "clip_fn", None)
             if clip_fn is not None:
@@ -200,33 +292,50 @@ class PipelineTrainStep:
                 g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
                 g_post = flat[len(g_pre) + len(g_body):]
 
-            def upd(ps, gs, ss, skip=()):
+            def upd(ps, gs, ss, param_refs, skip=()):
                 nps, nss = [], []
                 for i, (p, g, s) in enumerate(zip(ps, gs, ss)):
                     if i in skip:  # tied copy: mirrored after pre update
                         nps.append(p)
                         nss.append(s)
                         continue
+                    # per-param decay exclusion (trace-time static), same
+                    # as jit/train.py and distributed/engine.py
+                    opt._current_decay_enabled = opt._decay_enabled(
+                        param_refs[i])
                     np_, ns = opt._rule_mp(p, g, s, lr, step)
+                    opt._current_decay_enabled = True
+                    if found_inf is not None:
+                        np_ = jnp.where(found_inf, p, np_)
+                        ns = {k: jnp.where(found_inf, s[k], v)
+                              for k, v in ns.items()}
                     nps.append(np_)
                     nss.append(ns)
                 return nps, nss
 
-            npre, npre_s = upd(pre_p, g_pre, pre_s)
-            nbody, nbody_s = upd(body_p, g_body, body_s)
+            npre, npre_s = upd(pre_p, g_pre, pre_s, self._pre_params)
+            nbody, nbody_s = upd(body_p, g_body, body_s,
+                                 self._tmpl_params)
             npost, npost_s = upd(post_p, g_post, post_s,
+                                 self._post_params,
                                  skip=set(shared_post))
             for j, i in shared_post.items():
                 npost[j] = npre[i]
             set_current_mesh(None)
             return (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
-                    new_pre_b, new_post_b)
+                    new_pre_b, new_post_b, new_scaler_state)
 
         return step_fn
 
     def __call__(self, x, y):
         xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        if xd.shape[0] % self.M:
+            raise ValueError(
+                f"batch size {xd.shape[0]} must be a multiple of "
+                f"n_microbatches ({self.M} = {self.n_chunks} chunks x "
+                f"{self.S} stages); pad the batch or adjust "
+                f"accumulate_steps")
         jmesh = self._mesh.jax_mesh()
         dp = self._dp_axis if self._dp_axis in self._mesh.dim_names else None
 
@@ -242,6 +351,7 @@ class PipelineTrainStep:
             step_fn = self._make_step_fn()
             slot_sh = lambda shs, slots: [
                 {k: sh for k in s} for sh, s in zip(shs, slots)]
+            scaler_sh = None if self._scaler_state is None else self._repl
             self._jitted = jax.jit(
                 step_fn,
                 in_shardings=(self._pre_sh, self._body_sh, self._post_sh,
@@ -251,6 +361,7 @@ class PipelineTrainStep:
                               [self._repl] * len(self._pre_buffers),
                               [self._repl] * len(self._post_buffers),
                               self._repl, self._repl, self._repl,
+                              scaler_sh,
                               bsh(xd.ndim), bsh(yd.ndim)),
                 out_shardings=(self._repl, self._pre_sh, self._body_sh,
                                self._post_sh,
@@ -258,7 +369,8 @@ class PipelineTrainStep:
                                slot_sh(self._body_sh, self._body_slots),
                                slot_sh(self._post_sh, self._post_slots),
                                [self._repl] * len(self._pre_buffers),
-                               [self._repl] * len(self._post_buffers)),
+                               [self._repl] * len(self._post_buffers),
+                               scaler_sh),
                 donate_argnums=(0, 1, 2, 3, 4, 5))
         self._opt._step_count += 1
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
@@ -267,7 +379,7 @@ class PipelineTrainStep:
         set_current_mesh(self._mesh)
         try:
             (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
-             npre_b, npost_b) = \
+             npre_b, npost_b, nscaler) = \
                 self._jitted([p._data for p in self._pre_params],
                              self._stacked_body,
                              [p._data for p in self._post_params],
@@ -275,7 +387,7 @@ class PipelineTrainStep:
                              self._post_slots,
                              [b._data for b in self._pre_buffers],
                              [b._data for b in self._post_buffers],
-                             stp, lr, key, xd, yd)
+                             stp, lr, key, self._scaler_state, xd, yd)
         finally:
             set_current_mesh(None)
         for p, d in zip(self._pre_params, npre):
@@ -289,6 +401,11 @@ class PipelineTrainStep:
         self._stacked_body = nbody
         self._pre_slots, self._body_slots, self._post_slots = \
             npre_s, nbody_s, npost_s
+        if nscaler is not None:
+            from paddle_tpu import amp as _amp
+
+            self._scaler_state = nscaler
+            _amp.scaler_sync_from_state(self._scaler, nscaler)
         return Tensor._from_data(loss)
 
     def sync_params_to_model(self):
@@ -299,3 +416,11 @@ class PipelineTrainStep:
             leaf = self._stacked_body[i]
             for l in range(L):
                 self._body_layer_params[l][i]._data = leaf[l]
+
+
+def _grad_dtype(dtype):
+    """Accumulate grads in f32 across chunks for low-precision params."""
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.floating) and d.itemsize < 4:
+        return jnp.float32
+    return d

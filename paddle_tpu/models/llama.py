@@ -288,10 +288,14 @@ class LlamaForCausalLM(nn.Layer):
 
 
 def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int):
-    """Pipeline-ready Llama: embedding/head replicated sections, decoder
-    blocks as the homogeneous pipeline body."""
+    """Pipeline-ready Llama: embedding/head pre/post sections (their
+    storage is pp-sharded by PipelineTrainStep — the TPU equivalent of
+    the reference's first/last-stage placement, pp_layers.py:257),
+    decoder blocks as the homogeneous pipeline body. With
+    ``tie_word_embeddings`` the head reuses the embedding weight via
+    SharedLayerDesc (reference SharedLayerDesc pp_layers.py:76)."""
     from paddle_tpu.distributed.fleet.pipeline_parallel import (
-        LayerDesc, PipelineLayer,
+        LayerDesc, PipelineLayer, SharedLayerDesc,
     )
 
     class _Embed(nn.Layer):
@@ -299,6 +303,7 @@ def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int):
             super().__init__()
             self.embed_tokens = VocabParallelEmbedding(
                 config.vocab_size, config.hidden_size)
+            self.weight = self.embed_tokens.weight
 
         def forward(self, input_ids):
             return self.embed_tokens(input_ids)
@@ -314,10 +319,35 @@ def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int):
         def forward(self, x):
             return self.lm_head(self.norm(x))
 
+    if config.tie_word_embeddings:
+        class _TiedHead(nn.Layer):
+            """norm + x @ embedding.T using the shared [vocab, h] table.
+            ``weight`` is a placeholder that SharedLayerDesc rebinds to
+            the _Embed owner's parameter (never the owner itself, since
+            _Embed precedes it in the layer list)."""
+
+            def __init__(self):
+                super().__init__()
+                self.norm = LlamaRMSNorm(config)
+                # 1-row placeholder: no vocab-sized allocation is wasted
+                self.weight = self.create_parameter(
+                    [1, config.hidden_size])
+
+            def forward(self, x):
+                w = self.weight
+                return ops.matmul(self.norm(x), w, transpose_y=True)
+
+        layers = [SharedLayerDesc("embed", _Embed, shared_weight_attr="weight")] + \
+                 [LayerDesc(LlamaDecoderLayer, config)
+                  for _ in range(config.num_hidden_layers)] + \
+                 [SharedLayerDesc("embed", _TiedHead,
+                                  shared_weight_attr="weight")]
+    else:
+        layers = [_Embed()] + \
+                 [LayerDesc(LlamaDecoderLayer, config)
+                  for _ in range(config.num_hidden_layers)] + \
+                 [_Head()]
     return PipelineLayer(
-        layers=[_Embed()] +
-               [LayerDesc(LlamaDecoderLayer, config)
-                for _ in range(config.num_hidden_layers)] +
-               [_Head()],
+        layers=layers,
         num_stages=num_stages,
         loss_fn=LlamaPretrainingCriterion(config))

@@ -43,7 +43,7 @@ def _pick_block(seq_len, preferred):
     dividing candidate) become a single whole-sequence block, which
     available() then gates on 8-alignment."""
     for b in (preferred, 512, 256, 128):
-        if b <= seq_len and seq_len % b == 0:
+        if b <= preferred and b <= seq_len and seq_len % b == 0:
             return b
     return min(preferred, seq_len)
 _NEG_INF = -1e30

@@ -321,7 +321,6 @@ class Adam(Optimizer):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
-        self._multi_precision = bool(kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._multi_precision = bool(multi_precision)
 
