@@ -28,14 +28,22 @@ class Generator:
 
     def __init__(self, seed_: int = 0):
         self._seed = int(seed_)
-        self._root = jax.random.key(self._seed)
+        # the root key is built lazily: creating a jax array at import
+        # time would initialize the XLA backend, which must not happen
+        # before jax.distributed.initialize in multi-host jobs
+        self._root = None
         self._counter = 0
         self._lock = threading.Lock()
+
+    def _root_key(self):
+        if self._root is None:
+            self._root = jax.random.key(self._seed)
+        return self._root
 
     def manual_seed(self, seed_: int) -> "Generator":
         with self._lock:
             self._seed = int(seed_)
-            self._root = jax.random.key(self._seed)
+            self._root = None
             self._counter = 0
         return self
 
@@ -47,7 +55,7 @@ class Generator:
         with self._lock:
             c = self._counter
             self._counter += 1
-        return jax.random.fold_in(self._root, c)
+        return jax.random.fold_in(self._root_key(), c)
 
     def get_state(self):
         return (self._seed, self._counter)
@@ -55,7 +63,7 @@ class Generator:
     def set_state(self, state):
         with self._lock:
             self._seed, self._counter = int(state[0]), int(state[1])
-            self._root = jax.random.key(self._seed)
+            self._root = None
 
 
 default_generator = Generator(0)

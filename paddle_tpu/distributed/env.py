@@ -54,13 +54,26 @@ def init_parallel_env():
         return ParallelEnv()
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
         "MASTER_ADDR")
-    if coord and get_world_size() > 1 and jax.process_count() == 1:
+    # NOTE: nothing here may touch the backend (jax.devices /
+    # process_count) before jax.distributed.initialize — world size and
+    # rank come from the launcher env only, and the coordination client
+    # is probed directly
+    world = os.environ.get("PADDLE_TRAINERS_NUM") or \
+        os.environ.get("WORLD_SIZE")
+    rank = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    try:
+        from jax._src import distributed as _dist
+
+        already = _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - private API moved
+        already = False
+    if coord and world and int(world) > 1 and not already:
         port = os.environ.get("MASTER_PORT", "8476")
         addr = coord if ":" in coord else f"{coord}:{port}"
         jax.distributed.initialize(
             coordinator_address=addr,
-            num_processes=get_world_size(),
-            process_id=get_rank(),
+            num_processes=int(world),
+            process_id=int(rank or 0),
         )
     _initialized = True
     return ParallelEnv()

@@ -44,18 +44,26 @@ def sharding_constraint(x, spec: dict):
     """Annotate an activation with a per-tensor-dim axis mapping, e.g.
     ``{0: "dp", 1: "mp"}``. Under jit this becomes
     lax.with_sharding_constraint against the ambient mesh; eager it is a
-    no-op (single device)."""
+    no-op (single device).
+
+    Dims NOT mentioned in ``spec`` are left UNCONSTRAINED so GSPMD keeps
+    whatever sharding they carry (e.g. the dp-sharded batch dim);
+    mentioning a dim with ``None`` forces it replicated (gather)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
     from paddle_tpu.distributed.engine import current_mesh
 
     mesh = current_mesh()
     if mesh is None:
         return x
     ndim = x.ndim if not isinstance(x, Tensor) else x._data.ndim
-    pspec = [None] * ndim
+    pspec = [PartitionSpec.UNCONSTRAINED] * ndim
     for d, ax in spec.items():
-        if ax in mesh.dim_names:
+        if ax is None:
+            pspec[d] = None          # explicit: force replicated
+        elif ax in mesh.dim_names:
             pspec[d] = ax
-    from jax.sharding import NamedSharding, PartitionSpec
+        # axis not present in this mesh: leave the dim unconstrained
 
     sh = NamedSharding(mesh.jax_mesh(), PartitionSpec(*pspec))
     data = x._data if isinstance(x, Tensor) else x

@@ -1,0 +1,7 @@
+"""Fleet utils (reference: python/paddle/distributed/fleet/utils/)."""
+from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (  # noqa: F401
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)

@@ -1,0 +1,148 @@
+"""Megatron-style sequence parallelism (SP).
+
+Reference: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py — ScatterOp:85, GatherOp:97, AllGatherOp:111,
+ReduceScatterOp:127, ColumnSequenceParallelLinear:395,
+RowSequenceParallelLinear:528.
+
+SP splits the *sequence* dimension of activations across the mp group in
+the regions between TP layers (LayerNorm, dropout, residuals), so those
+memory-heavy activations are stored at 1/mp per device; entering a
+column-parallel linear the sequence is all-gathered, and leaving a
+row-parallel linear the partial sums are reduce-scattered back onto the
+sequence dim (one reduce-scatter replaces the TP all-reduce — same bytes
+on the wire, less live memory).
+
+TPU-native design: the reference implements each op as a PyLayer with a
+hand-written collective pair (fwd all-gather / bwd reduce-scatter etc.).
+Under a single compiled SPMD program the same movement is expressed as a
+*sharding constraint* on the sequence dim: GSPMD materializes the
+all-gather / reduce-scatter pair exactly where the layout transition
+happens, and the autodiff transpose of a constraint reproduces the
+reference's backward collective. The op classes below keep the
+reference's ``XxxOp.apply(x)`` call surface so SP models port verbatim.
+
+Layout note: the reference fixes [s, b, h] with the sequence on dim 0;
+these ops take ``axis`` (default 0) so [b, s, h] models pass axis=1.
+"""
+from __future__ import annotations
+
+from paddle_tpu import ops
+from paddle_tpu.distributed.fleet.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, sharding_constraint,
+)
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+_SP_AXIS = "mp"  # Megatron SP reuses the tensor-parallel group
+
+
+def _constrain_seq(x, axis, sharded: bool):
+    """Constrain the sequence dim to the mp axis (sharded) or to
+    replicated (gathered); GSPMD inserts the matching collective."""
+    return sharding_constraint(x, {axis: _SP_AXIS if sharded else None})
+
+
+class ScatterOp:
+    """Split the sequence dim across mp (reference ScatterOp:85 —
+    fwd split, bwd all-gather)."""
+
+    @staticmethod
+    def apply(x, axis: int = 0):
+        return _constrain_seq(x, axis, sharded=True)
+
+
+class GatherOp:
+    """Gather the sequence dim from mp (reference GatherOp:97 —
+    fwd all-gather, bwd split)."""
+
+    @staticmethod
+    def apply(x, axis: int = 0):
+        return _constrain_seq(x, axis, sharded=False)
+
+
+class AllGatherOp:
+    """All-gather the sequence dim before a column-parallel matmul
+    (reference AllGatherOp:111 — fwd all-gather, bwd reduce-scatter)."""
+
+    @staticmethod
+    def apply(x, axis: int = 0):
+        return _constrain_seq(x, axis, sharded=False)
+
+
+class ReduceScatterOp:
+    """Reduce partial sums and scatter onto the sequence dim after a
+    row-parallel matmul (reference ReduceScatterOp:127 — fwd
+    reduce-scatter, bwd all-gather)."""
+
+    @staticmethod
+    def apply(x, axis: int = 0):
+        return _constrain_seq(x, axis, sharded=True)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Tag params that live in SP regions (LayerNorm weights etc.); the
+    reference uses the tag to all-reduce their grads across the mp group
+    (sequence_parallel_utils.py:156-217). Under the compiled SPMD step
+    replicated params already get summed grads from GSPMD, so the tag is
+    metadata for checkpoint/debug parity."""
+    param.sequence_parallel = True
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **k):
+    """Reference :156 registers grad allreduce hooks for SP params; the
+    compiled SPMD step performs that reduction automatically (grads of
+    replicated params are psummed by GSPMD), so this is a no-op kept for
+    API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """ColumnParallelLinear whose input arrives sequence-sharded
+    (reference ColumnSequenceParallelLinear:395): all-gather the sequence,
+    matmul with the column-sharded weight, leave the output feature-dim
+    sharded. Parameter creation/placement is inherited — only the
+    sequence-layout transitions differ."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, seq_axis: int = 0,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, has_bias=has_bias,
+                         gather_output=gather_output, mp_group=mp_group,
+                         name=name)
+        self.seq_axis = seq_axis
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x, axis=self.seq_axis)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """RowParallelLinear that reduce-scatters its output onto the
+    sequence dim (reference RowSequenceParallelLinear:528): input arrives
+    feature-sharded, the partial-sum reduction lands sequence-sharded.
+    The bias is added after the reduce-scatter (reference :528 does the
+    same so each rank adds it to its sequence shard exactly once)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, seq_axis: int = 0,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         mp_group=mp_group, name=name)
+        self.seq_axis = seq_axis
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = sharding_constraint(x, {x.ndim - 1: _SP_AXIS})
+        out = ops.linear(x, self.weight, None)
+        out = ReduceScatterOp.apply(out, axis=self.seq_axis)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

@@ -1,0 +1,137 @@
+"""Distributed launcher.
+
+Reference: python/paddle/distributed/launch/ — __main__.py arg surface,
+CollectiveController (controllers/collective.py:76-132 sets
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS per process), master
+rendezvous (controllers/master.py), and the process watcher
+(controllers/watcher.py).
+
+TPU-native design: one process per HOST (JAX is single-controller per
+host — chips are addressed through the mesh, not through per-device
+processes), so ``--nproc_per_node`` spawns host-level workers whose
+rendezvous is ``jax.distributed.initialize`` (the coordination service
+plays the reference's TCPStore role; worker 0's endpoint is the
+coordinator). The spawned env protocol matches the reference's so
+training scripts using env.init_parallel_env()/ParallelEnv port over
+unchanged.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def launch(args, extra_env=None):
+    """Spawn ``nproc_per_node`` worker processes and babysit them.
+
+    Returns the first nonzero exit code (0 if all succeed). On any child
+    failure the remaining children are terminated (reference watcher
+    semantics: one dead trainer kills the job)."""
+    n = args.nproc_per_node
+    node_rank = args.node_rank
+    nnodes = args.nnodes
+    world = n * nnodes
+    if args.master:
+        master = args.master
+    elif nnodes > 1:
+        raise SystemExit(
+            "--master host:port is required when --nnodes > 1 (all nodes "
+            "must rendezvous at one coordinator)")
+    else:
+        master = f"127.0.0.1:{_free_port()}"
+    host = master.split(":")[0]
+    base_port = int(master.split(":")[1])
+    # worker data endpoints use THIS node's host and skip the coordinator
+    # port (base_port); cross-node peer endpoints are exchanged through
+    # the jax coordination service at init, so the static endpoint list
+    # is only advertised for single-node jobs (reference master.py
+    # fetches it from the rendezvous KV in the multi-node case).
+    local_host = "127.0.0.1" if nnodes == 1 else socket.gethostname()
+    endpoints = ",".join(
+        f"{host}:{base_port + 1 + i}" for i in range(world)) \
+        if nnodes == 1 else ""
+
+    procs = []
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    script_args = list(getattr(args, "training_script_args", []) or [])
+    cmd = [sys.executable, "-u", args.training_script] + script_args
+    for local_rank in range(n):
+        rank = node_rank * n + local_rank
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{local_host}:{base_port + 1 + rank}",
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_MASTER": master,
+            "MASTER_ADDR": host,
+            "MASTER_PORT": str(base_port),
+        })
+        out = None
+        if log_dir:
+            out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        p = subprocess.Popen(cmd, env=env, stdout=out,
+                             stderr=subprocess.STDOUT if out else None)
+        p._log = out
+        procs.append(p)
+
+    rc = 0
+    try:
+        while procs:
+            for p in list(procs):
+                r = p.poll()
+                if r is None:
+                    continue
+                procs.remove(p)
+                if p._log:
+                    p._log.close()
+                if r != 0 and rc == 0:
+                    rc = r
+                    # one dead trainer kills the job (watcher.py role)
+                    for q in procs:
+                        q.terminate()
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        rc = 130
+    return rc
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a multi-process (multi-host) training job")
+    ap.add_argument("--nproc_per_node", type=int, default=1,
+                    help="worker processes on this node (hosts, not chips)")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--master", default=None,
+                    help="coordinator endpoint host:port (default: "
+                         "localhost with a free port — single node)")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("training_script")
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch(args)

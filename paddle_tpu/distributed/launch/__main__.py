@@ -1,0 +1,5 @@
+import sys
+
+from paddle_tpu.distributed.launch import main
+
+sys.exit(main())

@@ -114,6 +114,22 @@ class LlamaRMSNorm(nn.RMSNorm):
         super().__init__(config.hidden_size, epsilon=config.rms_norm_eps)
 
 
+def _tp_linears(config: LlamaConfig):
+    """Column/Row projection classes: Megatron-SP variants (sequence
+    sharded over mp between blocks, reference sequence_parallel_utils.py
+    :395/:528) when config.sequence_parallel, plain TP otherwise."""
+    if config.sequence_parallel:
+        from paddle_tpu.distributed.fleet.utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+        )
+        import functools
+
+        return (functools.partial(ColumnSequenceParallelLinear,
+                                  seq_axis=1),
+                functools.partial(RowSequenceParallelLinear, seq_axis=1))
+    return ColumnParallelLinear, RowParallelLinear
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -122,16 +138,13 @@ class LlamaAttention(nn.Layer):
         self.n_heads = config.num_attention_heads
         self.n_kv = config.num_key_value_heads
         self.head_dim = h // self.n_heads
-        self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
-                                           gather_output=False)
-        self.k_proj = ColumnParallelLinear(
-            h, self.n_kv * self.head_dim, has_bias=False,
-            gather_output=False)
-        self.v_proj = ColumnParallelLinear(
-            h, self.n_kv * self.head_dim, has_bias=False,
-            gather_output=False)
-        self.o_proj = RowParallelLinear(h, h, has_bias=False,
-                                        input_is_parallel=True)
+        Col, Row = _tp_linears(config)
+        self.q_proj = Col(h, h, has_bias=False, gather_output=False)
+        self.k_proj = Col(h, self.n_kv * self.head_dim, has_bias=False,
+                          gather_output=False)
+        self.v_proj = Col(h, self.n_kv * self.head_dim, has_bias=False,
+                          gather_output=False)
+        self.o_proj = Row(h, h, has_bias=False, input_is_parallel=True)
 
     def forward(self, x, cos, sin, attn_mask=None):
         b, s, h = x.shape
@@ -168,12 +181,11 @@ class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         h, m = config.hidden_size, config.intermediate_size
-        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
-                                              gather_output=False)
-        self.up_proj = ColumnParallelLinear(h, m, has_bias=False,
-                                            gather_output=False)
-        self.down_proj = RowParallelLinear(m, h, has_bias=False,
-                                           input_is_parallel=True)
+        Col, Row = _tp_linears(config)
+        self.gate_proj = Col(h, m, has_bias=False, gather_output=False)
+        self.up_proj = Col(h, m, has_bias=False, gather_output=False)
+        self.down_proj = Row(m, h, has_bias=False,
+                             input_is_parallel=True)
 
     def forward(self, x):
         # swiglu (reference: incubate/nn/functional/swiglu.py)

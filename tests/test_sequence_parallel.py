@@ -1,0 +1,106 @@
+"""Megatron sequence-parallel layers on the virtual CPU mesh.
+
+Reference behavior: distributed/fleet/utils/sequence_parallel_utils.py —
+SP must be numerically identical to TP-only (the layout differs, the math
+does not), and the activation between blocks must be sequence-sharded.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.engine import ParallelTrainStep
+from paddle_tpu.distributed.fleet.utils import (
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp,
+)
+from paddle_tpu.distributed.mesh import ProcessMesh
+from paddle_tpu.models.llama import (
+    LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+)
+
+
+def test_sp_ops_identity_without_mesh():
+    """Outside a mesh context the SP ops are no-ops on values."""
+    x = paddle.randn([4, 6, 8])
+    for op in (ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp):
+        y = op.apply(x, axis=1)
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def _sp_linear_pair(d=16, m=32, seq_axis=0):
+    paddle.seed(7)
+    col = ColumnSequenceParallelLinear(d, m, has_bias=True,
+                                       seq_axis=seq_axis)
+    row = RowSequenceParallelLinear(m, d, has_bias=True,
+                                    seq_axis=seq_axis)
+    return col, row
+
+
+def test_sp_linears_match_dense_on_mesh():
+    """Column->Row SP pair equals the dense computation under the
+    compiled mesh step (GSPMD inserts allgather/reduce-scatter)."""
+    import jax
+
+    d, m, s, b = 16, 32, 8, 4
+    col, row = _sp_linear_pair(d, m, seq_axis=0)
+    # dense reference from the same weights
+    wc, bc = col.weight.numpy(), col.bias.numpy()
+    wr, br = row.weight.numpy(), row.bias.numpy()
+    x = np.random.RandomState(0).randn(s, b, d).astype(np.float32)
+    ref = np.maximum(x @ wc + bc, 0.0) @ wr + br
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col, self.row = col, row
+
+        def forward(self, x):
+            h = ScatterOp.apply(x, axis=0)
+            h = self.col(h)
+            h = paddle.ops.relu(h)
+            return self.row(h)
+
+    from paddle_tpu.distributed.engine import set_current_mesh
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    from paddle_tpu.jit.trace import functionalize
+
+    net = Net()
+    apply_fn, (_, params), (_, bufs) = functionalize(net)
+    from paddle_tpu.distributed.engine import shard_model_parameters
+
+    shard_model_parameters(net, mesh)
+    set_current_mesh(mesh)
+    try:
+        out = jax.jit(lambda pd, x: apply_fn(pd, [], jax.random.PRNGKey(0),
+                                             x)[0])(
+            [p._data for p in params], x)
+    finally:
+        set_current_mesh(None)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def _llama_losses(sequence_parallel, n_steps=2):
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, use_flash_attention=False,
+        sequence_parallel=sequence_parallel)
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    Y = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    paddle.seed(42)
+    m = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    step = ParallelTrainStep(m, LlamaPretrainingCriterion(cfg), opt, mesh)
+    return [float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+            for _ in range(n_steps)]
+
+
+def test_llama_sp_matches_tp_only():
+    """SP Llama loss-aligns with TP-only Llama (VERDICT r2 item 4)."""
+    tp = _llama_losses(False)
+    sp = _llama_losses(True)
+    np.testing.assert_allclose(tp, sp, rtol=5e-4, atol=1e-5)
