@@ -52,8 +52,24 @@ from paddle_tpu.hapi.model import Model  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402,E402
 
+# the fft MODULE shadows the raw 1-D fft op exported by the registry
+# (paddle.fft is a namespace in the reference; paddle.fft.fft the op)
+import paddle_tpu.fft  # noqa: F401,E402
+import sys as _sys  # noqa: E402
+
+fft = _sys.modules["paddle_tpu.fft"]
+from paddle_tpu import distribution  # noqa: F401,E402
+from paddle_tpu import device  # noqa: F401,E402
+
 # numpy-style casting helper used across paddle code
 from paddle_tpu.ops.registry import API as _api
+
+
+def einsum(equation, *operands):
+    """paddle.einsum(equation, *operands) — the registry op takes the
+    operand list first, the public API leads with the equation
+    (reference python/paddle/tensor/einsum.py)."""
+    return _api["einsum"](list(operands), equation)
 
 
 def randn_like(x, dtype=None):

@@ -48,9 +48,16 @@ def define_flag(name: str, default, help_str: str = ""):
         return f.value
 
 
+def _norm(name: str) -> str:
+    """Accept both bare names and the reference's FLAGS_ prefix
+    (paddle.set_flags({"FLAGS_check_nan_inf": 1}))."""
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
 def set_flags(flags: Dict[str, Any]):
     with _lock:
         for name, value in flags.items():
+            name = _norm(name)
             if name not in _FLAGS:
                 raise KeyError(f"unknown flag: {name}")
             f = _FLAGS[name]
@@ -63,7 +70,7 @@ def get_flags(names=None) -> Dict[str, Any]:
             return {k: f.value for k, f in _FLAGS.items()}
         if isinstance(names, str):
             names = [names]
-        return {n: _FLAGS[n].value for n in names}
+        return {n: _FLAGS[_norm(n)].value for n in names}
 
 
 def flag(name: str):

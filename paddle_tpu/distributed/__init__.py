@@ -12,8 +12,10 @@ from paddle_tpu.distributed.mesh import (  # noqa: F401
     init_mesh, set_mesh,
 )
 from paddle_tpu.distributed.api import (  # noqa: F401
-    dtensor_from_local, dtensor_to_local, reshard, shard_layer,
-    shard_optimizer, shard_tensor, unshard_dtensor,
+    DistModel, ShardDataloader, ShardingStage1, ShardingStage2,
+    ShardingStage3, dtensor_from_local, dtensor_to_local, reshard,
+    shard_dataloader, shard_layer, shard_optimizer, shard_tensor,
+    to_static, unshard_dtensor,
 )
 from paddle_tpu.distributed.communication import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,

@@ -68,12 +68,18 @@ def init_parallel_env():
     except Exception:  # pragma: no cover - private API moved
         already = False
     if coord and world and int(world) > 1 and not already:
+        if rank is None:
+            raise RuntimeError(
+                "multi-host init: PADDLE_TRAINERS_NUM/WORLD_SIZE is set "
+                "but PADDLE_TRAINER_ID/RANK is not — every process would "
+                "claim rank 0 and the rendezvous would hang. Use "
+                "python -m paddle_tpu.distributed.launch or export RANK.")
         port = os.environ.get("MASTER_PORT", "8476")
         addr = coord if ":" in coord else f"{coord}:{port}"
         jax.distributed.initialize(
             coordinator_address=addr,
             num_processes=int(world),
-            process_id=int(rank or 0),
+            process_id=int(rank),
         )
     _initialized = True
     return ParallelEnv()

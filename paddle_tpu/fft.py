@@ -1,0 +1,53 @@
+"""paddle.fft — spectral transforms (reference: python/paddle/fft.py).
+
+The reference dispatches to pocketfft (CPU) / cuFFT (GPU) through phi
+fft kernels (paddle/phi/kernels/funcs/fft.cc); here every transform is
+one registry op lowering to the XLA Fft HLO, differentiable through the
+standard vjp path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _API
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _make(name):
+    fn = _API[name]
+
+    def wrapper(x, *a, **k):
+        return fn(x, *a, **k)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+fft = _make("fft")
+ifft = _make("ifft")
+fft2 = _make("fft2")
+ifft2 = _make("ifft2")
+fftn = _make("fftn")
+ifftn = _make("ifftn")
+rfft = _make("rfft")
+irfft = _make("irfft")
+rfft2 = _make("rfft2")
+irfft2 = _make("irfft2")
+rfftn = _make("rfftn")
+irfftn = _make("irfftn")
+hfft = _make("hfft")
+ihfft = _make("ihfft")
+fftshift = _make("fftshift")
+ifftshift = _make("ifftshift")
+
+
+def fftfreq(n, d=1.0, dtype="float32"):
+    return Tensor(np.fft.fftfreq(int(n), d=float(d)), dtype=dtype)
+
+
+def rfftfreq(n, d=1.0, dtype="float32"):
+    return Tensor(np.fft.rfftfreq(int(n), d=float(d)), dtype=dtype)

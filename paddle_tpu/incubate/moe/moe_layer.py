@@ -59,8 +59,11 @@ def _top1_dispatch(logits, capacity):
     return combine, dispatch, aux
 
 
-def _top2_dispatch(logits, capacity):
-    """GShard top-2 routing."""
+def _top2_dispatch(logits, capacity, rand=None):
+    """GShard top-2 routing. ``rand`` (uniform [T]) enables the GShard
+    random-routing rule: the 2nd expert is used with probability
+    min(1, 2*g2) (reference distributed/models/moe/utils.py:109
+    _random_routing — drop when 2*value2 < prob)."""
     t, e = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     i1 = jnp.argmax(gates, axis=-1)
@@ -68,6 +71,9 @@ def _top2_dispatch(logits, capacity):
     gates2 = gates * (1.0 - mask1)
     i2 = jnp.argmax(gates2, axis=-1)
     mask2 = jax.nn.one_hot(i2, e, dtype=jnp.float32)
+    if rand is not None:
+        g2_raw = jnp.sum(gates * mask2, axis=-1)
+        mask2 = mask2 * (2.0 * g2_raw >= rand)[:, None].astype(jnp.float32)
 
     aux = e * jnp.sum(jnp.mean(gates, axis=0) * jnp.mean(mask1, axis=0))
 
@@ -97,16 +103,35 @@ def _top2_dispatch(logits, capacity):
 
 @_register(name="moe_forward")
 def _moe_forward_emitter(x, gate_w, leaves, apply_fn=None, k=2,
-                         capacity=0, ep_axis=None, key=None):
+                         capacity=0, ep_axis=None, key=None,
+                         switch_eps=0.0, random_routing=False):
     """x [T,M]; gate_w [M,E]; leaves: list of stacked [E,...] expert
-    params. Returns (out [T,M], aux_loss scalar)."""
+    params. Returns (out [T,M], aux_loss scalar).
+
+    key (a traced PRNG key when training, None in eval) drives the
+    reference gates\' stochastic parts: SwitchGate\'s additive uniform
+    logit noise drawn from [1-eps, 1+eps] (switch_gate.py:52-56 adds it;
+    softmax is shift-invariant, so the effective jitter is the +-eps
+    spread) and GShardGate\'s random second-expert routing
+    (gshard_gate.py:76-83).
+    """
     t, m = x.shape
     e = gate_w.shape[1]
     logits = jnp.dot(x.astype(jnp.float32), gate_w.astype(jnp.float32))
     if k == 1:
+        if key is not None and switch_eps > 0.0:
+            k_noise, key = jax.random.split(key)
+            noise = jax.random.uniform(
+                k_noise, logits.shape, minval=1.0 - switch_eps,
+                maxval=1.0 + switch_eps)
+            logits = logits + noise
         combine, dispatch, aux = _top1_dispatch(logits, capacity)
     else:
-        combine, dispatch, aux = _top2_dispatch(logits, capacity)
+        rand = None
+        if key is not None and random_routing:
+            k_rand, key = jax.random.split(key)
+            rand = jax.random.uniform(k_rand, (t,))
+        combine, dispatch, aux = _top2_dispatch(logits, capacity, rand)
     # dispatch: [T,E,C] x [T,M] -> [E,C,M]  (the all-to-all under GSPMD)
     expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), x)
     if ep_axis is not None:
@@ -146,11 +171,31 @@ class NaiveGate(nn.Layer):
 
 
 class GShardGate(NaiveGate):
+    """Top-2 with random second-expert routing and train/eval capacity
+    factors (reference gshard_gate.py:31 — capacity=(1.2, 2.4),
+    random_routing=True)."""
+
     top_k = 2
+
+    def __init__(self, d_model, num_experts, capacity=(1.2, 2.4),
+                 random_routing=True):
+        super().__init__(d_model, num_experts)
+        self.capacity = tuple(capacity)
+        self.random_routing = random_routing
 
 
 class SwitchGate(NaiveGate):
+    """Top-1 with additive uniform logit noise while training
+    (reference switch_gate.py:31 — switch_eps=0.1,
+    capacity=(1.2, 2.4))."""
+
     top_k = 1
+
+    def __init__(self, d_model, num_experts, switch_eps=0.1,
+                 capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_experts)
+        self.switch_eps = switch_eps
+        self.capacity = tuple(capacity)
 
 
 _GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
@@ -172,11 +217,13 @@ class MoELayer(nn.Layer):
 
     def __init__(self, d_model: int, experts: Sequence[nn.Layer],
                  gate: str | nn.Layer = "gshard",
-                 capacity_factor: float = 1.25,
+                 capacity_factor: Optional[float] = None,
                  ep_axis: Optional[str] = "ep"):
         super().__init__()
         self.d_model = d_model
         self.num_experts = len(experts)
+        # None: defer to the gate's (train, eval) capacity factors;
+        # an explicit value always wins over the gate defaults
         self.capacity_factor = capacity_factor
         self.ep_axis = ep_axis
         if isinstance(gate, str):
@@ -217,14 +264,27 @@ class MoELayer(nn.Layer):
         return out
 
     def forward(self, x):
+        from paddle_tpu.core import generator as gen
+
         shape = x.shape
         t = int(np.prod(shape[:-1]))
         x2 = x.reshape([t, shape[-1]])
-        capacity = int(np.ceil(t / self.num_experts *
-                               self.capacity_factor))
+        # train/eval capacity factors from the gate when it defines them
+        # (reference capacity=(1.2, 2.4)); fall back to the layer factor
+        gate_caps = getattr(self.gate, "capacity", None)
+        if self.capacity_factor is not None:
+            factor = self.capacity_factor
+        elif gate_caps is not None:
+            factor = gate_caps[0 if self.training else 1]
+        else:
+            factor = 1.25
+        capacity = int(np.ceil(t / self.num_experts * factor))
+        key = gen.active_key() if self.training else None
         out, aux = _registry.API["moe_forward"](
             x2, self.gate.weight, list(self.stacked_params),
             apply_fn=self._apply_one_expert, k=self.top_k,
-            capacity=max(capacity, 1), ep_axis=self.ep_axis)
+            capacity=max(capacity, 1), ep_axis=self.ep_axis, key=key,
+            switch_eps=getattr(self.gate, "switch_eps", 0.0),
+            random_routing=getattr(self.gate, "random_routing", False))
         self.aux_loss = aux
         return out.reshape(shape)

@@ -91,11 +91,26 @@ def _is_diff_dtype(d) -> bool:
     return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating)
 
 
+def _raise_nonfinite(bad, name):
+    if bad:
+        raise FloatingPointError(f"op {name!r} produced nan/inf")
+
+
 def _check_nan_inf(name, outs):
+    """FLAGS_check_nan_inf: every float op output is checked, INCLUDING
+    inside compiled steps (reference hooks this into eager dispatch
+    everywhere — paddle/fluid/eager/nan_inf_utils.h; round 2 skipped
+    tracers, making the flag inert in TrainStep). For traced values the
+    check becomes a host debug callback compiled into the step — debug
+    mode, so the callback cost is accepted."""
     for o in outs:
-        if _is_diff_dtype(o.dtype) and not isinstance(o, jax.core.Tracer):
-            if bool(jnp.any(~jnp.isfinite(o))):
-                raise FloatingPointError(f"op {name!r} produced nan/inf")
+        if not _is_diff_dtype(o.dtype):
+            continue
+        bad = jnp.any(~jnp.isfinite(o))
+        if isinstance(o, jax.core.Tracer):
+            jax.debug.callback(_raise_nonfinite, bad, name)
+        elif bool(bad):
+            raise FloatingPointError(f"op {name!r} produced nan/inf")
 
 
 # AMP hook: set by paddle_tpu.amp at import (avoids a circular import).

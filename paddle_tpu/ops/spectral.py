@@ -1,0 +1,115 @@
+"""Einsum + spectral (FFT) emitters.
+
+Reference capability: python/paddle/tensor/einsum.py (equation parser +
+planner over matmul/transpose ops — here the whole planner collapses
+into XLA's native einsum lowering) and python/paddle/fft.py over
+pocketfft/cuFFT kernels (paddle/phi/kernels/funcs/fft.cc — on TPU the
+FFT lowers to the XLA Fft HLO).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+@op
+def einsum(operands, equation):
+    """paddle.einsum semantics (tensor/einsum.py): explicit and implicit
+    output modes, '...' broadcasting, repeated-label diagonals/sums —
+    all native to the XLA einsum contraction."""
+    return jnp.einsum(equation.replace(" ", ""), *operands)
+
+
+# ---------------------------------------------------------------------------
+# 1-D / N-D complex transforms (paddle.fft surface)
+# ---------------------------------------------------------------------------
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+@op
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@op
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@op
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes if axes is None else tuple(axes),
+                        norm=_norm(norm))
+
+
+@op
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s,
+                         axes=axes if axes is None else tuple(axes),
+                         norm=_norm(norm))
+
+
+@op
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@op
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@op
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s,
+                         axes=axes if axes is None else tuple(axes),
+                         norm=_norm(norm))
+
+
+@op
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s,
+                          axes=axes if axes is None else tuple(axes),
+                          norm=_norm(norm))
+
+
+@op
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@op
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@op
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)

@@ -81,8 +81,14 @@ class Optimizer:
             master = p.astype(jnp.float32)
             slots = self._init_slots(master)
             slots["master_weight"] = master
-            return slots
-        return self._init_slots(p)
+        else:
+            slots = self._init_slots(p)
+        fn = getattr(self, "_slot_shard_fn", None)
+        if fn is not None:
+            # dist.shard_optimizer(opt, ShardingStage1/2/3): place every
+            # slot per the sharding rule (ZeRO-style states over dp)
+            slots = {k: fn(k, p, v) for k, v in slots.items()}
+        return slots
 
     def _rule_mp(self, p, g, slots, lr, step):
         """dtype-stable _rule: the updated param/slots keep their stored
@@ -143,6 +149,15 @@ class Optimizer:
             new_p, new_slots = self._rule_mp(p._data, gdata, slots,
                                              self.get_lr(), self._step_count)
             self._current_decay_enabled = True
+            # params keep their user placement even when sharded slots
+            # (dist.shard_optimizer ZeRO stages) would propagate their
+            # sharding through the update math
+            old_sh = getattr(p._data, "sharding", None)
+            if old_sh is not None and \
+                    getattr(new_p, "sharding", None) != old_sh:
+                import jax
+
+                new_p = jax.device_put(new_p, old_sh)
             p._data = new_p
             self._slots[id(p)] = new_slots
 

@@ -1,0 +1,81 @@
+"""Memory stats + nan/inf checking observability (VERDICT r2 item 10).
+
+Reference: paddle/fluid/memory/stats.cc (max_memory_allocated) and
+paddle/fluid/eager/nan_inf_utils.h (FLAGS_check_nan_inf hooked into
+dispatch everywhere, including compiled paths).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_memory_api_surface():
+    from paddle_tpu import device
+
+    assert device.device_count() >= 1
+    # CPU/mock runtimes may not export allocator stats; the API must
+    # still answer with well-typed values
+    assert isinstance(device.memory_allocated(), int)
+    assert isinstance(device.max_memory_allocated(), int)
+    assert device.max_memory_allocated() >= device.memory_allocated() \
+        or device.max_memory_allocated() == 0
+    info = device.get_memory_info()
+    assert set(info) == {"allocated", "peak_allocated", "limit"}
+    device.reset_max_memory_allocated()
+    device.empty_cache()
+
+
+def test_compiled_memory_analysis():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import device
+
+    f = jax.jit(lambda x: (x @ x).sum())
+    lowered = f.lower(jnp.zeros((64, 64), jnp.float32))
+    compiled = lowered.compile()
+    ma = device.compiled_memory_analysis(compiled)
+    assert ma.get("argument_size_in_bytes", 0) >= 64 * 64 * 4
+
+
+def test_check_nan_inf_eager():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="divide"):
+            _ = paddle.ops.divide(x, paddle.to_tensor(
+                np.array([1.0, 0.0], np.float32)))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_inside_compiled_step():
+    """The flag must fire INSIDE TrainStep (round 2 skipped tracers so
+    compiled training never checked anything)."""
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        # poison one weight with inf: the first matmul output is nonfinite
+        w = model.parameters()[0]
+        bad = np.array(w.numpy(), copy=True)
+        bad[0, 0] = np.inf
+        w.set_value(paddle.to_tensor(bad))
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, nn.MSELoss(), opt)
+        X = paddle.to_tensor(np.ones((2, 4), np.float32))
+        Y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        with pytest.raises(Exception, match="nan/inf"):
+            loss = step(X, Y)
+            float(loss._data)  # force execution
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_off_by_default():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    y = paddle.ops.divide(x, paddle.to_tensor(np.array([0.0], np.float32)))
+    assert np.isinf(y.numpy()).all()
