@@ -60,6 +60,8 @@ import sys as _sys  # noqa: E402
 fft = _sys.modules["paddle_tpu.fft"]
 from paddle_tpu import distribution  # noqa: F401,E402
 from paddle_tpu import device  # noqa: F401,E402
+from paddle_tpu import audio  # noqa: F401,E402
+from paddle_tpu import text  # noqa: F401,E402
 
 # numpy-style casting helper used across paddle code
 from paddle_tpu.ops.registry import API as _api
@@ -129,3 +131,5 @@ def is_grad_enabled():
 def device_count():
     from paddle_tpu.core.place import device_count as _dc
     return _dc()
+from paddle_tpu import sparse  # noqa: F401,E402
+from paddle_tpu import quantization  # noqa: F401,E402

@@ -1,0 +1,20 @@
+"""paddle.audio — audio feature extraction.
+
+Reference: python/paddle/audio/ — functional/ (window_function.py,
+functional.py: hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/
+compute_fbank_matrix/power_to_db/create_dct) and features/ (layers.py:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+TPU-native: every transform is framing + rfft + matmuls over registry
+ops, so the whole feature pipeline fuses into the training graph
+(the reference binds to a C++ frame/stft kernel chain).
+"""
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.features import (  # noqa: F401
+    LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+
+from paddle_tpu.audio import features  # noqa: F401,E402

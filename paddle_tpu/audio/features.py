@@ -1,0 +1,110 @@
+"""Audio feature layers (reference: python/paddle/audio/features/
+layers.py — Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+from paddle_tpu import nn, ops
+from paddle_tpu.audio import functional as F
+from paddle_tpu.ops.registry import API as _ops
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    """STFT power spectrogram: frame -> window -> rfft -> |.|^power.
+    Input [B, T] (or [T]); output [B, 1 + n_fft//2, num_frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, dtype=dtype)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = ops.pad(w, [lp, n_fft - self.win_length - lp])
+        self.window = w
+
+    def forward(self, x):
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = ops.unsqueeze(x, 0)
+        if self.center:
+            x = ops.pad(x, [self.n_fft // 2, self.n_fft // 2],
+                        mode=self.pad_mode)
+        b, t = x.shape
+        n_frames = 1 + (t - self.n_fft) // self.hop_length
+        # frame via strided gather: [B, n_frames, n_fft]
+        import jax.numpy as jnp
+
+        idx = (jnp.arange(n_frames)[:, None] * self.hop_length
+               + jnp.arange(self.n_fft)[None, :])
+        frames = ops.gather(x, ops.Tensor(idx.reshape(-1))
+                            if hasattr(ops, "Tensor") else idx, axis=1)
+        frames = ops.reshape(frames, [b, n_frames, self.n_fft])
+        frames = frames * self.window
+        spec = _ops["rfft"](frames, n=self.n_fft, axis=-1)
+        mag = _ops["abs"](spec)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        out = ops.transpose(mag, [0, 2, 1])  # [B, freq, frames]
+        return ops.squeeze(out, 0) if squeeze else out
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, frames]
+        return ops.matmul(self.fbank, spec)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self._melspectrogram(x), self.ref_value,
+                             self.amin, self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        return ops.matmul(ops.transpose(self.dct, [1, 0]), mel)

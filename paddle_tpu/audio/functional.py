@@ -1,0 +1,129 @@
+"""Audio DSP functional ops (reference: python/paddle/audio/functional/
+functional.py + window_function.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _ops
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hertz -> mel (slaney by default, HTK optional) — matches the
+    reference's dual-scale behavior (functional.py hz_to_mel)."""
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if scalar else Tensor(out.astype(np.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if scalar else Tensor(out.astype(np.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(f_min, htk=htk)
+    hi = hz_to_mel(f_max, htk=htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(np.asarray(
+        [mel_to_hz(float(m), htk=htk) for m in mels], dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fft_f = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max,
+                                       htk).numpy(), np.float64)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) with an optional dynamic-range floor."""
+    s = spect if isinstance(spect, Tensor) else Tensor(spect)
+    log_spec = 10.0 * (_ops["log10"](_ops["clip"](s, amin, None))
+                       if "log10" in _ops else
+                       _ops["log"](_ops["clip"](s, amin, None))
+                       * (1.0 / math.log(10.0)))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        peak = _ops["max"](log_spec)
+        log_spec = _ops["maximum"](log_spec, peak - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    basis = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2.0)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(basis.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/rect windows (window_function.py)."""
+    n = win_length
+    den = n if fftbins else n - 1
+    t = np.arange(n, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / den)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / den)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / den)
+             + 0.08 * np.cos(4 * math.pi * t / den))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

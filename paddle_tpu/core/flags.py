@@ -79,7 +79,8 @@ def flag(name: str):
 
 
 # -- core flags (analogs of FLAGS_* in paddle/phi/core/flags.cc) ------------
-define_flag("check_nan_inf", False, "check every op output for nan/inf")
+define_flag("check_nan_inf", False,
+            "check every op output for nan/inf; for compiled steps the check\n            is baked in at TRACE time — set it before the first step runs\n            (like the reference's static-graph programs, the cached executable\n            keeps whatever the flag said when it was built)")
 define_flag("eager_vjp", True, "record vjp tape in eager mode")
 define_flag("use_bfloat16_default", False, "default float dtype is bfloat16")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (xla only)")

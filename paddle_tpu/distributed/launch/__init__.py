@@ -40,7 +40,24 @@ def launch(args, extra_env=None):
 
     Returns the first nonzero exit code (0 if all succeed). On any child
     failure the remaining children are terminated (reference watcher
-    semantics: one dead trainer kills the job)."""
+    semantics: one dead trainer kills the job), and — when
+    ``--max_restart`` allows — the whole gang is relaunched, the elastic
+    recovery loop of reference fleet/elastic/manager.py:124 (collective
+    jobs restart as a unit because the rendezvous must re-form)."""
+    restarts = getattr(args, "max_restart", 0)
+    attempt = 0
+    while True:
+        rc = _launch_once(args, extra_env, attempt)
+        attempt += 1
+        if rc == 0 or restarts <= 0:
+            return rc
+        restarts -= 1
+        print(f"[launch] job failed (rc={rc}); restarting "
+              f"({restarts} restarts left)", file=sys.stderr, flush=True)
+        time.sleep(getattr(args, "restart_interval", 1.0))
+
+
+def _launch_once(args, extra_env=None, attempt=0):
     n = args.nproc_per_node
     node_rank = args.node_rank
     nnodes = args.nnodes
@@ -85,10 +102,12 @@ def launch(args, extra_env=None):
             "PADDLE_MASTER": master,
             "MASTER_ADDR": host,
             "MASTER_PORT": str(base_port),
+            "PADDLE_RESTART_COUNT": str(attempt),
         })
         out = None
         if log_dir:
-            out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            out = open(os.path.join(log_dir, f"workerlog.{rank}"),
+                       "a" if attempt else "w")
         p = subprocess.Popen(cmd, env=env, stdout=out,
                              stderr=subprocess.STDOUT if out else None)
         p._log = out
@@ -131,6 +150,10 @@ def main(argv=None):
                     help="coordinator endpoint host:port (default: "
                          "localhost with a free port — single node)")
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--max_restart", type=int, default=0,
+                    help="relaunch the job up to N times after a failure "
+                         "(elastic recovery)")
+    ap.add_argument("--restart_interval", type=float, default=1.0)
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)

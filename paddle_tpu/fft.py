@@ -17,32 +17,23 @@ __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
            "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
-def _make(name):
-    fn = _API[name]
-
-    def wrapper(x, *a, **k):
-        return fn(x, *a, **k)
-
-    wrapper.__name__ = name
-    return wrapper
-
-
-fft = _make("fft")
-ifft = _make("ifft")
-fft2 = _make("fft2")
-ifft2 = _make("ifft2")
-fftn = _make("fftn")
-ifftn = _make("ifftn")
-rfft = _make("rfft")
-irfft = _make("irfft")
-rfft2 = _make("rfft2")
-irfft2 = _make("irfft2")
-rfftn = _make("rfftn")
-irfftn = _make("irfftn")
-hfft = _make("hfft")
-ihfft = _make("ihfft")
-fftshift = _make("fftshift")
-ifftshift = _make("ifftshift")
+# the registry ops ARE the public functions
+fft = _API["fft"]
+ifft = _API["ifft"]
+fft2 = _API["fft2"]
+ifft2 = _API["ifft2"]
+fftn = _API["fftn"]
+ifftn = _API["ifftn"]
+rfft = _API["rfft"]
+irfft = _API["irfft"]
+rfft2 = _API["rfft2"]
+irfft2 = _API["irfft2"]
+rfftn = _API["rfftn"]
+irfftn = _API["irfftn"]
+hfft = _API["hfft"]
+ihfft = _API["ihfft"]
+fftshift = _API["fftshift"]
+ifftshift = _API["ifftshift"]
 
 
 def fftfreq(n, d=1.0, dtype="float32"):

@@ -4,9 +4,11 @@ Host-side input pipeline that keeps the TPU fed: Dataset/Sampler abstractions
 match the reference; DataLoader batches on host (numpy), optionally with a
 background prefetch thread (the role of the reference's buffered reader +
 LoDTensorBlockingQueue, python/paddle/io/dataloader/dataloader_iter.py:114).
-Multiprocess workers come from the C++-backed queue in a later milestone;
-thread-prefetch already overlaps host batching with device compute since
-device work releases the GIL inside XLA.
+num_workers > 0 forks worker processes that fetch + collate to numpy and
+ship batches through an mp queue with a deterministic reorder buffer
+(reference dataloader/worker.py); thread-prefetch additionally overlaps
+host batching with device compute since device work releases the GIL
+inside XLA.
 """
 from __future__ import annotations
 
@@ -243,6 +245,79 @@ class DistributedBatchSampler(BatchSampler):
 # ---------------------------------------------------------------------------
 # collate + loader
 # ---------------------------------------------------------------------------
+def _collate_np(batch):
+    """Numpy-only collate used inside worker processes (they must not
+    create device arrays: the forked child would share the parent's
+    accelerator runtime/sockets)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_collate_np([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _collate_np([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        raise RuntimeError(
+            "dataset __getitem__ returned a device Tensor inside a "
+            "DataLoader worker process; return numpy arrays (or python "
+            "scalars) when num_workers > 0 — a forked worker must not "
+            "drive the parent's accelerator runtime")
+    return np.stack([np.asarray(s) for s in batch])
+
+
+def _tree_to_numpy(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_to_numpy(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_to_numpy(v) for k, v in x.items()}
+    return x
+
+
+def _tree_to_tensor(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_to_tensor(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_to_tensor(v) for k, v in x.items()}
+    return x
+
+
+def _worker_loop(wid, nw, dataset, indexed_batches, batch_size, drop_last,
+                 collate_fn, worker_init_fn, result_q):
+    """Body of one DataLoader worker process (reference worker.py
+    _worker_loop): fetch, collate to numpy, ship (batch_id, data)."""
+    global _worker_info
+    try:
+        _worker_info = _WorkerInfo(id=wid, num_workers=nw, dataset=dataset)
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        collate = _collate_np if collate_fn is default_collate_fn \
+            else (lambda b: _tree_to_numpy(collate_fn(b)))
+        if indexed_batches is None:
+            # iterable dataset: this worker consumes its own iterator
+            batch = []
+            bid = wid
+            for item in dataset:
+                batch.append(item)
+                if len(batch) == batch_size:
+                    result_q.put(("ok", (bid, collate(batch))))
+                    bid += nw
+                    batch = []
+            if batch and not drop_last:
+                result_q.put(("ok", (bid, collate(batch))))
+        else:
+            for bid, idxs in indexed_batches:
+                result_q.put(
+                    ("ok", (bid, collate([dataset[i] for i in idxs]))))
+        result_q.put(("end", wid))
+    except BaseException:
+        import traceback
+
+        result_q.put(("err", traceback.format_exc()))
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
@@ -282,6 +357,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -314,6 +390,9 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        if self.num_workers > 0:
+            yield from self._multiprocess_iter()
+            return
         if not self.use_buffer_reader:
             yield from self._raw_iter()
             return
@@ -340,3 +419,108 @@ class DataLoader:
             yield item
         if err:
             raise err[0]
+
+    # -- multiprocess workers (reference dataloader/worker.py) ------------
+    def _multiprocess_iter(self):
+        """num_workers > 0: forked worker processes fetch + collate
+        batches to NUMPY (workers must not touch the accelerator
+        runtime); the main process reorders results by batch index so
+        iteration order is deterministic, then materializes Tensors.
+        Reference: dataloader_iter.py _DataLoaderIterMultiProcess +
+        worker.py (the C++ LoDTensorBlockingQueue role is played by the
+        mp.SimpleQueue + reorder buffer)."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        dataset = self.dataset
+        if isinstance(dataset, TensorDataset):
+            # device-backed tensors must be materialized in the PARENT:
+            # the forked child must not drive the inherited PJRT client
+            dataset = TensorDataset([
+                np.asarray(t.numpy()) if isinstance(t, Tensor) else t
+                for t in dataset.tensors])
+        if self._iterable_mode:
+            # each worker iterates its own dataset copy with worker_info
+            # set; batches are interleaved worker-major (reference
+            # iterable semantics: sharding is the dataset's job)
+            idx_queues = None
+            n_batches = None
+        else:
+            batches = list(self.batch_sampler)
+            n_batches = len(batches)
+        nw = self.num_workers
+        result_q = ctx.Queue()
+        workers = []
+
+        def _get():
+            # liveness-aware get: a worker killed by the OS (OOM/segv)
+            # never posts 'end', so a bare blocking get would hang the job
+            import queue as _q
+
+            while True:
+                try:
+                    return result_q.get(timeout=1.0)
+                except _q.Empty:
+                    for p in workers:
+                        if p.exitcode not in (None, 0):
+                            raise RuntimeError(
+                                f"DataLoader worker died with exit code "
+                                f"{p.exitcode} (killed by the OS?)")
+        try:
+            for wid in range(nw):
+                if self._iterable_mode:
+                    wargs = (wid, nw, dataset, None, self.batch_size,
+                             self.drop_last, self.collate_fn,
+                             self.worker_init_fn, result_q)
+                else:
+                    my = batches[wid::nw]
+                    my_ids = list(range(wid, n_batches, nw))
+                    wargs = (wid, nw, dataset, list(zip(my_ids, my)),
+                             None, None, self.collate_fn,
+                             self.worker_init_fn, result_q)
+                p = ctx.Process(target=_worker_loop, args=wargs,
+                                daemon=True)
+                p.start()
+                workers.append(p)
+            done = 0
+            if self._iterable_mode:
+                buf = []
+                while done < nw:
+                    kind, payload = _get()
+                    if kind == "err":
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{payload}")
+                    if kind == "end":
+                        done += 1
+                        continue
+                    yield _tree_to_tensor(payload[1])
+            else:
+                pending = {}
+                nxt = 0
+                while nxt < n_batches:
+                    if nxt in pending:
+                        yield _tree_to_tensor(pending.pop(nxt))
+                        nxt += 1
+                        continue
+                    kind, payload = _get()
+                    if kind == "err":
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{payload}")
+                    if kind == "end":
+                        done += 1
+                        if done == nw and nxt < n_batches and \
+                                nxt not in pending:
+                            missing = [i for i in range(nxt, n_batches)
+                                       if i not in pending]
+                            if missing:
+                                raise RuntimeError(
+                                    f"workers exited with batches "
+                                    f"{missing[:4]}... missing")
+                        continue
+                    pending[payload[0]] = payload[1]
+        finally:
+            for p in workers:
+                if p.is_alive():
+                    p.terminate()
+            for p in workers:
+                p.join(timeout=5)

@@ -1,0 +1,348 @@
+"""paddle.quantization — PTQ / QAT.
+
+Reference: python/paddle/quantization/ — config.py QuantConfig
+(add_layer_config/add_type_config), ptq.py PTQ (observer insertion →
+calibrate → convert), qat.py QAT (fake-quant insertion), observers
+(AbsmaxObserver ...) and fake quanters (FakeQuanterWithAbsMaxObserver).
+
+TPU-native: fake-quant is a traced elementwise op with a
+straight-through-estimator custom VJP, so QAT trains inside the same
+compiled step; observers are host-side running statistics updated at
+eager/calibration time. int8 execution itself is simulated
+(quantize→dequantize), matching the reference's simulated-quant
+training path; true int8 serving is an inference-engine concern.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Type
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "EMAObserver",
+           "FakeQuanterWithAbsMaxObserver", "quant_dequant"]
+
+
+# ---------------------------------------------------------------------------
+# fake quant with STE
+# ---------------------------------------------------------------------------
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through inside the clip range, zero outside
+    mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+    return g * mask, None, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+from paddle_tpu.ops import registry as _registry
+from paddle_tpu.ops.registry import register_emitter as _register
+
+
+@_register(name="fake_quant_dequant")
+def _fake_quant_emitter(x, scale=1.0, qmax=127.0):
+    """Registry op so the eager autograd tape records the STE vjp —
+    calling the raw jax function would silently detach quantized
+    weights from their gradients."""
+    return _fake_quant(x, jnp.asarray(scale, x.dtype),
+                       jnp.asarray(qmax, x.dtype))
+
+
+if "fake_quant_dequant" not in _registry.OPS:
+    _registry.build_registry([
+        {"op": "fake_quant_dequant", "tensor_args": ["x"],
+         "methods": []}])
+
+
+def quant_dequant(x, scale, bit_length=8):
+    """Simulated quantization (quantize->dequantize) of a Tensor."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = float(scale._data) if isinstance(scale, Tensor) else float(scale)
+    return _registry.API["fake_quant_dequant"](x, scale=s, qmax=qmax)
+
+
+# ---------------------------------------------------------------------------
+# observers
+# ---------------------------------------------------------------------------
+class _ObserverBase:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = 0.0
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        return max(self._scale, 1e-8)
+
+    def quant_axis(self):
+        return -1
+
+
+class AbsmaxObserver(_ObserverBase):
+    """Running max(|x|) (reference observers/abs_max.py)."""
+
+    def observe(self, x):
+        v = float(np.max(np.abs(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x))))
+        self._scale = max(self._scale, v)
+
+
+class EMAObserver(_ObserverBase):
+    """Exponential moving average of max(|x|) (reference
+    observers/ema.py semantics)."""
+
+    def __init__(self, quant_bits=8, decay=0.9):
+        super().__init__(quant_bits)
+        self.decay = decay
+        self._init = False
+
+    def observe(self, x):
+        v = float(np.max(np.abs(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x))))
+        if not self._init:
+            self._scale, self._init = v, True
+        else:
+            self._scale = self.decay * self._scale + (1 - self.decay) * v
+
+
+class FakeQuanterWithAbsMaxObserver(_ObserverBase):
+    """QAT quanter: observes while training and fake-quants in the same
+    pass (reference quanters/abs_max.py)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._init = False
+
+    def observe(self, x):
+        # under tracing we cannot host-read; keep last calibrated scale
+        xd = x._data if isinstance(x, Tensor) else x
+        if isinstance(xd, jax.core.Tracer):
+            return
+        v = float(np.max(np.abs(np.asarray(xd))))
+        if not self._init:
+            self._scale, self._init = v, True
+        else:
+            self._scale = self.moving_rate * self._scale + \
+                (1 - self.moving_rate) * v
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_act = activation
+        self._global_w = weight
+        self._layer_cfg = {}
+        self._type_cfg: Dict[Type, tuple] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        # NOTE: layer identity is matched by id(); pair with
+        # quantize(..., inplace=True) — a deepcopy changes identities
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _for_layer(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global_act or self._global_w:
+            return (self._global_act, self._global_w)
+        return None
+
+
+def _make(factory, default_cls):
+    if factory is None:
+        return default_cls()
+    if isinstance(factory, type):
+        return factory()
+    if callable(factory):
+        try:
+            return factory()
+        except TypeError:
+            return factory
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+class QuantedLayer(Layer):
+    """Wraps Linear/Conv2D: observe activations (+fake-quant in QAT)."""
+
+    def __init__(self, inner, act_observer, w_observer, train_quant):
+        super().__init__()
+        self.inner = inner
+        self.act_observer = act_observer
+        self.w_observer = w_observer
+        self.train_quant = train_quant  # QAT: fake-quant during forward
+        self.w_observer.observe(inner.weight)
+
+    def forward(self, x):
+        self.act_observer.observe(x)
+        if self.train_quant:
+            # re-observe the (training) weight every pass: a frozen init
+            # scale would clip growing weights and the STE would zero
+            # their gradients, stalling QAT (reference quanters observe
+            # per forward)
+            self.w_observer.observe(self.inner.weight)
+            x = quant_dequant(x, self.act_observer.scale(),
+                              self.act_observer.quant_bits)
+            w = quant_dequant(self.inner.weight, self.w_observer.scale(),
+                              self.w_observer.quant_bits)
+            return self._apply_inner(x, w)
+        return self.inner(x)
+
+    def _apply_inner(self, x, w):
+        from paddle_tpu import ops
+
+        if isinstance(self.inner, nn.Linear):
+            return ops.linear(x, w, self.inner.bias)
+        if isinstance(self.inner, nn.Conv2D):
+            c = self.inner
+            return ops.conv2d(x, w, c.bias, stride=c.stride,
+                              padding=c.padding, dilation=c.dilation,
+                              groups=c.groups)
+        raise NotImplementedError(type(self.inner))
+
+
+class ConvertedQuantLayer(Layer):
+    """Post-convert form: weights stored int8 + scale, dequantized (and
+    activations quant-dequant'ed) at forward — the simulated-int8
+    execution the reference's convert() produces for eval/export."""
+
+    def __init__(self, q: QuantedLayer):
+        super().__init__()
+        self.inner = q.inner
+        bits = q.w_observer.quant_bits
+        qmax = float(2 ** (bits - 1) - 1)
+        w = q.inner.weight.numpy()
+        self.w_scale = q.w_observer.scale()
+        self.qweight = np.clip(
+            np.round(w / self.w_scale * qmax), -qmax, qmax
+        ).astype(np.int8)
+        self.act_scale = q.act_observer.scale()
+        self.act_bits = q.act_observer.quant_bits
+        self._qmax = qmax
+
+    def forward(self, x):
+        from paddle_tpu import ops
+
+        x = quant_dequant(x, self.act_scale, self.act_bits)
+        w = Tensor(self.qweight.astype(np.float32)
+                   * (self.w_scale / self._qmax))
+        if isinstance(self.inner, nn.Linear):
+            return ops.linear(x, w, self.inner.bias)
+        c = self.inner
+        return ops.conv2d(x, w, c.bias, stride=c.stride,
+                          padding=c.padding, dilation=c.dilation,
+                          groups=c.groups)
+
+
+_DEFAULT_TYPES = (nn.Linear, nn.Conv2D)
+
+
+def _replace_child(parent, key, new_layer):
+    """Replace a sublayer IN PLACE in the parent's registry: setattr
+    would delete+reinsert the key, moving it to the end of the ordered
+    _sub_layers dict and scrambling Sequential execution order."""
+    if key in getattr(parent, "_sub_layers", {}):
+        parent._sub_layers[key] = new_layer
+    else:
+        setattr(parent, key, new_layer)
+
+
+def _swap_layers(model, config, train_quant, default_act, default_w):
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        child = getattr(parent, parts[-1])
+        if isinstance(child, _DEFAULT_TYPES):
+            cfg = config._for_layer(child) if config else None
+            act = _make(cfg[0] if cfg else None, default_act)
+            wob = _make(cfg[1] if cfg else None, default_w)
+            _replace_child(parent, parts[-1],
+                           QuantedLayer(child, act, wob, train_quant))
+    return model
+
+
+class PTQ:
+    """Post-training quantization (reference ptq.py): insert observers,
+    run calibration batches, convert()."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_layers(model, self.config, train_quant=False,
+                            default_act=AbsmaxObserver,
+                            default_w=AbsmaxObserver)
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for name, sub in list(model.named_sublayers(include_self=False)):
+            if isinstance(sub, QuantedLayer):
+                parent = model
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                _replace_child(parent, parts[-1],
+                               ConvertedQuantLayer(sub))
+        return model
+
+
+class QAT:
+    """Quantization-aware training (reference qat.py): fake-quant with
+    STE inside the training graph."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_layers(model, self.config, train_quant=True,
+                            default_act=FakeQuanterWithAbsMaxObserver,
+                            default_w=FakeQuanterWithAbsMaxObserver)
+
+    convert = PTQ.convert

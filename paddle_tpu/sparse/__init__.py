@@ -1,0 +1,261 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor; unary/binary ops; matmul) over the phi sparse
+kernels (paddle/phi/kernels/sparse/).
+
+TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA-traceable
+sparse formats whose matmuls lower to gather/scatter+MXU kernels. The
+wrapper keeps paddle's API shape (indices [ndim, nnz], crows/cols), and
+densifying ops interoperate with the regular Tensor/autograd world
+through to_dense().
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
+           "add", "subtract", "multiply", "divide", "matmul", "relu",
+           "tanh", "sqrt", "sin", "abs", "pow", "neg", "cast",
+           "transpose"]
+
+
+class _SparseBase:
+    def numel(self):
+        return int(np.prod(self.shape))
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    @property
+    def dtype(self):
+        from paddle_tpu.core.dtype import convert_dtype
+
+        return convert_dtype(self._mat.data.dtype)
+
+    def to_dense(self) -> Tensor:
+        return Tensor._from_data(self._mat.todense())
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={list(self.shape)}, "
+                f"nnz={self.nnz()}, dtype={self.dtype.name})")
+
+
+class SparseCooTensor(_SparseBase):
+    """COO: indices [sparse_dim, nnz] + values [nnz, ...dense dims]."""
+
+    def __init__(self, mat: "jsparse.BCOO"):
+        self._mat = mat
+        self.shape = tuple(mat.shape)
+
+    def indices(self) -> Tensor:
+        return Tensor._from_data(self._mat.indices.T.astype(jnp.int64))
+
+    def values(self) -> Tensor:
+        return Tensor._from_data(self._mat.data)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.sum_duplicates())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._mat.sum_duplicates()))
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+
+class SparseCsrTensor(_SparseBase):
+    """CSR: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, mat: "jsparse.BCSR"):
+        self._mat = mat
+        self.shape = tuple(mat.shape)
+
+    def crows(self) -> Tensor:
+        return Tensor._from_data(self._mat.indptr.astype(jnp.int64))
+
+    def cols(self) -> Tensor:
+        return Tensor._from_data(self._mat.indices.astype(jnp.int64))
+
+    def values(self) -> Tensor:
+        return Tensor._from_data(self._mat.data)
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.to_bcoo())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def _data_of(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """indices [sparse_dim, nnz] (paddle layout), values [nnz, ...]."""
+    idx = jnp.asarray(_data_of(indices), jnp.int32).T  # -> [nnz, ndim]
+    vals = _data_of(values)
+    if dtype is not None:
+        from paddle_tpu.core.dtype import to_jax
+
+        vals = vals.astype(to_jax(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0)) + \
+            tuple(vals.shape[1:])
+    mat = jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    vals = _data_of(values)
+    if dtype is not None:
+        from paddle_tpu.core.dtype import to_jax
+
+        vals = vals.astype(to_jax(dtype))
+    mat = jsparse.BCSR(
+        (vals, jnp.asarray(_data_of(cols), jnp.int32),
+         jnp.asarray(_data_of(crows), jnp.int32)),
+        shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(mat)
+
+
+def is_sparse(x):
+    return isinstance(x, _SparseBase)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _coo(x) -> "jsparse.BCOO":
+    if isinstance(x, SparseCooTensor):
+        return x._mat
+    if isinstance(x, SparseCsrTensor):
+        return x._mat.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _wrap_like(x, mat):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat))
+    return SparseCooTensor(mat)
+
+
+# -- elementwise on values (zero-preserving unary ops) ----------------------
+def _unary(fn):
+    def op(x):
+        m = _coo(x)
+        return _wrap_like(x, jsparse.BCOO((fn(m.data), m.indices),
+                                          shape=m.shape))
+
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+sin = _unary(jnp.sin)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor):
+    m = _coo(x)
+    return _wrap_like(x, jsparse.BCOO((m.data ** factor, m.indices),
+                                      shape=m.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from paddle_tpu.core.dtype import to_jax
+
+    m = _coo(x)
+    vals = m.data if value_dtype is None else m.data.astype(
+        to_jax(value_dtype))
+    idx = m.indices if index_dtype is None else m.indices.astype(
+        to_jax(index_dtype))
+    return _wrap_like(x, jsparse.BCOO((vals, idx), shape=m.shape))
+
+
+# -- binary -----------------------------------------------------------------
+def _binary(fn, densify_rhs=False):
+    def op(x, y):
+        if isinstance(y, _SparseBase) and not densify_rhs:
+            out = fn(_coo(x).todense(), _coo(y).todense())
+            return SparseCooTensor(jsparse.BCOO.fromdense(out))
+        yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        out = fn(_coo(x).todense(), yv)
+        return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def matmul(x, y) -> Tensor:
+    """sparse @ dense -> dense (reference sparse.matmul); lowers to the
+    XLA scatter/gather dot."""
+    yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor._from_data(_coo(x) @ yv)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
+    """dense @ dense evaluated only at mask's nonzero positions
+    (reference sparse.masked_matmul)."""
+    m = _coo(mask)
+    xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def transpose(x, perm):
+    m = _coo(x)
+    return SparseCooTensor(m.transpose(tuple(perm)))
+
+
+# -- Tensor interop (reference: Tensor.to_sparse_coo / to_dense) ------------
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    nd = self._data.ndim
+    n_dense = 0 if sparse_dim is None else nd - int(sparse_dim)
+    return SparseCooTensor(jsparse.BCOO.fromdense(self._data,
+                                                  n_dense=n_dense))
+
+
+def _tensor_to_sparse_csr(self):
+    return SparseCooTensor(
+        jsparse.BCOO.fromdense(self._data)).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr

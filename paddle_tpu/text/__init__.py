@@ -1,0 +1,188 @@
+"""paddle.text — text utilities + datasets.
+
+Reference: python/paddle/text/ — viterbi_decode.py (ViterbiDecoder /
+viterbi_decode over CRF transition scores) and datasets/ (Imdb,
+Imikolov, UCIHousing, ... download-backed; here: real file parsing when
+files exist, deterministic synthetic fallback — the vision/datasets.py
+pattern, since this image has no network egress).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import Dataset
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Max-score tag path through a linear-chain CRF (reference
+    text/viterbi_decode.py:24).
+
+    potentials: [B, T, N] unary scores; transition_params: [N, N];
+    lengths: [B] int64 (defaults to full length). Returns
+    (scores [B], paths [B, T]). Implemented as a lax.scan over time —
+    compiler-friendly dynamic programming (no Python loop in the jit).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    pot = potentials._data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._data \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    b, t, n = pot.shape
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = jnp.asarray(lengths._data if isinstance(lengths, Tensor)
+                           else lengths, jnp.int32)
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 is BOS, N-1 is EOS
+        start = pot[:, 0] + trans[n - 2][None, :]
+    else:
+        start = pot[:, 0]
+
+    def step(carry, xs):
+        alpha, backs_t = carry
+        emit, tstep = xs
+        # alpha [B, N]; score of arriving at tag j: alpha_i + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)              # [B, N]
+        alpha_new = jnp.max(scores, axis=1) + emit           # [B, N]
+        # positions beyond each sequence's length keep their alpha
+        live = (tstep < lens)[:, None]
+        alpha_out = jnp.where(live, alpha_new, alpha)
+        return (alpha_out, None), jnp.where(live, best_prev, -1)
+
+    (alpha, _), backpointers = lax.scan(
+        step, (start, None),
+        (jnp.moveaxis(pot[:, 1:], 1, 0), jnp.arange(1, t)))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)                    # [B]
+
+    def backward(tag, bp):
+        # emit the PREDECESSOR tag: walking bp for t=T-1..1 yields tags
+        # at positions T-2..0 (the tag at T-1 is last_tag, appended below)
+        prev = jnp.where(bp[jnp.arange(b), tag] < 0, tag,
+                         bp[jnp.arange(b), tag])
+        return prev, prev
+
+    _, path_rev = lax.scan(backward, last_tag, backpointers[::-1])
+    paths = jnp.concatenate(
+        [path_rev[::-1].T, last_tag[:, None]], axis=1)       # [B, T]
+    return Tensor._from_data(scores), \
+        Tensor._from_data(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference ViterbiDecoder:117) holding the
+    transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(np.asarray(transitions, np.float32))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression (reference text/datasets/uci_housing.py);
+    real data file when present, deterministic synthetic otherwise."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/uci_housing/housing.data")
+        if os.path.exists(path):
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rng = np.random.RandomState(7)
+            X = rng.randn(506, self.FEATURES).astype(np.float32)
+            w = rng.randn(self.FEATURES, 1).astype(np.float32)
+            y = X @ w + rng.randn(506, 1).astype(np.float32) * 0.1
+            raw = np.concatenate([X, y], axis=1)
+        X, y = raw[:, :-1], raw[:, -1:]
+        X = (X - X.mean(0)) / (X.std(0) + 1e-8)
+        split = int(len(X) * 0.8)
+        if mode == "train":
+            self.data, self.label = X[:split], y[:split]
+        else:
+            self.data, self.label = X[split:], y[split:]
+
+    def __getitem__(self, i):
+        return self.data[i], self.label[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py); parses the
+    aclImdb archive when present, class-conditional synthetic token
+    sequences otherwise."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 vocab_size=5000, seq_len=128, n_samples=2000):
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/imdb/aclImdb_v1.tar.gz")
+        self.vocab_size = vocab_size
+        if os.path.exists(path):
+            self.docs, self.labels = self._load_real(path, mode, cutoff)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            labels = rng.randint(0, 2, n_samples).astype(np.int64)
+            # class-conditional unigram shift so models can learn
+            docs = []
+            for lbl in labels:
+                base = rng.zipf(1.3, seq_len) % (vocab_size // 2)
+                docs.append((base + lbl * (vocab_size // 2)).astype(
+                    np.int64))
+            self.docs, self.labels = docs, labels
+
+    def _load_real(self, path, mode, cutoff):
+        import re
+        import tarfile
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        texts = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                mt = pat.match(m.name)
+                if not mt:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "latin-1").lower().split()
+                texts.append((words, 1 if mt.group(1) == "pos" else 0))
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: -kv[1])) if c >= cutoff}
+        unk = len(vocab)
+        for words, lbl in texts:
+            docs.append(np.asarray([vocab.get(w, unk) for w in words],
+                                   np.int64))
+            labels.append(lbl)
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)

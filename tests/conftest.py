@@ -41,3 +41,18 @@ def _seed_all():
 
     paddle_tpu.seed(1234)
     yield
+
+# Persistent XLA compilation cache: the suite compiles hundreds of graphs
+# (every model family x train/eval); caching them on disk makes re-runs
+# dramatically faster without changing what gets exercised.
+import tempfile as _tempfile  # noqa: E402
+
+_cache_dir = os.environ.get(
+    "PADDLE_TPU_TEST_CACHE",
+    os.path.join(_tempfile.gettempdir(), "paddle_tpu_xla_cache"))
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass

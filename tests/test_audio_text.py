@@ -1,0 +1,139 @@
+"""audio features + text viterbi/datasets (reference: python/paddle/
+audio/, python/paddle/text/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu import text
+
+
+# ---------------------------------------------------------------------------
+# audio functional
+# ---------------------------------------------------------------------------
+def test_hz_mel_roundtrip():
+    for htk in (False, True):
+        for hz in (60.0, 440.0, 4000.0):
+            mel = audio.functional.hz_to_mel(hz, htk=htk)
+            back = audio.functional.mel_to_hz(mel, htk=htk)
+            np.testing.assert_allclose(back, hz, rtol=1e-5)
+
+
+def test_fbank_matrix_properties():
+    fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+    m = fb.numpy()
+    assert m.shape == (40, 257)
+    assert (m >= 0).all()
+    # every filter has support
+    assert (m.sum(axis=1) > 0).all()
+
+
+def test_window_functions():
+    for w in ("hann", "hamming", "blackman", "rect"):
+        win = audio.functional.get_window(w, 64).numpy()
+        assert win.shape == (64,)
+        assert win.max() <= 1.0 + 1e-6
+    hann = audio.functional.get_window("hann", 64).numpy()
+    np.testing.assert_allclose(hann[0], 0.0, atol=1e-7)
+
+
+def test_spectrogram_parseval_sine():
+    """A pure tone concentrates energy in the right frequency bin."""
+    sr, n_fft = 8000, 256
+    t = np.arange(sr, dtype=np.float32) / sr
+    freq = 1000.0
+    x = paddle.to_tensor(np.sin(2 * np.pi * freq * t))
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=128)(x)
+    s = spec.numpy()  # [freq_bins, frames]
+    assert s.shape[0] == 1 + n_fft // 2
+    peak_bin = s.mean(axis=1).argmax()
+    expect_bin = round(freq * n_fft / sr)
+    assert abs(int(peak_bin) - expect_bin) <= 1, (peak_bin, expect_bin)
+
+
+def test_mel_spectrogram_and_mfcc_shapes():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+    mel = audio.MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 32
+    logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+    assert logmel.shape == mel.shape
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mfcc.shape[0] == 2 and mfcc.shape[1] == 13
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+def test_viterbi_decode_simple_chain():
+    """A chain with a dominant diagonal transition keeps the best tag."""
+    B, T, N = 2, 5, 4
+    pot = np.full((B, T, N), -1.0, np.float32)
+    pot[:, :, 1] = 2.0  # tag 1 always best unary
+    trans = np.full((N, N), -0.5, np.float32)
+    np.fill_diagonal(trans, 1.0)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    assert list(paths.shape) == [B, T]
+    np.testing.assert_array_equal(paths.numpy(),
+                                  np.full((B, T), 1, np.int64))
+    # score = T*2 unary + (T-1)*1 diagonal transitions
+    np.testing.assert_allclose(scores.numpy(),
+                               np.full((B,), 2.0 * T + (T - 1) * 1.0),
+                               rtol=1e-5)
+
+
+def test_viterbi_decoder_layer_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 4, 3
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans),
+                              include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot))
+
+    # brute force over all tag sequences
+    import itertools
+
+    for b in range(B):
+        best, best_path = -np.inf, None
+        for seq in itertools.product(range(N), repeat=T):
+            s = pot[b, 0, seq[0]]
+            for t in range(1, T):
+                s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(paths.numpy()[b],
+                                      np.asarray(best_path))
+
+
+def test_uci_housing_trains():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.io import DataLoader
+
+    ds = text.UCIHousing(mode="train")
+    assert len(ds) > 100
+    m = nn.Linear(13, 1)
+    opt = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    losses = []
+    for _ in range(3):
+        for xb, yb in DataLoader(ds, batch_size=64, shuffle=True):
+            losses.append(float(step(xb, yb).item()))
+    assert losses[-1] < losses[0]
+
+
+def test_imdb_synthetic_separable():
+    ds = text.Imdb(mode="train", n_samples=200)
+    doc, lbl = ds[0]
+    assert doc.dtype == np.int64
+    # class-conditional vocab ranges hold
+    for i in range(50):
+        d, l = ds[i]
+        if l == 0:
+            assert d.max() < ds.vocab_size // 2
+        else:
+            assert d.min() >= ds.vocab_size // 2

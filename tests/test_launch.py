@@ -113,3 +113,43 @@ def test_launcher_env_protocol(tmp_path):
          "--nproc_per_node", "2", str(worker)],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_launcher_elastic_restart(tmp_path):
+    """--max_restart relaunches the whole gang after a failure (elastic
+    manager role, reference fleet/elastic/manager.py:124): a worker that
+    fails on its first attempt succeeds after one restart."""
+    worker = tmp_path / "worker.py"
+    marker = tmp_path / "attempted"
+    worker.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if os.environ["PADDLE_TRAINER_ID"] == "0":
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                sys.exit(3)  # first attempt dies
+            assert os.environ["PADDLE_RESTART_COUNT"] == "1"
+        print("ELASTIC_OK", os.environ["PADDLE_TRAINER_ID"], flush=True)
+    """))
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "2",
+         "--restart_interval", "0.1", "--log_dir", str(log_dir),
+         str(worker)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    logs = "".join(f.read_text() for f in sorted(log_dir.glob("workerlog.*")))
+    assert r.returncode == 0, (r.returncode, logs, r.stderr)
+    assert "ELASTIC_OK 0" in logs and "ELASTIC_OK 1" in logs, logs
+
+
+def test_launcher_max_restart_exhausted(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text("import sys; sys.exit(9)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restart", "1",
+         "--restart_interval", "0.1", str(worker)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 9
+    assert r.stderr.count("restarting") == 1, r.stderr

@@ -1,0 +1,95 @@
+"""paddle.sparse COO/CSR (reference: python/paddle/sparse/ over phi
+sparse kernels; numerics vs dense numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _dense_example():
+    d = np.zeros((4, 5), np.float32)
+    d[0, 1] = 2.0
+    d[2, 3] = -1.5
+    d[3, 0] = 4.0
+    return d
+
+
+def test_sparse_coo_roundtrip():
+    d = _dense_example()
+    idx = np.array(np.nonzero(d))
+    vals = d[tuple(idx)]
+    s = sparse.sparse_coo_tensor(idx, vals, shape=d.shape)
+    assert sparse.is_sparse_coo(s)
+    assert s.nnz() == 3
+    np.testing.assert_array_equal(s.to_dense().numpy(), d)
+    np.testing.assert_array_equal(s.indices().numpy(), idx)
+    np.testing.assert_allclose(s.values().numpy(), vals)
+
+
+def test_sparse_csr_roundtrip():
+    d = _dense_example()
+    # CSR of d
+    crows = [0, 1, 1, 2, 3]
+    cols = [1, 3, 0]
+    vals = [2.0, -1.5, 4.0]
+    s = sparse.sparse_csr_tensor(crows, cols, vals, shape=d.shape)
+    assert sparse.is_sparse_csr(s)
+    np.testing.assert_array_equal(s.to_dense().numpy(), d)
+    coo = s.to_sparse_coo()
+    np.testing.assert_array_equal(coo.to_dense().numpy(), d)
+    back = coo.to_sparse_csr()
+    np.testing.assert_array_equal(back.to_dense().numpy(), d)
+
+
+def test_tensor_to_sparse_and_back():
+    d = _dense_example()
+    t = paddle.to_tensor(d)
+    s = t.to_sparse_coo()
+    assert s.nnz() == 3
+    np.testing.assert_array_equal(s.to_dense().numpy(), d)
+    c = t.to_sparse_csr()
+    np.testing.assert_array_equal(c.to_dense().numpy(), d)
+
+
+def test_sparse_unary_zero_preserving():
+    d = _dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(),
+                               np.maximum(d, 0))
+    np.testing.assert_allclose(sparse.tanh(s).to_dense().numpy(),
+                               np.tanh(d), rtol=1e-6)
+    np.testing.assert_allclose(sparse.neg(s).to_dense().numpy(), -d)
+    # nnz unchanged: ops act on stored values only
+    assert sparse.relu(s).nnz() == s.nnz()
+
+
+def test_sparse_binary_and_matmul():
+    d = _dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    other = np.ones_like(d)
+    out = sparse.add(s, paddle.to_tensor(other))
+    np.testing.assert_allclose(out.to_dense().numpy(), d + 1)
+    rng = np.random.RandomState(0)
+    w = rng.randn(5, 3).astype(np.float32)
+    mm = sparse.matmul(s, paddle.to_tensor(w))
+    np.testing.assert_allclose(mm.numpy(), d @ w, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(6, 5).astype(np.float32)
+    mask_d = (_dense_example() != 0).astype(np.float32)
+    mask = paddle.to_tensor(mask_d).to_sparse_coo()
+    out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                               mask)
+    np.testing.assert_allclose(out.to_dense().numpy(), (a @ b) * mask_d,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_transpose_and_cast():
+    d = _dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_array_equal(t.to_dense().numpy(), d.T)

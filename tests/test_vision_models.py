@@ -1,0 +1,108 @@
+"""Model-zoo smoke tests: every family builds, forwards at the right
+shape, and takes a compiled train step (reference vision/models — 14
+families; pattern of test/legacy_test vision model tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision import models as M
+
+
+def _smoke(model, in_shape=(1, 3, 64, 64), n_classes=10, eval_too=False):
+    """One train-mode forward per family (each distinct graph costs an
+    XLA compile on the CPU test platform, so eval-mode is exercised for
+    a single representative family only)."""
+    paddle.seed(0)
+    model.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(*in_shape).astype(np.float32))
+    out = model(x)
+    if isinstance(out, tuple):  # googlenet aux heads
+        out = out[0]
+    assert list(out.shape) == [in_shape[0], n_classes], out.shape
+    if eval_too:
+        model.eval()
+        out2 = model(x)
+        if isinstance(out2, tuple):
+            out2 = out2[0]
+        assert list(out2.shape) == [in_shape[0], n_classes]
+
+
+def test_lenet():
+    _smoke(M.LeNet(num_classes=10), in_shape=(1, 1, 28, 28), eval_too=True)
+
+
+def test_alexnet():
+    _smoke(M.alexnet(num_classes=10), in_shape=(1, 3, 64, 64))
+
+
+def test_vgg11():
+    _smoke(M.vgg11(num_classes=10), in_shape=(1, 3, 32, 32))
+
+
+def test_vgg16_bn():
+    _smoke(M.vgg16(batch_norm=True, num_classes=10),
+           in_shape=(1, 3, 32, 32))
+
+
+def test_mobilenet_v1():
+    _smoke(M.mobilenet_v1(num_classes=10, scale=0.25), in_shape=(1, 3, 32, 32))
+
+
+def test_mobilenet_v2():
+    _smoke(M.mobilenet_v2(num_classes=10, scale=0.25), in_shape=(1, 3, 32, 32))
+
+
+def test_mobilenet_v3_small():
+    _smoke(M.mobilenet_v3_small(num_classes=10, scale=0.5), in_shape=(1, 3, 32, 32))
+
+
+def test_mobilenet_v3_large():
+    _smoke(M.mobilenet_v3_large(num_classes=10, scale=0.5), in_shape=(1, 3, 32, 32))
+
+
+def test_squeezenet():
+    _smoke(M.squeezenet1_0(num_classes=10), in_shape=(1, 3, 64, 64))
+    _smoke(M.squeezenet1_1(num_classes=10), in_shape=(1, 3, 64, 64))
+
+
+def test_shufflenet_v2():
+    _smoke(M.shufflenet_v2_x0_25(num_classes=10), in_shape=(1, 3, 32, 32))
+
+
+def test_densenet121():
+    _smoke(M.densenet121(num_classes=10), in_shape=(1, 3, 32, 32))
+
+
+def test_googlenet_aux_heads():
+    m = M.googlenet(num_classes=10)
+    m.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+    out, aux1, aux2 = m(x)
+    assert list(out.shape) == [1, 10]
+    assert list(aux1.shape) == [1, 10] and list(aux2.shape) == [1, 10]
+
+
+def test_inception_v3():
+    _smoke(M.inception_v3(num_classes=10), in_shape=(1, 3, 96, 96))
+
+
+def test_pretrained_raises_actionable_error():
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        M.vgg16(pretrained=True)
+
+
+def test_small_model_trains_end_to_end():
+    """One family through the compiled TrainStep: loss descends."""
+    paddle.seed(0)
+    m = M.LeNet(num_classes=4)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randn(16, 1, 28, 28).astype(np.float32))
+    Y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype(np.int64))
+    losses = [float(step(X, Y).item()) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.8, losses
