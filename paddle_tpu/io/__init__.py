@@ -357,6 +357,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -449,7 +450,19 @@ class DataLoader:
             batches = list(self.batch_sampler)
             n_batches = len(batches)
         nw = self.num_workers
-        result_q = ctx.Queue()
+        # transport: native C++ shared-memory ring buffer (one memcpy per
+        # batch; the reference's LoDTensorBlockingQueue role) when
+        # available and use_shared_memory, else an mp.Queue (pickle)
+        result_q = None
+        if self.use_shared_memory:
+            try:
+                from paddle_tpu.io.shm_queue import ShmQueue
+
+                result_q = ShmQueue()
+            except Exception:
+                result_q = None
+        if result_q is None:
+            result_q = ctx.Queue()
         workers = []
 
         def _get():
@@ -466,6 +479,11 @@ class DataLoader:
                             raise RuntimeError(
                                 f"DataLoader worker died with exit code "
                                 f"{p.exitcode} (killed by the OS?)")
+                except EOFError:
+                    # shm transport: closed by a recovered dead-writer
+                    raise RuntimeError(
+                        "DataLoader shm queue closed unexpectedly (a "
+                        "worker died mid-record?)")
         try:
             for wid in range(nw):
                 if self._iterable_mode:
