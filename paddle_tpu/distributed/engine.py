@@ -196,9 +196,14 @@ class ParallelTrainStep:
 
         self._batch_sharding = batch_sharding
 
-        def step_fn(param_datas, slot_list, buffer_datas, step, lr, key,
+        def step_fn(carry, param_datas, slot_list, buffer_datas, lr,
                     scaler_state, *batch):
             set_current_mesh(mesh)
+            # device-carried (step, rng chain) — committed-args fast path,
+            # no per-step host scalar transfer (see jit/train.py)
+            step, chain = carry
+            step = step + 1.0
+            chain, key = jax.random.split(chain)
             scaling = scaler_state is not None
 
             def loss_of(trainable_params):
@@ -264,25 +269,36 @@ class ParallelTrainStep:
                 new_params[i] = np_
                 new_slots[i] = ns
             set_current_mesh(None)
-            return loss, new_params, new_slots, new_buffers, \
-                new_scaler_state
+            return loss, (step, chain), new_params, new_slots, \
+                new_buffers, new_scaler_state
 
         self._step_fn = step_fn
         self._jitted = None  # built lazily at first call (needs batch avals)
+        # step seeds from the optimizer counter so checkpoint resume keeps
+        # bias correction right (see jit/train.py _sync_step_carry)
+        self._carry = (jnp.asarray(float(optimizer._step_count),
+                                   jnp.float32),
+                       gen.default_generator.next_key())
+        self._host_step_mirror = optimizer._step_count
+        self._lr_val = None
+        self._lr_arr = None
 
     def _build_jit(self, batch_datas):
         scaler_sh = self._repl if self._scaler_state is not None else None
+        carry_sh = (self._repl, self._repl)
         in_shardings = (
+            carry_sh,
             self._param_sh,
             [{k: self._slot_sh[i] for k in s} for i, s in
              enumerate(self._slots)],
             [self._repl] * len(self._buffers),
-            self._repl, self._repl, self._repl,
+            self._repl,
             scaler_sh,
             *[self._batch_sharding(b.ndim) for b in batch_datas],
         )
         out_shardings = (
             self._repl,  # loss
+            carry_sh,
             self._param_sh,
             [{k: self._slot_sh[i] for k in s} for i, s in
              enumerate(self._slots)],
@@ -292,29 +308,39 @@ class ParallelTrainStep:
         self._jitted = jax.jit(self._step_fn,
                                in_shardings=in_shardings,
                                out_shardings=out_shardings,
-                               donate_argnums=(0, 1))
+                               donate_argnums=(0, 1, 2, 3))
 
-    def __call__(self, *batch):
-        datas = tuple(
+    def _place_batch(self, batch):
+        return tuple(
             jax.device_put(
                 b._data if isinstance(b, Tensor) else jnp.asarray(b),
                 self._batch_sharding(
                     (b._data if isinstance(b, Tensor)
                      else jnp.asarray(b)).ndim))
             for b in batch)
+
+    def __call__(self, *batch):
+        datas = self._place_batch(batch)
         if self._jitted is None:
             self._build_jit(datas)
-        self._opt._step_count += 1
-        lr = jnp.asarray(self._opt.get_lr(), dtype=jnp.float32)
-        step = jnp.asarray(float(self._opt._step_count), dtype=jnp.float32)
-        key = gen.default_generator.next_key()
+        if self._opt._step_count != self._host_step_mirror:
+            # optimizer counter changed externally (checkpoint resume)
+            self._carry = (jnp.asarray(float(self._opt._step_count),
+                                       jnp.float32), self._carry[1])
+        self._opt._step_count += 1  # host mirror (schedulers, state_dict)
+        self._host_step_mirror = self._opt._step_count
+        lr_val = float(self._opt.get_lr())
+        if self._lr_arr is None or lr_val != self._lr_val:
+            self._lr_val = lr_val
+            self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
         set_current_mesh(self._mesh)
         try:
-            loss, new_params, new_slots, new_buffers, new_scaler_state = \
-                self._jitted(param_datas, self._slots, buffer_datas, step,
-                             lr, key, self._scaler_state, *datas)
+            loss, self._carry, new_params, new_slots, new_buffers, \
+                new_scaler_state = self._jitted(
+                    self._carry, param_datas, self._slots, buffer_datas,
+                    self._lr_arr, self._scaler_state, *datas)
         finally:
             set_current_mesh(None)
         for p, np_ in zip(self._params, new_params):

@@ -166,6 +166,14 @@ class PipelineTrainStep:
             for s, sh in zip(self._stacked_body, self._body_sh)]
 
         self._jitted = None
+        # step seeds from the optimizer counter so checkpoint resume keeps
+        # bias correction right (see jit/train.py _sync_step_carry)
+        self._carry = (jnp.asarray(float(optimizer._step_count),
+                                   jnp.float32),
+                       gen.default_generator.next_key())
+        self._host_step_mirror = optimizer._step_count
+        self._lr_val = None
+        self._lr_arr = None
 
     # ------------------------------------------------------------------
     def _make_step_fn(self):
@@ -189,9 +197,14 @@ class PipelineTrainStep:
             h, _ = lax.scan(step, h, tuple(params_leaves))
             return h
 
-        def step_fn(pre_p, body_p, post_p, pre_s, body_s, post_s,
-                    pre_b, post_b, step, lr, key, scaler_state, x, y):
+        def step_fn(carry, pre_p, body_p, post_p, pre_s, body_s, post_s,
+                    pre_b, post_b, lr, scaler_state, x, y):
             set_current_mesh(mesh)
+            # device-carried (step, rng chain): committed-args fast path,
+            # no per-step host scalar transfer (see jit/train.py)
+            step, chain = carry
+            step = step + 1.0
+            chain, key = jax.random.split(chain)
             from paddle_tpu import amp as _amp
 
             scaling = scaler_state is not None
@@ -322,7 +335,8 @@ class PipelineTrainStep:
             for j, i in shared_post.items():
                 npost[j] = npre[i]
             set_current_mesh(None)
-            return (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
+            return (loss, (step, chain), npre, nbody, npost,
+                    npre_s, nbody_s, npost_s,
                     new_pre_b, new_post_b, new_scaler_state)
 
         return step_fn
@@ -354,16 +368,18 @@ class PipelineTrainStep:
             scaler_sh = None if self._scaler_state is None else self._repl
             self._jitted = jax.jit(
                 step_fn,
-                in_shardings=(self._pre_sh, self._body_sh, self._post_sh,
+                in_shardings=((self._repl, self._repl),
+                              self._pre_sh, self._body_sh, self._post_sh,
                               slot_sh(self._pre_sh, self._pre_slots),
                               slot_sh(self._body_sh, self._body_slots),
                               slot_sh(self._post_sh, self._post_slots),
                               [self._repl] * len(self._pre_buffers),
                               [self._repl] * len(self._post_buffers),
-                              self._repl, self._repl, self._repl,
+                              self._repl,
                               scaler_sh,
                               bsh(xd.ndim), bsh(yd.ndim)),
-                out_shardings=(self._repl, self._pre_sh, self._body_sh,
+                out_shardings=(self._repl, (self._repl, self._repl),
+                               self._pre_sh, self._body_sh,
                                self._post_sh,
                                slot_sh(self._pre_sh, self._pre_slots),
                                slot_sh(self._body_sh, self._body_slots),
@@ -371,23 +387,30 @@ class PipelineTrainStep:
                                [self._repl] * len(self._pre_buffers),
                                [self._repl] * len(self._post_buffers),
                                scaler_sh),
-                donate_argnums=(0, 1, 2, 3, 4, 5))
-        self._opt._step_count += 1
-        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        stp = jnp.asarray(float(self._opt._step_count), jnp.float32)
-        key = gen.default_generator.next_key()
+                donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        if self._opt._step_count != self._host_step_mirror:
+            # optimizer counter changed externally (checkpoint resume)
+            self._carry = (jnp.asarray(float(self._opt._step_count),
+                                       jnp.float32), self._carry[1])
+        self._opt._step_count += 1  # host mirror (schedulers, state_dict)
+        self._host_step_mirror = self._opt._step_count
+        lr_val = float(self._opt.get_lr())
+        if self._lr_arr is None or lr_val != self._lr_val:
+            self._lr_val = lr_val
+            self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
         set_current_mesh(self._mesh)
         try:
-            (loss, npre, nbody, npost, npre_s, nbody_s, npost_s,
-             npre_b, npost_b, nscaler) = \
-                self._jitted([p._data for p in self._pre_params],
+            (loss, self._carry, npre, nbody, npost, npre_s, nbody_s,
+             npost_s, npre_b, npost_b, nscaler) = \
+                self._jitted(self._carry,
+                             [p._data for p in self._pre_params],
                              self._stacked_body,
                              [p._data for p in self._post_params],
                              self._pre_slots, self._body_slots,
                              self._post_slots,
                              [b._data for b in self._pre_buffers],
                              [b._data for b in self._post_buffers],
-                             stp, lr, key, self._scaler_state, xd, yd)
+                             self._lr_arr, self._scaler_state, xd, yd)
         finally:
             set_current_mesh(None)
         for p, d in zip(self._pre_params, npre):
