@@ -24,9 +24,29 @@ import jax.numpy as jnp
 __all__ = [
     "GradNode", "AccumulationNode", "backward", "no_grad", "enable_grad",
     "is_grad_enabled", "set_grad_enabled", "register_node", "Hook",
+    "register_post_backward_callback",
 ]
 
 _state = threading.local()
+
+# Callbacks fired once after each backward() finishes draining its queue —
+# the seam where the reference's EagerReducer finalizes gradient buckets
+# (paddle/fluid/distributed/collective/reducer.cc FinalizeBackward).
+_post_backward_callbacks: List[Callable] = []
+
+
+def register_post_backward_callback(fn: Callable):
+    """Register fn() to run at the end of every backward(). Returns a
+    remover handle."""
+    _post_backward_callbacks.append(fn)
+
+    def remove():
+        try:
+            _post_backward_callbacks.remove(fn)
+        except ValueError:
+            pass
+
+    return remove
 
 
 def is_grad_enabled() -> bool:
@@ -262,6 +282,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             indegree[id(prod)] -= 1
             if indegree[id(prod)] == 0:
                 queue.append(prod)
+
+    for cb in list(_post_backward_callbacks):
+        cb()
 
 
 def _zero_cotangent(av):

@@ -19,9 +19,10 @@ from paddle_tpu.distributed.api import (  # noqa: F401
 )
 from paddle_tpu.distributed.communication import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
-    barrier, broadcast, get_group, new_group, reduce, reduce_scatter,
-    scatter, stream,
+    barrier, broadcast, get_group, irecv, isend, new_group, recv, reduce,
+    reduce_scatter, scatter, send, shift, stream,
 )
+from paddle_tpu.distributed.store import FileStore, Store  # noqa: F401
 from paddle_tpu.distributed.topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, ParallelMode,
 )

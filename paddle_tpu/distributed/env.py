@@ -76,6 +76,12 @@ def init_parallel_env():
                 "python -m paddle_tpu.distributed.launch or export RANK.")
         port = os.environ.get("MASTER_PORT", "8476")
         addr = coord if ":" in coord else f"{coord}:{port}"
+        try:
+            # CPU debug backend: real cross-process collectives need the
+            # gloo transport (the reference's Gloo CPU ProcessGroup role)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # already-initialized backend or no CPU client
+            pass
         jax.distributed.initialize(
             coordinator_address=addr,
             num_processes=int(world),

@@ -1,21 +1,124 @@
-"""DataParallel wrapper.
+"""DataParallel wrapper with a real eager gradient reducer.
 
 Reference: python/paddle/distributed/parallel.py:202 (DataParallel) +
 C++ EagerReducer (paddle/fluid/distributed/collective/reducer.h:88 —
-bucketed grad fusion with overlapped allreduce).
+bucketed grad fusion with overlapped allreduce, find_unused_parameters,
+no_sync suppression).
 
 TPU-native: under a compiled step with a dp-sharded batch and replicated
 params, XLA inserts the gradient all-reduce itself and overlaps it with
-backward compute (the reducer's whole job). This wrapper exists for API
-parity: it marks the model for dp and provides the no_sync context.
+backward compute — that path needs no reducer. This wrapper implements
+the *eager* multi-process contract: parameters are broadcast from rank 0
+at wrap time, and every ``backward()`` ends with bucketed, fused
+all-reduces of the produced grads over the dp group (dispatched async —
+XLA queues them while the host continues). ``no_sync`` suppresses the
+sync so grads accumulate locally; the next synced backward reduces the
+accumulated value, matching the reference's semantics.
 """
 from __future__ import annotations
 
 import contextlib
+from typing import List, Optional
 
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import engine as _engine
 from paddle_tpu.nn.layer import Layer
 
 __all__ = ["DataParallel"]
+
+
+class _EagerReducer:
+    """Bucketed post-backward gradient all-reduce (EagerReducer role).
+
+    The reference buckets grads as they become ready during backward and
+    overlaps NCCL with remaining compute (reducer.h:88). Here readiness
+    order is recorded by leaf accumulation hooks; the flush runs when the
+    engine finishes (register_post_backward_callback) and dispatches one
+    fused all-reduce per ~``bucket_mb`` of grads. Dispatch is async, so
+    successive buckets pipeline on device; a flush at engine-end (rather
+    than mid-backward) keeps multi-contribution grads correct without the
+    reference's expected-use counting.
+    """
+
+    def __init__(self, params: List, group, bucket_mb: float = 25.0,
+                 find_unused_parameters: bool = False):
+        self._params = [p for p in params if not p.stop_gradient]
+        self._group = group
+        self._bucket_bytes = int(bucket_mb * 1024 * 1024)
+        self._find_unused = find_unused_parameters
+        self._ready_order: List[int] = []
+        self._enabled = True
+        self._remove_cb = _engine.register_post_backward_callback(
+            self._flush)
+        for i, p in enumerate(self._params):
+            self._install_hook(p, i)
+
+    def _install_hook(self, p, i):
+        def note(g):
+            if self._enabled and i not in self._ready_order:
+                self._ready_order.append(i)
+            return g
+
+        # leaf accumulation hook: fires when the param's grad contribution
+        # lands during backward (AccumulationNode.hooks)
+        acc = p._acc_node
+        if acc is None:
+            acc = _engine.AccumulationNode(p)
+            p._acc_node = acc
+        acc.hooks.append(note)
+
+    def _flush(self):
+        if not self._enabled or not self._ready_order:
+            self._ready_order.clear()
+            return
+        order = list(self._ready_order)
+        self._ready_order.clear()
+        if self._find_unused:
+            # keep ranks in lockstep: params untouched this backward
+            # contribute zero grads to the reduction
+            for i, p in enumerate(self._params):
+                if i not in order:
+                    if p.grad is None:
+                        from paddle_tpu.core.tensor import Tensor
+
+                        p.grad = Tensor._from_data(
+                            jnp.zeros_like(p._data), stop_gradient=True)
+                    order.append(i)
+        n = self._group.nranks
+        bucket: List[int] = []
+        size = 0
+        for i in order:
+            p = self._params[i]
+            if p.grad is None:
+                continue
+            bucket.append(i)
+            size += p.grad._data.size * p.grad._data.dtype.itemsize
+            if size >= self._bucket_bytes:
+                self._reduce_bucket(bucket, n)
+                bucket, size = [], 0
+        if bucket:
+            self._reduce_bucket(bucket, n)
+
+    def _reduce_bucket(self, idxs, n):
+        from paddle_tpu.distributed import communication as comm
+
+        grads = [self._params[i].grad._data for i in idxs]
+        flat = jnp.concatenate([g.ravel() for g in grads]) \
+            if len(grads) > 1 else grads[0].ravel()
+        reduced = comm.all_reduce(flat, op=comm.ReduceOp.SUM,
+                                  group=self._group)
+        reduced = reduced / n  # DP averages grads
+        off = 0
+        for i, g in zip(idxs, grads):
+            sz = g.size
+            self._params[i].grad._data = \
+                reduced[off:off + sz].reshape(g.shape).astype(g.dtype)
+            off += sz
+
+    def close(self):
+        self._remove_cb()
 
 
 class DataParallel(Layer):
@@ -26,29 +129,41 @@ class DataParallel(Layer):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self._grad_sync_enabled = True
+        from paddle_tpu.distributed import communication as comm
+
+        self._group = group or comm.get_group(0)
+        self._reducer: Optional[_EagerReducer] = None
+        if comm._multiprocess() and self._group.nranks > 1:
+            # reference DataParallel.__init__ broadcasts params from rank0
+            # (sync_params_buffers) so all ranks start identical
+            for p in layers.parameters():
+                comm.broadcast(p, src=self._group.ranks[0],
+                               group=self._group)
+            for _, b in getattr(layers, "named_buffers", lambda: [])():
+                comm.broadcast(b, src=self._group.ranks[0],
+                               group=self._group)
+            self._reducer = _EagerReducer(
+                list(layers.parameters()), self._group,
+                bucket_mb=comm_buffer_size,
+                find_unused_parameters=find_unused_parameters)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Accumulate gradients without cross-rank synchronization.
-
-        In the reference, backward() triggers the EagerReducer's bucketed
-        allreduce and no_sync suppresses it. Here gradient synchronization
-        only ever happens inside a compiled step (XLA inserts the
-        reduction); an eager ``backward()`` accumulates purely local
-        grads, so within no_sync the semantics the reference promises —
-        local accumulation, sync deferred to the next synced step — hold
-        by construction. The context manager therefore only flips the
-        bookkeeping flag; ``tests/test_advice_fixes.py`` pins the
-        accumulation semantics.
-        """
+        """Accumulate gradients without cross-rank synchronization; the
+        next backward outside the context reduces the accumulated grads
+        (reference parallel.py DataParallel.no_sync)."""
         self._grad_sync_enabled = False
+        if self._reducer is not None:
+            self._reducer._enabled = False
         try:
             yield
         finally:
             self._grad_sync_enabled = True
+            if self._reducer is not None:
+                self._reducer._enabled = True
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

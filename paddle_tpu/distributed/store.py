@@ -1,0 +1,198 @@
+"""Key-value rendezvous stores.
+
+The reference rendezvouses ranks through a ``TCPStore``
+(paddle/phi/core/distributed/store/tcp_store.h:121 — set/get/add/wait/
+barrier over a socket server on rank 0). On TPU the coordination service
+that ``jax.distributed.initialize`` starts plays the same role; ``Store``
+wraps its client with the TCPStore-shaped API so framework code (elastic
+manager, eager send/recv, debugging) has the same seam.
+
+``FileStore`` is the no-network fallback (reference analog: the
+file-backed Gloo store) used by single-host launcher tests and by the
+elastic manager's heartbeat registry.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+__all__ = ["Store", "FileStore", "current_store"]
+
+
+class Store:
+    """TCPStore-shaped API over the jax.distributed coordination service.
+
+    Requires ``jax.distributed.initialize`` (which
+    ``paddle_tpu.distributed.init_parallel_env`` performs) — the
+    coordination client is the transport; keys live on the coordinator
+    (rank-0 host), exactly like the reference's rank-0 TCPStore server.
+    """
+
+    def __init__(self, prefix: str = "paddle_store"):
+        from jax._src import distributed as _dist
+
+        client = _dist.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "Store requires an initialized distributed runtime "
+                "(call paddle_tpu.distributed.init_parallel_env first)")
+        self._c = client
+        self._prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self._prefix}/{key}"
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, bytes):
+            value = value.decode("latin-1")
+        self._c.key_value_set(self._k(key), str(value),
+                              allow_overwrite=True)
+
+    def get(self, key: str, timeout: float = 300.0) -> bytes:
+        v = self._c.blocking_key_value_get(self._k(key),
+                                           int(timeout * 1000))
+        return v.encode("latin-1")
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            v = self._c.key_value_try_get(self._k(key))
+        except Exception:
+            return None
+        return None if v is None else v.encode("latin-1")
+
+    def delete(self, key: str) -> None:
+        try:
+            self._c.key_value_delete(self._k(key))
+        except Exception:
+            pass
+
+    def list(self, prefix: str = "") -> List[str]:
+        try:
+            items = self._c.key_value_dir_get(self._k(prefix))
+        except Exception:
+            return []
+        return [k for k, _ in items]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        """Atomic counter (TCPStore::add). The coordination client has no
+        atomic increment, so each participant claims a unique slot key;
+        the counter value is the number of slots."""
+        import uuid
+
+        self._c.key_value_set(
+            self._k(f"{key}/slot-{uuid.uuid4().hex}"), str(amount))
+        items = self._c.key_value_dir_get(self._k(key))
+        return sum(int(v) for _, v in items)
+
+    def wait(self, keys, timeout: float = 300.0) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    def barrier(self, name: str = "barrier", timeout: float = 300.0,
+                process_ids=None) -> None:
+        self._c.wait_at_barrier(f"{self._prefix}/{name}",
+                                int(timeout * 1000),
+                                process_ids=process_ids)
+
+
+class FileStore:
+    """Filesystem-backed store for same-host process groups (launcher
+    tests, elastic heartbeats). Atomicity via O_EXCL create + rename."""
+
+    def __init__(self, path: str):
+        self._dir = path
+        os.makedirs(path, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self._dir, key.replace("/", "__"))
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        tmp = self._p(key) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, self._p(key))
+
+    def get(self, key: str, timeout: float = 300.0) -> bytes:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.try_get(key)
+            if v is not None:
+                return v
+            time.sleep(0.02)
+        raise TimeoutError(f"store key {key!r} not set within {timeout}s")
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._p(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._p(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> List[str]:
+        pat = prefix.replace("/", "__")
+        return [f for f in os.listdir(self._dir)
+                if f.startswith(pat) and not f.endswith("tmp")]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        # lock-free: one slot file per add, value = sum of slots
+        import uuid
+
+        self.set(f"{key}/slot-{uuid.uuid4().hex}", str(amount))
+        total = 0
+        for f in self.list(f"{key}/slot-"):
+            with open(os.path.join(self._dir, f), "rb") as fh:
+                total += int(fh.read())
+        return total
+
+    def wait(self, keys, timeout: float = 300.0) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    def barrier(self, name: str = "barrier", timeout: float = 300.0,
+                world_size: Optional[int] = None, rank: int = 0) -> None:
+        if world_size is None:
+            from paddle_tpu.distributed import env
+
+            world_size = env.get_world_size()
+        n = self.add(f"{name}/enter", 1)
+        deadline = time.time() + timeout
+        while n < world_size:
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name!r}: {n}/{world_size}")
+            time.sleep(0.02)
+            total = 0
+            for f in self.list(f"{name}/enter/slot-"):
+                with open(os.path.join(self._dir, f), "rb") as fh:
+                    total += int(fh.read())
+            n = total
+
+
+_store: Optional[object] = None
+
+
+def current_store():
+    """Process-wide default store: coordination-service Store when the
+    distributed runtime is up, else a FileStore under PADDLE_STORE_DIR."""
+    global _store
+    if _store is None:
+        try:
+            _store = Store()
+        except Exception:
+            d = os.environ.get("PADDLE_STORE_DIR")
+            if d is None:
+                raise
+            _store = FileStore(d)
+    return _store

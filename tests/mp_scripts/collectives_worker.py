@@ -1,0 +1,82 @@
+"""Per-collective multi-process checks (collective_allreduce_api.py
+pattern, test/collective/ in the reference). Run by test_multiprocess.py
+with 2 ranks; prints COLLECTIVES_OK on success."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from _mp_common import bootstrap
+
+rank, world = bootstrap()
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+assert world == 2, world
+
+# all_reduce
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), np.full((4,), 3.0), rtol=0)
+
+# all_reduce max
+t = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+dist.all_reduce(t, op=dist.ReduceOp.MAX)
+np.testing.assert_allclose(t.numpy(), np.full((3,), 2.0), rtol=0)
+
+# broadcast
+t = paddle.to_tensor(np.full((4,), float(rank * 7 + 1), np.float32))
+dist.broadcast(t, src=1)
+np.testing.assert_allclose(t.numpy(), np.full((4,), 8.0), rtol=0)
+
+# all_gather
+out = []
+t = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+dist.all_gather(out, t)
+assert len(out) == 2
+np.testing.assert_allclose(out[0].numpy(), np.zeros((2,)), rtol=0)
+np.testing.assert_allclose(out[1].numpy(), np.ones((2,)), rtol=0)
+
+# reduce_scatter: each rank contributes (world, chunk); gets its summed chunk
+src = paddle.to_tensor(
+    np.stack([np.full((3,), float(rank + 1), np.float32),
+              np.full((3,), float(rank + 10), np.float32)]))
+dst = paddle.zeros([3])
+dist.reduce_scatter(dst, src)
+expect = 3.0 if rank == 0 else 21.0
+np.testing.assert_allclose(dst.numpy(), np.full((3,), expect), rtol=0)
+
+# all_to_all
+ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + j), np.float32))
+       for j in range(2)]
+outs = []
+dist.all_to_all(outs, ins)
+np.testing.assert_allclose(outs[0].numpy(),
+                           np.full((2,), float(rank)), rtol=0)
+np.testing.assert_allclose(outs[1].numpy(),
+                           np.full((2,), float(10 + rank)), rtol=0)
+
+# scatter
+if rank == 0:
+    parts = [paddle.to_tensor(np.full((2,), 5.0, np.float32)),
+             paddle.to_tensor(np.full((2,), 9.0, np.float32))]
+else:
+    parts = None
+t = paddle.zeros([2])
+dist.scatter(t, parts, src=0)
+expect = 5.0 if rank == 0 else 9.0
+np.testing.assert_allclose(t.numpy(), np.full((2,), expect), rtol=0)
+
+# send / recv (store-backed p2p)
+if rank == 0:
+    dist.send(paddle.to_tensor(np.arange(4, dtype=np.float32)), dst=1)
+else:
+    r = paddle.zeros([4])
+    dist.recv(r, src=0)
+    np.testing.assert_allclose(r.numpy(), np.arange(4, dtype=np.float32))
+
+# barrier
+dist.barrier()
+
+print(f"rank{rank} COLLECTIVES_OK", flush=True)
