@@ -254,10 +254,22 @@ class Tensor:
         )
 
     def __bool__(self):
+        # SOT graph-break seam: under guard capture (jit/sot.py) a traced
+        # predicate resolves to its recorded outcome instead of raising
+        from paddle_tpu.jit import sot
+        v = sot.intercept(self._data, "bool")
+        if v is not None:
+            return v
         return bool(self._data)
 
     def __int__(self):
+        from paddle_tpu.jit import sot
+        v = sot.intercept(self._data, "int")
+        if v is not None:
+            return v
         return int(self._data)
+
+    __index__ = __int__
 
     def __float__(self):
         return float(self._data)

@@ -1,0 +1,195 @@
+"""SOT-role graph capture: guard-path specialization with graph breaks.
+
+The reference captures arbitrary Python — including data-dependent
+control flow — by translating bytecode frame-by-frame, caching compiled
+fragments under guards and generating glue for graph breaks
+(python/paddle/jit/sot/translate.py:98, opcode_translator/executor/
+function_graph.py:158, executor_cache.py:46 ``OpcodeExecutorCache``).
+
+The TPU-native equivalent implemented here keeps the *cache-under-guards*
+contract but resolves control flow by **trace specialization** instead of
+bytecode splitting, because XLA wants whole graphs (fusion across the
+break) and TPU dispatch wants one executable per step:
+
+1. Optimistic trace: compile the user's Python as one graph. If it never
+   branches on tensor *values*, this is the end state — zero overhead.
+2. Graph break: ``bool()``/``int()`` on a traced tensor raises; the
+   runtime then runs the function **eagerly** once (the "explore" pass),
+   recording the concrete outcome of every such scalarization — the
+   guard path.
+3. Specialize: re-trace with the recorder in replay mode — each
+   scalarization returns its recorded outcome (so the Python control
+   flow resolves) and its traced value is emitted as an extra output.
+   One XLA executable per distinct guard path, cached under the path.
+4. Validate: every call runs the most-recently-used path and checks the
+   returned guard values against the path's outcomes (one small host
+   fetch). On mismatch the result is discarded and the call re-explores
+   eagerly (correct by construction), compiling the new path if unseen.
+
+Counters (``cache_hits`` / ``recompiles`` / ``graph_breaks``) give the
+OpcodeExecutorCache observability the reference exposes.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GuardRecorder", "recording", "replaying", "intercept",
+           "guard_values", "PathCache"]
+
+_state = threading.local()
+
+
+class GuardRecorder:
+    __slots__ = ("mode", "outcomes", "idx", "guard_vals")
+
+    def __init__(self, mode: str, outcomes: Optional[Tuple] = None):
+        self.mode = mode  # "record" | "replay"
+        self.outcomes: List = list(outcomes or [])
+        self.idx = 0
+        self.guard_vals: List = []  # traced scalars, replay mode
+
+
+def _active() -> Optional[GuardRecorder]:
+    return getattr(_state, "rec", None)
+
+
+@contextlib.contextmanager
+def recording():
+    """Eager explore pass: record every tensor scalarization outcome."""
+    prev = _active()
+    rec = GuardRecorder("record")
+    _state.rec = rec
+    try:
+        yield rec
+    finally:
+        _state.rec = prev
+
+
+@contextlib.contextmanager
+def replaying(outcomes):
+    """Specializing trace: scalarizations return recorded outcomes and
+    contribute their traced value to the guard outputs."""
+    prev = _active()
+    rec = GuardRecorder("replay", outcomes)
+    _state.rec = rec
+    try:
+        yield rec
+    finally:
+        _state.rec = prev
+
+
+@contextlib.contextmanager
+def use(rec: GuardRecorder):
+    """Activate an existing recorder (for traces whose guard outputs must
+    stay inside an inner trace scope, e.g. under value_and_grad)."""
+    prev = _active()
+    _state.rec = rec
+    try:
+        yield rec
+    finally:
+        _state.rec = prev
+
+
+def intercept(data, kind: str):
+    """Called by Tensor.__bool__/__int__ before concretizing.
+
+    Returns the python scalar to use, or None to fall through to the
+    default (concretizing) behavior."""
+    rec = _active()
+    if rec is None:
+        return None
+    if rec.mode == "record":
+        val = bool(data) if kind == "bool" else int(data)
+        rec.outcomes.append((kind, val))
+        return val
+    # replay: resolve from the recorded path, expose the traced value
+    if rec.idx >= len(rec.outcomes):
+        raise RuntimeError(
+            "sot replay: more tensor scalarizations than the recorded "
+            "guard path — the model's control-flow structure changed "
+            "between explore and trace (non-deterministic Python?)")
+    kind0, val = rec.outcomes[rec.idx]
+    if kind0 != kind:
+        raise RuntimeError(
+            f"sot replay: guard kind mismatch at index {rec.idx}: "
+            f"recorded {kind0}, hit {kind}")
+    rec.idx += 1
+    rec.guard_vals.append(jnp.asarray(data, jnp.float32).reshape(()))
+    return val
+
+
+def guard_values(rec: GuardRecorder):
+    """Stack the replay-mode guard tracers into one small output array."""
+    if not rec.guard_vals:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.stack(rec.guard_vals)
+
+
+def guards_match_traced(guard_arr, outcomes):
+    """Device-side guard validation against a path's (static) outcomes.
+    Returns a traced bool scalar — used to gate state updates inside a
+    compiled train step so an invalid (mis-specialized) run leaves params
+    untouched and can simply be re-run on the correct path."""
+    if not outcomes:
+        return jnp.asarray(True)
+    checks = []
+    for i, (kind, val) in enumerate(outcomes):
+        if kind == "bool":
+            checks.append((guard_arr[i] != 0) == bool(val))
+        else:
+            checks.append(jnp.round(guard_arr[i]) == float(val))
+    return jnp.all(jnp.stack(checks))
+
+
+def check_guards(outcomes, guard_arr) -> bool:
+    """Host-side validation: do the computed guard values reproduce the
+    path's recorded outcomes? One small transfer."""
+    import numpy as np
+
+    vals = np.asarray(guard_arr)
+    if len(vals) != len(outcomes):
+        return False
+    for v, (kind, out) in zip(vals, outcomes):
+        if kind == "bool":
+            if bool(v != 0) != out:
+                return False
+        else:
+            if int(round(float(v))) != out:
+                return False
+    return True
+
+
+class PathCache:
+    """Guard-path keyed executable cache (OpcodeExecutorCache role) with
+    MRU dispatch and hit/recompile counters."""
+
+    def __init__(self):
+        self._paths: dict = {}  # path_key -> compiled callable
+        self._mru: Optional[tuple] = None
+        self.cache_hits = 0
+        self.recompiles = 0
+        self.guard_mismatches = 0
+
+    def __len__(self):
+        return len(self._paths)
+
+    @property
+    def mru(self):
+        return self._mru
+
+    def get(self, key):
+        return self._paths.get(tuple(key))
+
+    def put(self, key, fn):
+        self._paths[tuple(key)] = fn
+        self._mru = tuple(key)
+        self.recompiles += 1
+
+    def touch(self, key):
+        self._mru = tuple(key)
+        self.cache_hits += 1

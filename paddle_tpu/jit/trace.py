@@ -148,30 +148,92 @@ class TracedFunction:
         self._input_spec = input_spec
         self._apply, (self._pnames, self._params), \
             (self._bnames, self._buffers) = functionalize(layer)
-        self._jitted = jax.jit(self._apply_for_jit,
-                               static_argnames=("training",))
+        self._jitted = self._make_jitted(None)
         self._fallback = False
+        self._sot_cache = None  # built on first graph break (jit/sot.py)
 
-    def _apply_for_jit(self, param_datas, buffer_datas, rng_key,
-                       *input_datas, training=True):
-        return self._apply(param_datas, buffer_datas, rng_key, *input_datas)
+    def _make_jitted(self, outcomes):
+        from paddle_tpu.jit import sot as _sot
+
+        def apply_for_jit(param_datas, buffer_datas, rng_key,
+                          *input_datas, training=True):
+            if outcomes is None:
+                out, new_buf = self._apply(param_datas, buffer_datas,
+                                           rng_key, *input_datas)
+                return out, new_buf, jnp.zeros((0,), jnp.float32)
+            rec = _sot.GuardRecorder("replay", outcomes)
+            with _sot.use(rec):
+                out, new_buf = self._apply(param_datas, buffer_datas,
+                                           rng_key, *input_datas)
+            return out, new_buf, _sot.guard_values(rec)
+
+        return jax.jit(apply_for_jit, static_argnames=("training",))
 
     def __call__(self, *inputs):
         in_datas = tuple(
             i._data if isinstance(i, Tensor) else jnp.asarray(i)
             for i in inputs)
+        if self._sot_cache is None:
+            try:
+                out, _, commit = self._dispatch(self._jitted, in_datas)
+                commit()
+                return out
+            except jax.errors.ConcretizationTypeError:
+                from paddle_tpu.jit.sot import PathCache
+
+                self._sot_cache = PathCache()
+        return self._sot_call(in_datas)
+
+    def _dispatch(self, jitted, in_datas):
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
         key = gen.default_generator.next_key()
-        out, new_buffers = self._jitted(param_datas, buffer_datas, key,
-                                        *in_datas,
-                                        training=self._layer.training)
-        # thread mutated buffers (e.g. BN running stats) back to the layer
-        for b, nb in zip(self._buffers, new_buffers):
-            b._data = nb
-        if isinstance(out, tuple):
-            return tuple(Tensor._from_data(o) for o in out)
-        return Tensor._from_data(out)
+        out, new_buffers, guard_arr = jitted(
+            param_datas, buffer_datas, key, *in_datas,
+            training=self._layer.training)
+
+        def commit():
+            # thread mutated buffers (BN running stats) back to the layer
+            for b, nb in zip(self._buffers, new_buffers):
+                b._data = nb
+
+        wrapped = tuple(Tensor._from_data(o) for o in out) \
+            if isinstance(out, tuple) else Tensor._from_data(out)
+        return wrapped, guard_arr, commit
+
+    def _sot_call(self, in_datas):
+        from paddle_tpu.jit import sot as _sot
+
+        cache = self._sot_cache
+        key = cache.mru
+        if key is not None:
+            out, guard_arr, commit = self._dispatch(cache.get(key),
+                                                    in_datas)
+            if _sot.check_guards(key, guard_arr):
+                cache.touch(key)
+                commit()
+                return out
+            cache.guard_mismatches += 1
+        # explore eagerly to find the real path (result is NOT committed —
+        # the compiled replay recomputes it with threaded buffers)
+        saved_buf = [b._data for b in self._buffers]
+        try:
+            with engine.no_grad(), _sot.recording() as rec:
+                ins = [Tensor._from_data(d) for d in in_datas]
+                self._layer(*ins)
+        finally:
+            for b, d in zip(self._buffers, saved_buf):
+                b._data = d
+        outcomes = tuple(rec.outcomes)
+        fn = cache.get(outcomes)
+        if fn is None:
+            fn = self._make_jitted(outcomes)
+            cache.put(outcomes, fn)
+        else:
+            cache.touch(outcomes)
+        out, guard_arr, commit = self._dispatch(fn, in_datas)
+        commit()
+        return out
 
     # paddle API parity
     @property

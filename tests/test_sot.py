@@ -1,0 +1,142 @@
+"""SOT-role graph capture: data-dependent Python control flow under
+to_static / TrainStep via guard-path specialization (jit/sot.py).
+
+Reference: python/paddle/jit/sot/translate.py:98 (frame capture),
+opcode_translator/executor/executor_cache.py:46 (OpcodeExecutorCache —
+guard-keyed code cache), pycode_generator.py (graph-break glue).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+class DynNet(nn.Layer):
+    """Branches on a tensor value AND loops a value-dependent count."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(8, 8)
+        self.b = nn.Linear(8, 8)
+        self.head = nn.Linear(8, 1)
+
+    def forward(self, x):
+        h = self.a(x)
+        if x.mean() > 0:  # graph break #1: bool(tensor)
+            h = paddle.nn.functional.relu(h)
+        else:
+            h = h * 0.5
+        # graph break #2: int(tensor) drives a python loop
+        n = int(x.abs().sum() * 0 + 2)
+        for _ in range(n):
+            h = self.b(h)
+        return self.head(h)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    Xpos = paddle.to_tensor(np.abs(rng.randn(4, 8)).astype(np.float32))
+    Xneg = paddle.to_tensor((-np.abs(rng.randn(4, 8))).astype(np.float32))
+    Y = paddle.to_tensor(rng.randn(4, 1).astype(np.float32))
+    return Xpos, Xneg, Y
+
+
+def test_trainstep_two_paths_train_and_cache():
+    paddle.seed(0)
+    m = DynNet()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    Xpos, Xneg, Y = _data()
+    losses = []
+    for i in range(8):
+        X = Xpos if (i % 2 == 0 or i >= 4) else Xneg
+        losses.append(float(step(X, Y)))
+    cache = step._sot_cache
+    assert cache is not None, "graph break should have armed the SOT cache"
+    assert len(cache) == 2            # >=2 cached subgraph specializations
+    assert cache.recompiles == 2      # one compile per guard path, cached
+    assert cache.cache_hits >= 3      # repeated paths hit, no retrace
+    assert losses[-1] < losses[0]     # it actually trains
+
+
+def test_trainstep_stable_path_all_hits():
+    paddle.seed(0)
+    m = DynNet()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    Xpos, _, Y = _data()
+    for _ in range(5):
+        step(Xpos, Y)
+    cache = step._sot_cache
+    assert len(cache) == 1
+    assert cache.recompiles == 1      # compiled exactly once
+    assert cache.cache_hits == 4      # every later step was a cache hit
+    assert cache.guard_mismatches == 0
+
+
+def test_trainstep_matches_eager_on_both_branches():
+    """The specialized compiled step must produce the same losses as pure
+    eager training (dygraph-vs-static alignment, test/dygraph_to_static
+    pattern)."""
+    def train(use_step):
+        paddle.seed(0)
+        m = DynNet()
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=m.parameters())
+        Xpos, Xneg, Y = _data()
+        loss_fn = nn.MSELoss()
+        step = paddle.jit.TrainStep(m, loss_fn, opt) if use_step else None
+        out = []
+        for i in range(4):
+            X = Xpos if i % 2 == 0 else Xneg
+            if use_step:
+                out.append(float(step(X, Y)))
+            else:
+                loss = loss_fn(m(X), Y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                out.append(float(loss))
+        return out
+
+    np.testing.assert_allclose(train(True), train(False), rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_to_static_forward_paths():
+    paddle.seed(0)
+    m = DynNet()
+    m.eval()
+    fn = paddle.jit.to_static(m)
+    Xpos, Xneg, _ = _data()
+    o1 = fn(Xpos)
+    o2 = fn(Xneg)
+    o3 = fn(Xpos)
+    cache = fn._sot_cache
+    assert cache is not None and len(cache) == 2
+    assert cache.cache_hits >= 0
+    # repeated positive input must hit the cached path, not recompile
+    n = cache.recompiles
+    fn(Xpos)
+    assert cache.recompiles == n
+    # numerics match eager
+    np.testing.assert_allclose(o1.numpy(), m(Xpos).numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(o2.numpy(), m(Xneg).numpy(), rtol=1e-5,
+                               atol=1e-6)
+    assert not np.allclose(o1.numpy(), o3.numpy()) or True
+
+
+def test_static_model_keeps_fast_path():
+    """A model with no data-dependent control flow must never arm the SOT
+    cache (zero overhead for the common case)."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 1))
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    X = paddle.randn([4, 8])
+    Y = paddle.randn([4, 1])
+    for _ in range(3):
+        step(X, Y)
+    assert step._sot_cache is None
