@@ -1,0 +1,236 @@
+"""Auto-config tuner: search (dp, mp, sharding, remat, accumulate) for a
+model + device count.
+
+Reference: python/paddle/distributed/auto_tuner/ — ``search.py`` builds a
+grid over (dp_degree, mp_degree, pp_degree, micro_batch_size, sharding
+stage, recompute), ``prune.py`` drops invalid/ dominated points, and
+``recorder.py`` sorts & persists trial results; each surviving candidate
+is *launched as a trial job* and timed.
+
+TPU-native twist: trial launches are mostly unnecessary. XLA knows a
+step's exact HBM footprint at COMPILE time (`compiled.memory_analysis()`
+— argument/output/temp bytes), so candidates are pruned by an AOT
+compile with no execution; only the top-K survivors are actually timed
+(on the real mesh, or the virtual CPU mesh in tests). This is cheaper
+than the reference's launch-per-trial because compile-and-analyze costs
+seconds, not a job spin-up.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["TrialConfig", "Trial", "Recorder", "AutoTuner"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrialConfig:
+    """One hybrid-parallel configuration (reference: the per-trial config
+    dict emitted by auto_tuner/search.py)."""
+
+    dp: int = 1
+    mp: int = 1
+    sharding_stage: int = 0  # 0/1/2/3 (ZeRO)
+    remat: bool = False
+    accumulate_steps: int = 1
+
+    def axes(self):
+        return {"dp": self.dp, "mp": self.mp}
+
+    def name(self) -> str:
+        return (f"dp{self.dp}_mp{self.mp}_zero{self.sharding_stage}"
+                f"{'_remat' if self.remat else ''}"
+                f"_acc{self.accumulate_steps}")
+
+
+@dataclasses.dataclass
+class Trial:
+    config: TrialConfig
+    status: str = "pending"  # pruned / oom / error / ok
+    reason: str = ""
+    peak_bytes: Optional[int] = None
+    time_per_step: Optional[float] = None
+
+    def row(self) -> Dict:
+        return {"config": self.config.name(), "status": self.status,
+                "reason": self.reason, "peak_bytes": self.peak_bytes,
+                "time_per_step": self.time_per_step}
+
+
+class Recorder:
+    """Trial bookkeeping + persistence (reference recorder.py: store
+    history, sort by metric, save csv)."""
+
+    def __init__(self):
+        self.trials: List[Trial] = []
+
+    def add(self, trial: Trial):
+        self.trials.append(trial)
+
+    def sorted_trials(self) -> List[Trial]:
+        done = [t for t in self.trials if t.status == "ok"
+                and t.time_per_step is not None]
+        rest = [t for t in self.trials if t not in done]
+        return sorted(done, key=lambda t: t.time_per_step) + rest
+
+    def best(self) -> Optional[Trial]:
+        s = self.sorted_trials()
+        return s[0] if s and s[0].status == "ok" else None
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump([t.row() for t in self.sorted_trials()], f, indent=1)
+
+    def summary(self) -> List[Dict]:
+        return [t.row() for t in self.sorted_trials()]
+
+
+class AutoTuner:
+    """Search + prune + analyze + time.
+
+    ``model_builder() -> (model, loss_fn, optimizer)`` must build a fresh
+    model (the tuner mutates parameter placements per trial).
+    """
+
+    def __init__(self, model_builder: Callable, sample_batch: Sequence,
+                 num_devices: Optional[int] = None,
+                 memory_budget_bytes: Optional[int] = None,
+                 mp_candidates: Optional[Sequence[int]] = None,
+                 sharding_stages: Sequence[int] = (0, 2, 3),
+                 remat_options: Sequence[bool] = (False, True),
+                 accumulate_options: Sequence[int] = (1,)):
+        import jax
+
+        self._build = model_builder
+        self._batch = list(sample_batch)
+        self._ndev = num_devices or len(jax.devices())
+        self._budget = memory_budget_bytes
+        self._mp_candidates = mp_candidates
+        self._sharding_stages = tuple(sharding_stages)
+        self._remat_options = tuple(remat_options)
+        self._accumulate_options = tuple(accumulate_options)
+        self.recorder = Recorder()
+
+    # -- search space (reference search.py grid) -------------------------
+    def candidates(self) -> List[TrialConfig]:
+        def divisors(n):
+            return [d for d in range(1, n + 1) if n % d == 0]
+
+        mps = self._mp_candidates or divisors(self._ndev)
+        out = []
+        for mp in mps:
+            if self._ndev % mp:
+                continue
+            dp = self._ndev // mp
+            for stage, remat, acc in itertools.product(
+                    self._sharding_stages, self._remat_options,
+                    self._accumulate_options):
+                out.append(TrialConfig(dp=dp, mp=mp,
+                                       sharding_stage=stage,
+                                       remat=remat,
+                                       accumulate_steps=acc))
+        return out
+
+    # -- static prune rules (reference prune.py) -------------------------
+    def prune(self, cfg: TrialConfig) -> Optional[str]:
+        batch0 = self._batch[0]
+        bs = int(np.asarray(
+            batch0._data if hasattr(batch0, "_data") else batch0
+        ).shape[0])
+        if cfg.dp * cfg.mp != self._ndev:
+            return f"dp*mp={cfg.dp * cfg.mp} != devices={self._ndev}"
+        if bs % cfg.dp:
+            return f"batch {bs} not divisible by dp={cfg.dp}"
+        if cfg.sharding_stage and cfg.dp == 1:
+            return "sharding needs dp>1"
+        if cfg.sharding_stage and cfg.remat and cfg.sharding_stage < 3:
+            # dominated: remat+zero1/2 never beats remat+zero3 on memory
+            # and never beats plain zero1/2 on time
+            return "dominated (remat with zero<3)"
+        return None
+
+    # -- compile-time memory analysis ------------------------------------
+    def analyze(self, cfg: TrialConfig) -> Trial:
+        import jax
+
+        from paddle_tpu import device as _device
+        from paddle_tpu.distributed.engine import (
+            ParallelConfig, ParallelTrainStep,
+        )
+        from paddle_tpu.distributed.mesh import ProcessMesh
+
+        trial = Trial(cfg)
+        reason = self.prune(cfg)
+        if reason is not None:
+            trial.status, trial.reason = "pruned", reason
+            return trial
+        try:
+            model, loss_fn, opt = self._build()
+            mesh = ProcessMesh(
+                np.arange(self._ndev).reshape(cfg.dp, cfg.mp),
+                dim_names=["dp", "mp"])
+            pc = ParallelConfig(dp_axes=("dp",),
+                                sharding_stage=cfg.sharding_stage,
+                                sharding_axis="dp", remat=cfg.remat)
+            step = ParallelTrainStep(model, loss_fn, opt, mesh, pc)
+            datas = step._place_batch(self._batch)
+            if step._jitted is None:
+                step._build_jit(datas)
+            avals = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (step._carry, [p._data for p in step._params],
+                 step._slots, [b._data for b in step._buffers],
+                 jax.device_put(np.float32(0.01), step._repl),
+                 step._scaler_state, *datas))
+            compiled = step._jitted.lower(*avals).compile()
+            ma = _device.compiled_memory_analysis(compiled)
+            # per-device peak: args live in HBM + temps (+outputs alias
+            # donated args)
+            peak = ma.get("argument_size_in_bytes", 0) + \
+                ma.get("temp_size_in_bytes", 0)
+            trial.peak_bytes = peak
+            if self._budget is not None and peak > self._budget:
+                trial.status = "oom"
+                trial.reason = (f"analysis peak {peak} > budget "
+                                f"{self._budget}")
+                return trial
+            trial.status = "ok"
+            trial._step = step  # keep for timing phase
+        except Exception as e:  # compile failure = invalid config
+            trial.status, trial.reason = "error", f"{type(e).__name__}: {e}"
+        return trial
+
+    # -- timing (only for top-K analysis survivors) ----------------------
+    def time_trial(self, trial: Trial, steps: int = 3) -> Trial:
+        try:
+            step = trial._step
+            loss = step(*self._batch)
+            float(loss.item())  # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(*self._batch)
+            float(loss.item())
+            trial.time_per_step = (time.perf_counter() - t0) / steps
+        except Exception as e:
+            trial.status, trial.reason = "error", f"{type(e).__name__}: {e}"
+        return trial
+
+    def tune(self, top_k: int = 3, steps: int = 3) -> Optional[TrialConfig]:
+        """Full pipeline: grid -> prune -> analyze -> time top-K by
+        analyzed memory -> best config (or None)."""
+        analyzed = []
+        for cfg in self.candidates():
+            t = self.analyze(cfg)
+            self.recorder.add(t)
+            if t.status == "ok":
+                analyzed.append(t)
+        analyzed.sort(key=lambda t: t.peak_bytes or 0)
+        for t in analyzed[:top_k]:
+            self.time_trial(t, steps=steps)
+        best = self.recorder.best()
+        return best.config if best else None

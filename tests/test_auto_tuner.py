@@ -1,0 +1,96 @@
+"""Auto-config tuner: search/prune/XLA-memory-analysis/record.
+
+Reference: python/paddle/distributed/auto_tuner/{search,prune,recorder}.py
+— grid over hybrid-parallel configs, invalid-point pruning, trial
+records. TPU twist under test: OOM rejection happens via compile-time
+``memory_analysis`` with no execution (cheaper than the reference's
+launch-per-trial), then only top-K survivors are timed.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.auto_tuner import AutoTuner, Recorder, Trial, \
+    TrialConfig
+from paddle_tpu.models.llama import (
+    LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion,
+)
+
+CFG = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=176,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=64)
+
+
+def _builder():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    return m, LlamaPretrainingCriterion(CFG), opt
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randint(0, 256, (8, 32)).astype(np.int32))
+    Y = paddle.to_tensor(rng.randint(0, 256, (8, 32)).astype(np.int32))
+    return [X, Y]
+
+
+def test_candidates_and_prune_rules():
+    tuner = AutoTuner(_builder, _batch(), num_devices=8)
+    cands = tuner.candidates()
+    assert all(c.dp * c.mp == 8 for c in cands)
+    # batch 8 not divisible by dp -> there is no such candidate (dp in
+    # divisors of 8), but sharding with dp=1 must prune
+    bad = TrialConfig(dp=1, mp=8, sharding_stage=3)
+    assert tuner.prune(bad) is not None
+    ok = TrialConfig(dp=4, mp=2)
+    assert tuner.prune(ok) is None
+
+
+def test_tune_returns_valid_config_and_records():
+    tuner = AutoTuner(_builder, _batch(), mp_candidates=[2, 4],
+                      sharding_stages=(0,), remat_options=(False,))
+    best = tuner.tune(top_k=2, steps=1)
+    assert best is not None
+    assert best.dp * best.mp == 8
+    rows = tuner.recorder.summary()
+    # recorder output pinned: every row carries config/status/peak/time
+    assert all(set(r) == {"config", "status", "reason", "peak_bytes",
+                          "time_per_step"} for r in rows)
+    ok_rows = [r for r in rows if r["status"] == "ok"]
+    assert len(ok_rows) >= 2
+    assert all(r["peak_bytes"] > 0 for r in ok_rows)
+    timed = [r for r in ok_rows if r["time_per_step"] is not None]
+    assert len(timed) == 2  # exactly top-K were executed
+    # best-first ordering
+    assert rows[0]["time_per_step"] == min(t["time_per_step"]
+                                           for t in timed)
+
+
+def test_memory_analysis_rejects_oom_configs():
+    """A tiny budget must reject configs by ANALYSIS (no execution)."""
+    tuner = AutoTuner(_builder, _batch(), mp_candidates=[2],
+                      sharding_stages=(0,), remat_options=(False,),
+                      memory_budget_bytes=1024)  # absurdly small
+    best = tuner.tune(top_k=1, steps=1)
+    assert best is None
+    rows = tuner.recorder.summary()
+    assert any(r["status"] == "oom" for r in rows)
+    oom = [r for r in rows if r["status"] == "oom"][0]
+    assert "analysis peak" in oom["reason"]
+
+
+def test_recorder_save(tmp_path):
+    rec = Recorder()
+    rec.add(Trial(TrialConfig(dp=8), status="ok", peak_bytes=10,
+                  time_per_step=0.5))
+    rec.add(Trial(TrialConfig(dp=4, mp=2), status="ok", peak_bytes=9,
+                  time_per_step=0.2))
+    p = tmp_path / "trials.json"
+    rec.save(str(p))
+    import json
+
+    rows = json.loads(p.read_text())
+    assert rows[0]["config"].startswith("dp4_mp2")
+    assert rec.best().config.mp == 2
