@@ -221,16 +221,28 @@ class AutoTuner:
         return trial
 
     def tune(self, top_k: int = 3, steps: int = 3) -> Optional[TrialConfig]:
-        """Full pipeline: grid -> prune -> analyze -> time top-K by
-        analyzed memory -> best config (or None)."""
+        """Full pipeline: grid -> prune -> analyze -> time top-K -> best
+        config (or None).
+
+        Timing candidates are ordered by an overhead prior, not by
+        memory: among configs that fit, plain ones (no remat, lower ZeRO
+        stage, less mp) are almost always faster than their
+        memory-saving variants, so they must be in the timed set."""
         analyzed = []
         for cfg in self.candidates():
             t = self.analyze(cfg)
             self.recorder.add(t)
             if t.status == "ok":
                 analyzed.append(t)
-        analyzed.sort(key=lambda t: t.peak_bytes or 0)
+        analyzed.sort(key=lambda t: (t.config.remat,
+                                     t.config.sharding_stage,
+                                     t.config.mp,
+                                     t.peak_bytes or 0))
+        for t in analyzed[top_k:]:
+            # keep only the timed candidates' params/executables alive
+            t._step = None
         for t in analyzed[:top_k]:
             self.time_trial(t, steps=steps)
+            t._step = None
         best = self.recorder.best()
         return best.config if best else None

@@ -282,6 +282,7 @@ class ParallelTrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
+        self._wd_warm = False  # first call = compile, stretched deadline
 
     def _build_jit(self, batch_datas):
         scaler_sh = self._repl if self._scaler_state is not None else None
@@ -335,6 +336,11 @@ class ParallelTrainStep:
             self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
+        from paddle_tpu.distributed.watchdog import arm_step, attach_step
+
+        wd_id = arm_step(f"ParallelTrainStep#{self._opt._step_count}",
+                         cold=not self._wd_warm)
+        self._wd_warm = True
         set_current_mesh(self._mesh)
         try:
             loss, self._carry, new_params, new_slots, new_buffers, \
@@ -343,6 +349,7 @@ class ParallelTrainStep:
                     self._lr_arr, self._scaler_state, *datas)
         finally:
             set_current_mesh(None)
+        attach_step(wd_id, loss)
         for p, np_ in zip(self._params, new_params):
             p._data = np_
         for b, nb in zip(self._buffers, new_buffers):

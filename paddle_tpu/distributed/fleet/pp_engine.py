@@ -174,6 +174,7 @@ class PipelineTrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
+        self._wd_warm = False  # first call = compile, stretched deadline
 
     # ------------------------------------------------------------------
     def _make_step_fn(self):
@@ -398,6 +399,11 @@ class PipelineTrainStep:
         if self._lr_arr is None or lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
+        from paddle_tpu.distributed.watchdog import arm_step, attach_step
+
+        wd_id = arm_step(f"PipelineTrainStep#{self._opt._step_count}",
+                         cold=not self._wd_warm)
+        self._wd_warm = True
         set_current_mesh(self._mesh)
         try:
             (loss, self._carry, npre, nbody, npost, npre_s, nbody_s,
@@ -413,6 +419,7 @@ class PipelineTrainStep:
                              self._lr_arr, self._scaler_state, xd, yd)
         finally:
             set_current_mesh(None)
+        attach_step(wd_id, loss)
         for p, d in zip(self._pre_params, npre):
             p._data = d
         for p, d in zip(self._post_params, npost):

@@ -36,18 +36,27 @@ def _free_port() -> int:
 
 
 def launch(args, extra_env=None):
-    """Spawn ``nproc_per_node`` worker processes and babysit them.
+    """Spawn worker processes and babysit them.
 
     Returns the first nonzero exit code (0 if all succeed). On any child
     failure the remaining children are terminated (reference watcher
     semantics: one dead trainer kills the job), and — when
-    ``--max_restart`` allows — the whole gang is relaunched, the elastic
+    ``--max_restart`` allows — the whole gang is relaunched, the
     recovery loop of reference fleet/elastic/manager.py:124 (collective
-    jobs restart as a unit because the rendezvous must re-form)."""
+    jobs restart as a unit because the rendezvous must re-form).
+
+    Elastic mode (``--nnodes min:max``): membership is watched through
+    the elastic store; a lost worker shrinks the next gang (down to min),
+    a join request grows it (up to max). See launch/elastic.py."""
+    from paddle_tpu.distributed.launch.elastic import parse_nnodes
+
+    lo, hi = parse_nnodes(getattr(args, "nnodes", 1))
+    if lo != hi:
+        return _launch_elastic(args, extra_env, lo, hi)
     restarts = getattr(args, "max_restart", 0)
     attempt = 0
     while True:
-        rc = _launch_once(args, extra_env, attempt)
+        rc, _ = _launch_once(args, extra_env, attempt)
         attempt += 1
         if rc == 0 or restarts <= 0:
             return rc
@@ -57,11 +66,67 @@ def launch(args, extra_env=None):
         time.sleep(getattr(args, "restart_interval", 1.0))
 
 
-def _launch_once(args, extra_env=None, attempt=0):
-    n = args.nproc_per_node
+def _launch_elastic(args, extra_env, min_n, max_n):
+    """Elastic gang loop. On this controller, the elastic unit is a
+    worker process (the reference's unit is a node running its own
+    launcher; a single-host controller collapses node == worker). The
+    world starts at max and re-forms on membership change:
+
+      worker death  -> relaunch at world-1 (>= min, else job fails)
+      join request  -> gang-restart at world+1 (<= max)
+
+    Training scripts see PADDLE_RESTART_COUNT bump on every re-form and
+    are expected to resume from their checkpoints."""
+    import tempfile
+
+    from paddle_tpu.distributed.launch.elastic import ElasticManager
+
+    store_dir = getattr(args, "elastic_dir", None) or \
+        os.path.join(tempfile.gettempdir(),
+                     f"paddle_elastic_{os.getpid()}")
+    mgr = ElasticManager(store_dir, min_n, max_n,
+                         hb_timeout=getattr(args, "hb_timeout", 3.0))
+    mgr.clear_join_requests()  # stale requests from a previous run
+    world = max_n
+    restarts = getattr(args, "max_restart", 10)
+    attempt = 0
+    while True:
+        rc, lost = _launch_once(args, extra_env, attempt, world=world,
+                                elastic=mgr)
+        if rc == 0:
+            return 0
+        if rc == 130:  # user interrupt is a stop, not a member failure
+            return rc
+        attempt += 1
+        new_world = mgr.decide_world(world, lost=lost)
+        mgr.clear_join_requests()
+        if new_world is None:
+            print(f"[launch] membership fell below min={min_n}; giving up",
+                  file=sys.stderr, flush=True)
+            return rc
+        if restarts <= 0:
+            return rc
+        restarts -= 1
+        print(f"[launch] re-forming gang at world={new_world} "
+              f"(was {world}, rc={rc})", file=sys.stderr, flush=True)
+        world = new_world
+        time.sleep(getattr(args, "restart_interval", 0.5))
+
+
+def _launch_once(args, extra_env=None, attempt=0, world=None,
+                 elastic=None):
+    """One gang run. Returns (rc, n_lost_workers)."""
+    from paddle_tpu.distributed.launch.elastic import parse_nnodes
+
     node_rank = args.node_rank
-    nnodes = args.nnodes
-    world = n * nnodes
+    if world is not None:
+        # elastic mode: world workers on this controller, one per "node"
+        n = world
+        nnodes = 1
+    else:
+        n = args.nproc_per_node
+        nnodes = parse_nnodes(args.nnodes)[0]
+        world = n * nnodes
     if args.master:
         master = args.master
     elif nnodes > 1:
@@ -104,6 +169,8 @@ def _launch_once(args, extra_env=None, attempt=0):
             "MASTER_PORT": str(base_port),
             "PADDLE_RESTART_COUNT": str(attempt),
         })
+        if elastic is not None:
+            env["PADDLE_ELASTIC_DIR"] = elastic.dir
         out = None
         if log_dir:
             out = open(os.path.join(log_dir, f"workerlog.{rank}"),
@@ -113,27 +180,57 @@ def _launch_once(args, extra_env=None, attempt=0):
         p._log = out
         procs.append(p)
 
+    hbs = []
+    if elastic is not None:
+        from paddle_tpu.distributed.launch.elastic import Heartbeat
+
+        # controller publishes one heartbeat per live worker slot so
+        # external observers (and tests) can watch membership
+        hbs = [Heartbeat(elastic.dir, f"w{node_rank * n + i}",
+                         payload={"pid": procs[i].pid}).start()
+               for i in range(n)]
+
     rc = 0
+    lost = 0
     try:
         while procs:
             for p in list(procs):
                 r = p.poll()
                 if r is None:
                     continue
+                i = procs.index(p)
                 procs.remove(p)
+                if hbs:
+                    hbs.pop(i).stop()
                 if p._log:
                     p._log.close()
-                if r != 0 and rc == 0:
-                    rc = r
-                    # one dead trainer kills the job (watcher.py role)
-                    for q in procs:
-                        q.terminate()
+                if r != 0:
+                    if rc == 0:
+                        # organic failure: a lost member. Later nonzero
+                        # exits are collateral from the gang-kill below
+                        # and must NOT shrink the next world.
+                        lost += 1
+                        rc = r
+                        # one dead trainer kills the job (watcher.py role)
+                        for q in procs:
+                            q.terminate()
+            if elastic is not None and rc == 0 and procs and \
+                    elastic.join_requests() and n < elastic.max:
+                # scale-out: admit the newcomer by re-forming the gang
+                # (reference elastic manager force-restarts on member
+                # change — a collective world cannot grow in place)
+                rc = 75  # EX_TEMPFAIL: signals "re-form", not failure
+                for q in procs:
+                    q.terminate()
             time.sleep(0.1)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
         rc = 130
-    return rc
+    finally:
+        for hb in hbs:
+            hb.stop()
+    return rc, lost
 
 
 def main(argv=None):
@@ -144,8 +241,14 @@ def main(argv=None):
         description="Launch a multi-process (multi-host) training job")
     ap.add_argument("--nproc_per_node", type=int, default=1,
                     help="worker processes on this node (hosts, not chips)")
-    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--nnodes", default="1",
+                    help="node count, or an elastic range 'min:max' "
+                         "(membership-watched scale-in/out)")
     ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--elastic_dir", default=None,
+                    help="shared dir for the elastic membership store "
+                         "(the etcd role); default: a temp dir")
+    ap.add_argument("--hb_timeout", type=float, default=3.0)
     ap.add_argument("--master", default=None,
                     help="coordinator endpoint host:port (default: "
                          "localhost with a free port — single node)")

@@ -1,0 +1,170 @@
+"""Step/comm watchdog: detect hung device work and abort the process.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.cc +
+nccl_comm_task.cc — every collective records start/end into an async
+watchdog that dumps state and aborts the process group on timeout, so a
+desynced/hung rank turns into a restartable failure instead of an
+infinite hang.
+
+TPU-native shape: compiled steps are opaque single dispatches, so the
+watchable unit is the *step* (dispatch → device completion). The
+watchdog tracks each in-flight step with a deadline; a daemon prober
+per step blocks on the step's output array and clears the entry when the
+device finishes. If any entry passes its deadline, the watchdog dumps
+every Python thread's stack plus the tracked tags (faulthandler — the
+'dump host stacks' contract), then aborts the process (default
+``os._exit(6)``) so the launcher's restart/elastic loop can re-form the
+gang. Enable with FLAGS_step_timeout_s / PADDLE_STEP_TIMEOUT.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+
+from paddle_tpu.core import flags as _flags
+
+__all__ = ["StepWatchdog", "default_watchdog", "watch_step"]
+
+_flags.define_flag("step_timeout_s", float(os.environ.get(
+    "PADDLE_STEP_TIMEOUT", "0") or 0),
+    "abort the process if a dispatched step does not complete on device\n"
+    "            within this many seconds (0 = disabled); the launcher's\n"
+    "            restart loop then re-forms the gang")
+
+
+class StepWatchdog:
+    def __init__(self, timeout: Optional[float] = None,
+                 on_timeout: Optional[Callable] = None):
+        self._timeout = timeout
+        self._on_timeout = on_timeout
+        self._entries: Dict[int, tuple] = {}  # id -> (tag, deadline)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._monitor: Optional[threading.Thread] = None
+        self.fired = False
+
+    @property
+    def timeout(self) -> float:
+        if self._timeout is not None:
+            return self._timeout
+        return float(_flags.flag("step_timeout_s") or 0)
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout > 0
+
+    # -- tracking --------------------------------------------------------
+    def arm(self, tag: str, factor: float = 1.0) -> int:
+        """Record a step start with a deadline (comm_task_manager's
+        start record). MUST be called BEFORE dispatch: on backends where
+        dispatch itself blocks (CPU callbacks, full dispatch queues) the
+        hang happens inside the dispatch call. ``factor`` stretches the
+        deadline (first call of an executable includes trace+XLA
+        compile, which is slow but not hung)."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            self._seq += 1
+            eid = self._seq
+            self._entries[eid] = (tag,
+                                  time.monotonic() + self.timeout * factor)
+            if self._monitor is None:
+                self._monitor = threading.Thread(target=self._watch,
+                                                 daemon=True)
+                self._monitor.start()
+        return eid
+
+    def attach(self, eid: int, arrays) -> None:
+        """After dispatch: a prober thread blocks until the device
+        produces ``arrays`` and then clears the entry (the end record)."""
+        if not eid:
+            return
+
+        def probe():
+            try:
+                jax.block_until_ready(arrays)
+            except Exception:
+                pass  # step failure surfaces on the main thread
+            self.disarm(eid)
+
+        threading.Thread(target=probe, daemon=True).start()
+
+    def disarm(self, eid: int) -> None:
+        with self._lock:
+            self._entries.pop(eid, None)
+
+    def track(self, arrays, tag: str) -> None:
+        """arm + attach in one call (steps already dispatched)."""
+        self.attach(self.arm(tag), arrays)
+
+    # -- monitor ---------------------------------------------------------
+    def _watch(self):
+        while True:
+            time.sleep(min(0.2, max(0.01, self.timeout / 10)))
+            now = time.monotonic()
+            with self._lock:
+                expired_ids = [k for k, (_, dl) in self._entries.items()
+                               if dl < now]
+                expired = [self._entries.pop(k) for k in expired_ids]
+            if expired:
+                # default path aborts the process; a custom on_timeout
+                # handler keeps the monitor alive for later steps
+                self._fire(expired)
+
+    def _fire(self, expired):
+        self.fired = True
+        tags = ", ".join(tag for tag, _ in expired)
+        sys.stderr.write(
+            f"\n[watchdog] step(s) [{tags}] exceeded {self.timeout}s "
+            f"deadline — device appears hung; dumping host stacks and "
+            f"aborting so the launcher can restart the gang\n")
+        sys.stderr.flush()
+        try:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            pass
+        if self._on_timeout is not None:
+            self._on_timeout(expired)
+        else:
+            os._exit(6)
+
+
+_default: Optional[StepWatchdog] = None
+
+
+def default_watchdog() -> StepWatchdog:
+    global _default
+    if _default is None:
+        _default = StepWatchdog()
+    return _default
+
+
+COMPILE_ALLOWANCE = float(os.environ.get(
+    "PADDLE_STEP_COMPILE_ALLOWANCE", "10"))
+
+
+def arm_step(tag: str, cold: bool = False) -> int:
+    """Pre-dispatch hook for train-step engines: no-op unless
+    FLAGS_step_timeout_s / PADDLE_STEP_TIMEOUT is set. ``cold`` marks an
+    executable's first run, which gets COMPILE_ALLOWANCE x the deadline
+    to cover trace+compile time."""
+    return default_watchdog().arm(
+        tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
+
+
+def attach_step(eid: int, arrays) -> None:
+    """Post-dispatch hook: clears the deadline when the device finishes."""
+    default_watchdog().attach(eid, arrays)
+
+
+def watch_step(arrays, tag: str) -> None:
+    """arm+attach for already-dispatched steps."""
+    wd = default_watchdog()
+    if wd.enabled:
+        wd.track(arrays, tag)

@@ -183,6 +183,7 @@ class TrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
+        self._wd_warm: set = set()  # executables past their first run
 
     def _sync_step_carry(self):
         """If the optimizer's step counter was changed externally (e.g.
@@ -228,12 +229,21 @@ class TrainStep:
 
     def _run(self, jitted, n_inputs, datas):
         """Dispatch one compiled step and rebind carried state."""
+        from paddle_tpu.distributed.watchdog import arm_step, attach_step
+
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
+        # first call of an executable includes trace+XLA compile, which
+        # gets a stretched deadline (slow is not hung)
+        warm = id(jitted) in self._wd_warm
+        wd_id = arm_step(f"TrainStep#{self._opt._step_count}",
+                         cold=not warm)
         loss, self._carry, new_params, new_slots, new_buffers, \
             new_scaler_state, valid = jitted(
                 n_inputs, self._carry, param_datas, self._slots,
                 buffer_datas, self._lr_arr, self._scaler_state, *datas)
+        self._wd_warm.add(id(jitted))
+        attach_step(wd_id, loss)
         for p, np_ in zip(self._params, new_params):
             p._data = np_
         for b, nb in zip(self._buffers, new_buffers):

@@ -1,0 +1,67 @@
+"""Elastic scale-in/out worker (driven by test_elastic.py).
+
+Scenario across gang attempts (PADDLE_RESTART_COUNT):
+  attempt 0, world 4: last rank dies -> launcher re-forms at world 3
+  attempt 1, world 3: ranks train, checkpoint, then the test posts a
+      join request -> launcher re-forms at world 4
+  attempt 2, world 4: ranks resume from checkpoint and finish clean.
+
+Every attempt rendezvouses for real (jax.distributed) and runs one
+cross-process allreduce to prove the re-formed world actually works.
+Reference pattern: fleet/elastic/manager.py scale-in/out + checkpoint
+resume contract.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from _mp_common import bootstrap
+
+rank, world = bootstrap()
+attempt = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+out_dir = os.environ["ELASTIC_TEST_DIR"]
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+# prove the re-formed world communicates
+t = paddle.to_tensor(np.ones((2,), np.float32))
+dist.all_reduce(t)
+assert float(t.numpy()[0]) == world, (t.numpy(), world)
+
+# record what this attempt saw
+with open(os.path.join(out_dir, f"attempt{attempt}.rank{rank}.json"),
+          "w") as f:
+    json.dump({"world": world, "attempt": attempt}, f)
+
+ckpt = os.path.join(out_dir, f"ckpt.rank{rank}.npz")
+
+if attempt == 0:
+    # simulate training then a node loss: last rank dies mid-job
+    np.savez(ckpt, step=3)
+    if rank == world - 1:
+        time.sleep(0.5)
+        sys.exit(1)
+    time.sleep(30)  # survivors wait to be gang-killed by the launcher
+    sys.exit(1)
+
+# resumed attempts: training continues from the checkpoint
+assert os.path.exists(ckpt), "checkpoint from previous attempt missing"
+step = int(np.load(ckpt)["step"])
+assert step >= 3
+
+if attempt == 1:
+    np.savez(ckpt, step=step + 3)
+    # run "training" long enough for the test to post a join request;
+    # the launcher then re-forms the gang (we get terminated, which is
+    # expected — a nonzero exit here is the re-form, not a failure)
+    time.sleep(30)
+    sys.exit(1)
+
+# attempt >= 2: world must have grown back; finish clean
+np.savez(ckpt, step=step + 3)
+print(f"rank{rank} ELASTIC_OK world={world} step={step + 3}", flush=True)

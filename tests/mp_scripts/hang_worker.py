@@ -1,0 +1,54 @@
+"""Watchdog integration worker (driven by test_elastic.py).
+
+Attempt 0: the compiled train step contains a host callback that sleeps
+past the step deadline — the watchdog must dump stacks and abort this
+process so the launcher restarts the gang. Attempt 1: no hang; training
+finishes clean. Reference contract: comm_task_manager.cc hang abort +
+launcher restart loop."""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+attempt = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+hang = attempt == 0
+
+
+class MaybeHang(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 1)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if hang:
+            # device-side hang stand-in: a host callback that never
+            # finishes within the deadline (stop_gradient: callbacks
+            # have no VJP and the hang is forward-only anyway)
+            d = jax.lax.stop_gradient(h.sum()._data)
+            z = jax.pure_callback(
+                lambda v: (time.sleep(30), np.zeros((), np.float32))[1],
+                jax.ShapeDtypeStruct((), np.float32), d)
+            h = h + z
+        return h
+
+
+paddle.seed(0)
+m = MaybeHang()
+opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+step = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+X = paddle.randn([8, 4])
+Y = paddle.randn([8, 1])
+loss = step(X, Y)
+# host fetch forces us to wait on the (hung) device step; the watchdog
+# must fire first and abort the process
+print("loss:", float(loss.item()), flush=True)
+print(f"HANG_WORKER_DONE attempt={attempt}", flush=True)
