@@ -184,6 +184,16 @@ def main():
             "resnet50_cifar10_images_per_sec": round(img_s, 1),
             "gpt_small_tokens_per_sec_chip": round(tok_small, 1),
             "gpt_small_mfu": round(mfu_small, 4),
+            # analytic ramp-bubble per pipeline schedule at a
+            # representative S=4 stages, M=8 microbatches, V=2
+            # (PipelineTrainStep.bubble_fraction; single-chip bench
+            # cannot execute pp, so the schedule comparison is analytic)
+            "pp_bubble_fraction": {
+                "1f1b": round(3 / 7, 4),
+                "gpipe": round(3 / 11, 4),
+                "zero_bubble": round(3 / 11, 4),
+                "interleave_v2": round(7 / 15, 4),
+            },
             "vs_prev": {
                 "gpt_1b_tokens_per_sec": ratio(tok_1b,
                                                prev.get("_primary")),

@@ -38,7 +38,8 @@ from paddle_tpu.jit.trace import functionalize
 from paddle_tpu.nn.layer import Layer
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel", "pipeline_forward"]
+           "PipelineParallel", "pipeline_forward",
+           "pipeline_forward_interleaved"]
 
 
 class LayerDesc:
@@ -258,6 +259,67 @@ def pipeline_forward(stage_apply: Callable, stacked_params, x_mbs,
 
     (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
     # replicate last stage's outputs to every pp rank
+    outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+                    pp_axis)
+    return outs
+
+
+def pipeline_forward_interleaved(vstage_apply: Callable, stacked_params,
+                                 x_mbs, n_stages: int, v: int,
+                                 pp_axis: str = "pp"):
+    """Interleaved (VPP) rotation: ``v`` virtual stages per rank.
+
+    Reference: PipelineParallelWithInterleave
+    (meta_parallel/pipeline_parallel.py:987) — each rank owns ``v``
+    NON-contiguous layer chunks; a microbatch visits ranks
+    0..S-1, 0..S-1, ... ``v`` times. Here the virtual ring (depth S*v)
+    is realized with ``v`` rotating activation buffers per rank: each
+    tick applies every occupied slot's (1/v-sized) layer chunk, then
+    ppermutes all slots one rank right, slot-shifting on rank 0 (slot v
+    of the virtual ring = wrap v). To be called INSIDE shard_map manual
+    over ``pp_axis``.
+
+    vstage_apply(local_params, slot_index, h) applies this rank's slot
+    ``slot_index`` chunk (L/(S*v) layers). stacked_params' leading local
+    axis must be ordered rank-major (see pp_engine interleave reorder).
+    Returns [M, mb, ...] last-virtual-stage outputs, replicated over pp.
+    """
+    M = x_mbs.shape[0]
+    S = n_stages
+    R = S * v  # virtual ring depth
+    T = M + R - 1
+    idx = lax.axis_index(pp_axis)
+    bufs = jnp.zeros((v,) + x_mbs.shape[1:], x_mbs.dtype)
+    outs = jnp.zeros_like(x_mbs)
+
+    def tick(carry, t):
+        bufs, outs = carry
+        x_t = lax.dynamic_index_in_dim(x_mbs, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        # apply every slot's chunk; rank 0 slot 0 consumes the next
+        # microbatch (injection point of the virtual ring)
+        hs = []
+        for s in range(v):
+            inp = jnp.where(idx == 0, x_t, bufs[0]) if s == 0 else bufs[s]
+            hs.append(vstage_apply(stacked_params, s, inp))
+        h = jnp.stack(hs)
+        # completed microbatch exits at rank S-1, slot v-1
+        om = jnp.clip(t - (R - 1), 0, M - 1)
+        take = jnp.logical_and(idx == S - 1, t >= R - 1)
+        cur = lax.dynamic_index_in_dim(outs, om, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(take, h[v - 1], cur), om, 0)
+        # rotate all slots one rank right (ring includes S-1 -> 0)
+        nxt = lax.ppermute(h, pp_axis,
+                           [(i, (i + 1) % S) for i in range(S)])
+        # on rank 0 the arriving slot s continues as slot s+1 (virtual
+        # wrap); arriving slot v-1 is the completed output (dropped)
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(nxt[:1]), nxt[:-1]], axis=0)
+        new_bufs = jnp.where(idx == 0, shifted, nxt)
+        return (new_bufs, outs), None
+
+    (bufs, outs), _ = lax.scan(tick, (bufs, outs), jnp.arange(T))
     outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
                     pp_axis)
     return outs

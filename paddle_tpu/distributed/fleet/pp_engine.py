@@ -37,7 +37,9 @@ from jax.sharding import NamedSharding, PartitionSpec
 from paddle_tpu.core import generator as gen
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.engine import set_current_mesh
-from paddle_tpu.distributed.fleet.pipeline_parallel import pipeline_forward
+from paddle_tpu.distributed.fleet.pipeline_parallel import (
+    pipeline_forward, pipeline_forward_interleaved,
+)
 from paddle_tpu.distributed.mesh import ProcessMesh, Shard
 from paddle_tpu.jit.trace import functionalize
 
@@ -53,11 +55,37 @@ def _functionalize_layerlist(layers):
 
 
 class PipelineTrainStep:
+    SCHEDULES = ("1f1b", "gpipe", "interleave", "zero_bubble")
+
     def __init__(self, pipe_layer, loss_fn: Callable, optimizer,
                  mesh: ProcessMesh, n_microbatches: int = None,
                  pp_axis: str = "pp", dp_axis: str = "dp",
                  remat_body: bool = True, scaler=None,
-                 shard_pre_post: bool = True):
+                 shard_pre_post: bool = True, schedule: str = "1f1b",
+                 interleave_degree: int = 2):
+        """``schedule`` selects the microbatch schedule (reference ships
+        FThenB/1F1B/VPP/zero-bubble as pipeline_scheduler passes,
+        distributed/passes/pipeline_scheduler_pass/):
+
+        - "1f1b": chunks of S microbatches via in-step gradient
+          accumulation — in-flight activations capped at S (the 1F1B
+          memory bound), per-chunk ramp bubble (S-1)/(2S-1).
+        - "gpipe": all M microbatches in ONE rotation scan — bubble
+          shrinks to (S-1)/(M+S-1) but activations for M microbatches
+          are live (GPipe trade-off).
+        - "interleave": VPP (PipelineParallelWithInterleave,
+          pipeline_parallel.py:987) — each rank owns ``interleave_degree``
+          non-contiguous layer chunks on a virtual ring of depth S*V.
+        - "zero_bubble": the B/W-split bubble filling of the reference's
+          pipeline_zero_bubble.py is delegated to XLA: forward+backward
+          of the full-M rotation live in one fused program, and the
+          compiler schedules weight-grad matmuls into backward-ramp gaps
+          (same chunking as gpipe; distinct hand scheduling is an eager-
+          runtime concept with no analog in a single SPMD program).
+
+        ``bubble_fraction`` reports the analytic ramp bubble for the
+        chosen schedule.
+        """
         from paddle_tpu import amp as _amp
 
         self._pipe = pipe_layer
@@ -68,14 +96,26 @@ class PipelineTrainStep:
         self._dp_axis = dp_axis
         self.S = mesh.get_dim_size(pp_axis) if pp_axis in mesh.dim_names \
             else 1
-        M = n_microbatches or self.S
-        if M % self.S:
+        if schedule not in self.SCHEDULES:
+            raise ValueError(f"schedule must be one of {self.SCHEDULES}, "
+                             f"got {schedule!r}")
+        self.schedule = schedule
+        self.V = interleave_degree if schedule == "interleave" else 1
+        if self.V < 1:
+            raise ValueError("interleave_degree must be >= 1")
+        ring = self.S * self.V
+        M = n_microbatches or ring
+        # microbatches per accumulation chunk: the schedule's in-flight
+        # activation bound
+        self._chunk_mb = M if schedule in ("gpipe", "zero_bubble") \
+            else ring
+        if M % self._chunk_mb:
             raise ValueError(
-                f"n_microbatches ({M}) must be a multiple of the pipeline "
-                f"stages ({self.S}); microbatches run in chunks of S to "
-                f"cap in-flight activations at the 1F1B bound")
+                f"n_microbatches ({M}) must be a multiple of the chunk "
+                f"size ({self._chunk_mb} = ring depth {ring} for "
+                f"{schedule!r})")
         self.M = M
-        self.n_chunks = M // self.S
+        self.n_chunks = M // self._chunk_mb
         self._remat = remat_body
         self._scaler = scaler if scaler is not None and scaler.is_enable() \
             else None
@@ -111,8 +151,25 @@ class PipelineTrainStep:
         self._n_leaves = len(tmpl_params)
         self._body_hints = [getattr(p, "_placement_hints", None) or {}
                             for p in tmpl_params]
-        stacked = [jnp.stack([per_layer[l][i]._data
-                              for l in range(len(body))])
+        # stacking order: natural, or rank-major for interleave so each
+        # pp shard holds its V NON-contiguous virtual-stage chunks
+        # (position p = r*(V*Lv) + v*Lv + j <-> layer (v*S + r)*Lv + j)
+        L = len(body)
+        if self.V > 1 and self.S > 1:
+            if L % (self.S * self.V):
+                raise ValueError(
+                    f"interleave needs layers ({L}) divisible by "
+                    f"stages*degree ({self.S}*{self.V})")
+            Lv = L // (self.S * self.V)
+            self._layer_perm = [
+                (v * self.S + r) * Lv + j
+                for r in range(self.S)
+                for v in range(self.V)
+                for j in range(Lv)]
+        else:
+            self._layer_perm = list(range(L))
+        stacked = [jnp.stack([per_layer[self._layer_perm[p]][i]._data
+                              for p in range(L)])
                    for i in range(self._n_leaves)]
         self._stacked_body = stacked
 
@@ -174,13 +231,15 @@ class PipelineTrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
-        self._wd_warm = False  # first call = compile, stretched deadline
+        self._wd_warm = None  # last batch shapes (compile detection)
 
     # ------------------------------------------------------------------
     def _make_step_fn(self):
         mesh = self._mesh
         jmesh = mesh.jax_mesh()
         S, M, C = self.S, self.M, self.n_chunks
+        CM, V = self._chunk_mb, self.V
+        n_body = len(self._body_layer_params)
         pp_axis = self._pp_axis
         body_apply = self._body_template_apply
         pre_apply = self._pre_apply
@@ -220,15 +279,29 @@ class PipelineTrainStep:
                                else p for j, p in enumerate(post_pd)]
                 k1, k2, k3 = jax.random.split(k, 3)
                 h, new_pre_b = pre_apply(pre_pd, pre_bufs, k1, xc)
-                # microbatch: [B, ...] -> [S, B/S, ...]
+                # microbatch: [B, ...] -> [CM, B/CM, ...]
                 B = h.shape[0]
-                h_mbs = h.reshape((S, B // S) + h.shape[1:])
+                h_mbs = h.reshape((CM, B // CM) + h.shape[1:])
 
                 if S > 1:
-                    def spmd_body(body_leaves, mbs):
-                        return pipeline_forward(
-                            lambda lp, hh: body_block(lp, hh, k2),
-                            body_leaves, mbs, S, pp_axis)
+                    if V > 1:
+                        # VPP: each rank's shard holds V virtual-stage
+                        # chunks of Lvl layers (rank-major reorder)
+                        Lvl = (n_body // S) // V
+
+                        def vapply(leaves, s, hh):
+                            sub = tuple(
+                                l[s * Lvl:(s + 1) * Lvl] for l in leaves)
+                            return body_block(sub, hh, k2)
+
+                        def spmd_body(body_leaves, mbs):
+                            return pipeline_forward_interleaved(
+                                vapply, body_leaves, mbs, S, V, pp_axis)
+                    else:
+                        def spmd_body(body_leaves, mbs):
+                            return pipeline_forward(
+                                lambda lp, hh: body_block(lp, hh, k2),
+                                body_leaves, mbs, S, pp_axis)
 
                     body_specs = tuple(
                         PartitionSpec(pp_axis) for _ in body_pd)
@@ -313,12 +386,15 @@ class PipelineTrainStep:
                         nps.append(p)
                         nss.append(s)
                         continue
-                    # per-param decay exclusion (trace-time static), same
-                    # as jit/train.py and distributed/engine.py
+                    # per-param decay exclusion + ASP mask (trace-time
+                    # static), same as jit/train.py and engine.py
                     opt._current_decay_enabled = opt._decay_enabled(
                         param_refs[i])
+                    opt._current_mask = opt._param_masks.get(
+                        id(param_refs[i]))
                     np_, ns = opt._rule_mp(p, g, s, lr, step)
                     opt._current_decay_enabled = True
+                    opt._current_mask = None
                     if found_inf is not None:
                         np_ = jnp.where(found_inf, p, np_)
                         ns = {k: jnp.where(found_inf, s[k], v)
@@ -349,7 +425,8 @@ class PipelineTrainStep:
             raise ValueError(
                 f"batch size {xd.shape[0]} must be a multiple of "
                 f"n_microbatches ({self.M} = {self.n_chunks} chunks x "
-                f"{self.S} stages); pad the batch or adjust "
+                f"{self._chunk_mb} microbatches/chunk, schedule="
+                f"{self.schedule}); pad the batch or adjust "
                 f"accumulate_steps")
         jmesh = self._mesh.jax_mesh()
         dp = self._dp_axis if self._dp_axis in self._mesh.dim_names else None
@@ -399,11 +476,15 @@ class PipelineTrainStep:
         if self._lr_arr is None or lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
-        from paddle_tpu.distributed.watchdog import arm_step, attach_step
+        from paddle_tpu.distributed.watchdog import (
+            arm_step, attach_step, default_watchdog,
+        )
 
+        # new batch shapes force a retrace: stretched (compile) deadline
+        shapes = ((tuple(xd.shape), str(xd.dtype)),
+                  (tuple(yd.shape), str(yd.dtype)))
         wd_id = arm_step(f"PipelineTrainStep#{self._opt._step_count}",
-                         cold=not self._wd_warm)
-        self._wd_warm = True
+                         cold=self._wd_warm != shapes)
         set_current_mesh(self._mesh)
         try:
             (loss, self._carry, npre, nbody, npost, npre_s, nbody_s,
@@ -417,8 +498,12 @@ class PipelineTrainStep:
                              [b._data for b in self._pre_buffers],
                              [b._data for b in self._post_buffers],
                              self._lr_arr, self._scaler_state, xd, yd)
+        except BaseException:
+            default_watchdog().disarm(wd_id)
+            raise
         finally:
             set_current_mesh(None)
+        self._wd_warm = shapes
         attach_step(wd_id, loss)
         for p, d in zip(self._pre_params, npre):
             p._data = d
@@ -438,14 +523,23 @@ class PipelineTrainStep:
             _amp.scaler_sync_from_state(self._scaler, nscaler)
         return Tensor._from_data(loss)
 
+    @property
+    def bubble_fraction(self) -> float:
+        """Analytic ramp-bubble fraction of the chosen schedule: the
+        virtual ring needs R-1 fill ticks per chunk of CM microbatches
+        (same shape for the reverse/backward rotation)."""
+        ring = self.S * self.V
+        return (ring - 1) / (self._chunk_mb + ring - 1)
+
     def sync_params_to_model(self):
         """Write stacked body params back into the Layer objects (for
-        state_dict / checkpointing)."""
+        state_dict / checkpointing). Honors the interleave reorder."""
         L = len(self._body_layer_params)
         for i in range(self._n_leaves):
             leaf = self._stacked_body[i]
-            for l in range(L):
-                self._body_layer_params[l][i]._data = leaf[l]
+            for p in range(L):
+                self._body_layer_params[self._layer_perm[p]][i]._data = \
+                    leaf[p]
 
 
 def _grad_dtype(dtype):
