@@ -259,9 +259,12 @@ class ParallelTrainStep:
                 gi += 1
                 optimizer._current_decay_enabled = optimizer._decay_enabled(
                     self._params[i])
+                optimizer._current_mask = \
+                    optimizer._param_masks.get(id(self._params[i]))
                 np_, ns = optimizer._rule_mp(param_datas[i], g,
                                              slot_list[i], lr, step)
                 optimizer._current_decay_enabled = True
+                optimizer._current_mask = None
                 if found_inf is not None:
                     np_ = jnp.where(found_inf, param_datas[i], np_)
                     ns = {k: jnp.where(found_inf, slot_list[i][k], v)
@@ -282,7 +285,7 @@ class ParallelTrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
-        self._wd_warm = False  # first call = compile, stretched deadline
+        self._wd_warm = None  # last batch shapes (compile detection)
 
     def _build_jit(self, batch_datas):
         scaler_sh = self._repl if self._scaler_state is not None else None
@@ -336,19 +339,26 @@ class ParallelTrainStep:
             self._lr_arr = jax.device_put(np.float32(lr_val), self._repl)
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
-        from paddle_tpu.distributed.watchdog import arm_step, attach_step
+        from paddle_tpu.distributed.watchdog import (
+            arm_step, attach_step, default_watchdog,
+        )
 
+        # new batch shapes force a retrace: stretched (compile) deadline
+        shapes = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
         wd_id = arm_step(f"ParallelTrainStep#{self._opt._step_count}",
-                         cold=not self._wd_warm)
-        self._wd_warm = True
+                         cold=self._wd_warm != shapes)
         set_current_mesh(self._mesh)
         try:
             loss, self._carry, new_params, new_slots, new_buffers, \
                 new_scaler_state = self._jitted(
                     self._carry, param_datas, self._slots, buffer_datas,
                     self._lr_arr, self._scaler_state, *datas)
+        except BaseException:
+            default_watchdog().disarm(wd_id)
+            raise
         finally:
             set_current_mesh(None)
+        self._wd_warm = shapes
         attach_step(wd_id, loss)
         for p, np_ in zip(self._params, new_params):
             p._data = np_

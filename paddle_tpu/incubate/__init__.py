@@ -4,3 +4,4 @@ Reference: python/paddle/incubate/ (nn/functional fused ops, MoE under
 incubate/distributed/models/moe)."""
 from paddle_tpu.incubate import moe  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401

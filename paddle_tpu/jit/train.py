@@ -137,12 +137,16 @@ class TrainStep:
                         continue
                     g = grads[gi]
                     gi += 1
-                    # per-param decay exclusion is trace-time static
+                    # per-param decay exclusion + ASP mask are
+                    # trace-time static
                     optimizer._current_decay_enabled = \
                         optimizer._decay_enabled(self._params[i])
+                    optimizer._current_mask = \
+                        optimizer._param_masks.get(id(self._params[i]))
                     np_, ns = optimizer._rule_mp(param_datas[i], g,
                                                  slot_list[i], lr, step)
                     optimizer._current_decay_enabled = True
+                    optimizer._current_mask = None
                     if skip is not None:
                         np_ = jnp.where(skip, param_datas[i], np_)
                         ns = {k: jnp.where(skip, slot_list[i][k], v)
@@ -183,7 +187,7 @@ class TrainStep:
         self._host_step_mirror = optimizer._step_count
         self._lr_val = None
         self._lr_arr = None
-        self._wd_warm: set = set()  # executables past their first run
+        self._wd_warm: dict = {}  # id(jitted) -> last batch shapes
 
     def _sync_step_carry(self):
         """If the optimizer's step counter was changed externally (e.g.
@@ -231,18 +235,28 @@ class TrainStep:
         """Dispatch one compiled step and rebind carried state."""
         from paddle_tpu.distributed.watchdog import arm_step, attach_step
 
+        from paddle_tpu.distributed.watchdog import default_watchdog
+
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
-        # first call of an executable includes trace+XLA compile, which
-        # gets a stretched deadline (slow is not hung)
-        warm = id(jitted) in self._wd_warm
+        # a call that will trace+compile (first call, or new batch
+        # shapes forcing a retrace) gets a stretched deadline — compile
+        # is slow, not hung
+        shapes = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        warm = self._wd_warm.get(id(jitted)) == shapes
         wd_id = arm_step(f"TrainStep#{self._opt._step_count}",
                          cold=not warm)
-        loss, self._carry, new_params, new_slots, new_buffers, \
-            new_scaler_state, valid = jitted(
-                n_inputs, self._carry, param_datas, self._slots,
-                buffer_datas, self._lr_arr, self._scaler_state, *datas)
-        self._wd_warm.add(id(jitted))
+        try:
+            loss, self._carry, new_params, new_slots, new_buffers, \
+                new_scaler_state, valid = jitted(
+                    n_inputs, self._carry, param_datas, self._slots,
+                    buffer_datas, self._lr_arr, self._scaler_state,
+                    *datas)
+        except BaseException:
+            # failed dispatch must not leave an armed deadline behind
+            default_watchdog().disarm(wd_id)
+            raise
+        self._wd_warm[id(jitted)] = shapes
         attach_step(wd_id, loss)
         for p, np_ in zip(self._params, new_params):
             p._data = np_

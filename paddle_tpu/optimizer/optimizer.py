@@ -41,6 +41,11 @@ class Optimizer:
         self._slots: Dict[int, dict] = {}
         self._step_count = 0
         self._multi_precision = bool(multi_precision)
+        # ASP n:m sparsity enforcement (incubate/asp): id(param) -> 0/1
+        # mask, re-applied after every update; call sites set
+        # _current_mask per param (trace-time static, like decay)
+        self._param_masks: Dict[int, object] = {}
+        self._current_mask = None
 
     # -- lr ----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -102,12 +107,16 @@ class Optimizer:
             ns = {k: (v.astype(inner[k].dtype)
                       if k in inner and hasattr(v, "astype") else v)
                   for k, v in ns.items()}
+            if self._current_mask is not None:  # ASP n:m enforcement
+                new_mw = new_mw * self._current_mask.astype(new_mw.dtype)
             ns["master_weight"] = new_mw.astype(jnp.float32)
             return new_mw.astype(p.dtype), ns
         new_p, ns = self._rule(p, g, slots, lr, step)
         ns = {k: (v.astype(slots[k].dtype)
                   if k in slots and hasattr(v, "astype") else v)
               for k, v in ns.items()}
+        if self._current_mask is not None:  # ASP n:m enforcement
+            new_p = new_p * self._current_mask.astype(new_p.dtype)
         return new_p.astype(p.dtype), ns
 
     # weight decay applied as decoupled or L2 depending on optimizer.
@@ -146,9 +155,11 @@ class Optimizer:
             if gdata.dtype != p._data.dtype:
                 gdata = gdata.astype(p._data.dtype)
             self._current_decay_enabled = self._decay_enabled(p)
+            self._current_mask = self._param_masks.get(id(p))
             new_p, new_slots = self._rule_mp(p._data, gdata, slots,
                                              self.get_lr(), self._step_count)
             self._current_decay_enabled = True
+            self._current_mask = None
             # params keep their user placement even when sharded slots
             # (dist.shard_optimizer ZeRO stages) would propagate their
             # sharding through the update math

@@ -23,7 +23,7 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
            "add", "subtract", "multiply", "divide", "matmul", "relu",
            "tanh", "sqrt", "sin", "abs", "pow", "neg", "cast",
-           "transpose"]
+           "transpose", "softmax", "masked_matmul"]
 
 
 class _SparseBase:
@@ -242,6 +242,25 @@ def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
 def transpose(x, perm):
     m = _coo(x)
     return SparseCooTensor(m.transpose(tuple(perm)))
+
+
+def softmax(x, axis=-1):
+    """Softmax over the STORED values of each row, absent entries
+    treated as -inf (reference phi/kernels/sparse/softmax_kernel.cc /
+    sparse.nn.functional.softmax). 2-D sparse only; axis must be the
+    last. This is the sparse-attention normalizer: rows with different
+    sparsity patterns normalize over their own support."""
+    if axis not in (-1, 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    m = _coo(x).sum_duplicates(nse=_coo(x).nse)
+    rows = m.indices[:, 0]
+    nrows = m.shape[0]
+    mx = jax.ops.segment_max(m.data, rows, num_segments=nrows)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    ex = jnp.exp(m.data - mx[rows])
+    s = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+    out = ex / jnp.maximum(s[rows], 1e-38)
+    return _wrap_like(x, jsparse.BCOO((out, m.indices), shape=m.shape))
 
 
 # -- Tensor interop (reference: Tensor.to_sparse_coo / to_dense) ------------
