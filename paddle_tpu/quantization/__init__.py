@@ -236,21 +236,33 @@ class QuantedLayer(Layer):
 
 
 class ConvertedQuantLayer(Layer):
-    """Post-convert form: weights stored int8 + scale, dequantized (and
-    activations quant-dequant'ed) at forward — the simulated-int8
-    execution the reference's convert() produces for eval/export."""
+    """Post-convert form: the weight is stored as an INT8 buffer + scale
+    and dequantized inside the compiled graph (activations
+    quant-dequant'ed) — the reference's convert() output feeding int8
+    export (static/quantization/post_training_quantization.py role).
+
+    The original f32 weight is NOT kept: state_dict/jit.save carry the
+    int8 buffer (~4x smaller), and the exported StableHLO takes the int8
+    array as an input with the dequant multiply compiled in."""
 
     def __init__(self, q: QuantedLayer):
         super().__init__()
-        self.inner = q.inner
+        inner = q.inner
+        self._is_linear = isinstance(inner, nn.Linear)
+        if not self._is_linear:
+            self._stride = inner.stride
+            self._padding = inner.padding
+            self._dilation = inner.dilation
+            self._groups = inner.groups
         bits = q.w_observer.quant_bits
         qmax = float(2 ** (bits - 1) - 1)
-        w = q.inner.weight.numpy()
-        self.w_scale = q.w_observer.scale()
-        self.qweight = np.clip(
-            np.round(w / self.w_scale * qmax), -qmax, qmax
-        ).astype(np.int8)
-        self.act_scale = q.act_observer.scale()
+        w = inner.weight.numpy()
+        self.w_scale = float(q.w_observer.scale())
+        qw = np.clip(np.round(w / self.w_scale * qmax), -qmax, qmax
+                     ).astype(np.int8)
+        self.register_buffer("qweight", Tensor(qw))
+        self.bias = inner.bias  # reused Parameter (may be None)
+        self.act_scale = float(q.act_observer.scale())
         self.act_bits = q.act_observer.quant_bits
         self._qmax = qmax
 
@@ -258,14 +270,12 @@ class ConvertedQuantLayer(Layer):
         from paddle_tpu import ops
 
         x = quant_dequant(x, self.act_scale, self.act_bits)
-        w = Tensor(self.qweight.astype(np.float32)
-                   * (self.w_scale / self._qmax))
-        if isinstance(self.inner, nn.Linear):
-            return ops.linear(x, w, self.inner.bias)
-        c = self.inner
-        return ops.conv2d(x, w, c.bias, stride=c.stride,
-                          padding=c.padding, dilation=c.dilation,
-                          groups=c.groups)
+        w = ops.cast(self.qweight, "float32") * (self.w_scale / self._qmax)
+        if self._is_linear:
+            return ops.linear(x, w, self.bias)
+        return ops.conv2d(x, w, self.bias, stride=self._stride,
+                          padding=self._padding, dilation=self._dilation,
+                          groups=self._groups)
 
 
 _DEFAULT_TYPES = (nn.Linear, nn.Conv2D)
