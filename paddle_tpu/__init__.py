@@ -132,4 +132,6 @@ def device_count():
     from paddle_tpu.core.place import device_count as _dc
     return _dc()
 from paddle_tpu import sparse  # noqa: F401,E402
+from paddle_tpu import geometric  # noqa: F401,E402
+from paddle_tpu import onnx  # noqa: F401,E402
 from paddle_tpu import quantization  # noqa: F401,E402

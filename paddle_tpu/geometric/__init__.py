@@ -1,0 +1,142 @@
+"""paddle.geometric — graph message passing, segment math, reindexing.
+
+Reference: python/paddle/geometric/ — message_passing/send_recv.py
+(send_u_recv:36, send_ue_recv:187, send_uv:392 over the graph_send_*
+CUDA kernels), math.py (segment_sum/mean/min/max), reindex.py
+(reindex_graph), sampling/neighbors.py (sample_neighbors).
+
+TPU-native: gather + ``jax.ops.segment_*`` — XLA lowers these to fused
+gather/scatter kernels, which is exactly what the reference's
+graph_send_recv kernels hand-implement. Sampling/reindex are host-side
+numpy (data preparation, like the reference's CPU path).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_min", "segment_max", "reindex_graph",
+           "sample_neighbors"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _idx(x):
+    return jnp.asarray(_data(x), jnp.int32)
+
+
+# one segment-reduce / message-op implementation, shared with the
+# graph_send_* registry emitters
+from paddle_tpu.ops.graph_ops import _segment  # noqa: E402
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None) -> Tensor:
+    """Gather x[src] along edges, reduce at dst (reference
+    send_recv.py:36). Routed through the graph_send_recv registry op so
+    eager autograd records the gather/segment vjp."""
+    from paddle_tpu import ops
+
+    return ops.graph_send_recv(x, src_index, dst_index,
+                               reduce_op=reduce_op,
+                               out_size=int(out_size or 0))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None) -> Tensor:
+    """Combine x[src] with edge features y, reduce at dst
+    (reference send_recv.py:187)."""
+    from paddle_tpu import ops
+
+    return ops.graph_send_ue_recv(x, y, src_index, dst_index,
+                                  message_op=message_op,
+                                  reduce_op=reduce_op,
+                                  out_size=int(out_size or 0))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add",
+            name=None) -> Tensor:
+    """Per-edge message from both endpoints (reference
+    send_recv.py:392): out[e] = x[src[e]] op y[dst[e]]."""
+    from paddle_tpu import ops
+
+    return ops.graph_send_uv(x, y, src_index, dst_index,
+                             message_op=message_op)
+
+
+def _segment_api(op):
+    def fn(data, segment_ids, name=None):
+        d = _data(data)
+        seg = _idx(segment_ids)
+        n = int(jnp.max(seg)) + 1 if seg.size else 0
+        return Tensor._from_data(_segment(op, d, seg, n))
+
+    fn.__name__ = f"segment_{op}"
+    fn.__doc__ = (f"segment_{op} over the leading axis (reference "
+                  "geometric/math.py; segment ids must be sorted "
+                  "ascending in the reference — unsorted also works "
+                  "here).")
+    return fn
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_min = _segment_api("min")
+segment_max = _segment_api("max")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Compact the node space of a sampled subgraph (reference
+    reindex.py:reindex_graph): nodes in ``x`` keep ids 0..len(x)-1,
+    unseen neighbor ids get fresh consecutive ids."""
+    xs = np.asarray(x.numpy() if hasattr(x, "numpy") else x).ravel()
+    nb = np.asarray(neighbors.numpy() if hasattr(neighbors, "numpy")
+                    else neighbors).ravel()
+    cnt = np.asarray(count.numpy() if hasattr(count, "numpy")
+                     else count).ravel()
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    for v in nb:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(mapping)
+    reindex_src = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    # edges: neighbors are grouped per source node, count[i] edges each
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor._from_data(jnp.asarray(reindex_src)),
+            Tensor._from_data(jnp.asarray(reindex_dst)),
+            Tensor._from_data(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors per input
+    node from a CSC graph (reference sampling/neighbors.py). Host-side
+    numpy — graph sampling is data preparation."""
+    r = np.asarray(row.numpy() if hasattr(row, "numpy") else row).ravel()
+    cp = np.asarray(colptr.numpy() if hasattr(colptr, "numpy")
+                    else colptr).ravel()
+    nodes = np.asarray(input_nodes.numpy()
+                       if hasattr(input_nodes, "numpy")
+                       else input_nodes).ravel()
+    rng = np.random.RandomState(0)
+    out, counts = [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        neigh = r[lo:hi]
+        if 0 <= sample_size < len(neigh):
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.append(neigh)
+        counts.append(len(neigh))
+    flat = np.concatenate(out) if out else np.zeros((0,), np.int64)
+    return (Tensor._from_data(jnp.asarray(flat.astype(np.int64))),
+            Tensor._from_data(jnp.asarray(np.asarray(counts, np.int64))))

@@ -1,0 +1,67 @@
+"""Graph message-passing emitters.
+
+Reference kernels: paddle/phi/kernels/gpu/graph_send_recv_kernel.cu,
+graph_send_ue_recv_kernel.cu, graph_send_uv_kernel.cu (+ their grad
+kernels). Here each op is one gather + XLA segment reduction, and the
+backward comes from jax.vjp over the emitter like every other op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+def _segment(reduce_op, msgs, dst, n):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), msgs.dtype), dst, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    if reduce_op == "min":
+        out = jax.ops.segment_min(msgs, dst, num_segments=n)
+    elif reduce_op == "max":
+        out = jax.ops.segment_max(msgs, dst, num_segments=n)
+    else:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    # empty segments come back +/-inf; the reference fills zeros
+    return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+
+
+def _message(xs, e, message_op):
+    if message_op == "add":
+        return xs + e
+    if message_op == "sub":
+        return xs - e
+    if message_op == "mul":
+        return xs * e
+    if message_op == "div":
+        return xs / e
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+@op
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=0):
+    src = jnp.asarray(src_index).astype(jnp.int32)
+    dst = jnp.asarray(dst_index).astype(jnp.int32)
+    n = int(out_size) if out_size else x.shape[0]
+    return _segment(reduce_op, x[src], dst, n)
+
+
+@op
+def graph_send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                       reduce_op="sum", out_size=0):
+    src = jnp.asarray(src_index).astype(jnp.int32)
+    dst = jnp.asarray(dst_index).astype(jnp.int32)
+    n = int(out_size) if out_size else x.shape[0]
+    return _segment(reduce_op, _message(x[src], y, message_op), dst, n)
+
+
+@op
+def graph_send_uv(x, y, src_index, dst_index, message_op="add"):
+    src = jnp.asarray(src_index).astype(jnp.int32)
+    dst = jnp.asarray(dst_index).astype(jnp.int32)
+    return _message(x[src], y[dst], message_op)

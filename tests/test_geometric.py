@@ -1,0 +1,129 @@
+"""paddle.geometric parity: message passing + segment math + sampling.
+
+Reference: python/paddle/geometric/message_passing/send_recv.py
+(send_u_recv/send_ue_recv/send_uv docstring examples give the expected
+numerics), math.py, reindex.py, sampling/neighbors.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def test_send_u_recv_sum_mean_max_min():
+    # the reference docstring graph: edges (0->1),(1->2),(2->1),(0->0)
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.array([[0, 2, 3], [2, 8, 10], [1, 4, 5]], np.float32))
+    out = G.send_u_recv(x, src, dst, reduce_op="mean")
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.array([[0, 2, 3], [1, 4, 5], [1, 4, 5]], np.float32))
+    out = G.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.array([[0, 2, 3], [2, 6, 7], [1, 4, 5]], np.float32))
+    out = G.send_u_recv(x, src, dst, reduce_op="min")
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.array([[0, 2, 3], [0, 2, 3], [1, 4, 5]], np.float32))
+
+
+def test_send_u_recv_out_size_and_empty_segment():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([0, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="max", out_size=2)
+    assert out.shape == [2, 3]
+    # empty segment 1 fills with zeros (reference semantics), not -inf
+    np.testing.assert_allclose(out.numpy()[1], np.zeros(3))
+
+
+def test_send_ue_recv_message_ops():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    e = paddle.to_tensor(np.array([[10.0, 10.0], [2.0, 2.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 1], np.int32))
+    out = G.send_ue_recv(x, e, src, dst, message_op="add",
+                         reduce_op="sum")
+    np.testing.assert_allclose(out.numpy()[1], [16.0, 18.0])
+    out = G.send_ue_recv(x, e, src, dst, message_op="mul",
+                         reduce_op="sum")
+    np.testing.assert_allclose(out.numpy()[1], [16.0, 28.0])
+
+
+def test_send_uv():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    y = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 0], np.int32))
+    out = G.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(out.numpy(), [[21.0], [12.0]])
+
+
+def test_segment_math():
+    data = paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6]],
+                                     np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(G.segment_sum(data, seg).numpy(),
+                               [[4, 6], [5, 6]])
+    np.testing.assert_allclose(G.segment_mean(data, seg).numpy(),
+                               [[2, 3], [5, 6]])
+    np.testing.assert_allclose(G.segment_min(data, seg).numpy(),
+                               [[1, 2], [5, 6]])
+    np.testing.assert_allclose(G.segment_max(data, seg).numpy(),
+                               [[3, 4], [5, 6]])
+
+
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([0, 5, 9], np.int64))
+    neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6], np.int64))
+    count = paddle.to_tensor(np.array([2, 3, 1], np.int64))
+    rsrc, rdst, nodes = G.reindex_graph(x, neighbors, count)
+    # original nodes keep ids 0..2; new neighbors get 3,4,...
+    assert nodes.numpy()[:3].tolist() == [0, 5, 9]
+    assert rdst.numpy().tolist() == [0, 0, 1, 1, 1, 2]
+    assert rsrc.numpy()[1] == 2   # neighbor 9 is existing node id 2
+    assert rsrc.numpy()[2] == 0   # neighbor 0 is existing node id 0
+    assert len(set(rsrc.numpy().tolist())) == 6
+
+
+def test_sample_neighbors():
+    # CSC: node i's in-neighbors = row[colptr[i]:colptr[i+1]]
+    row = paddle.to_tensor(np.array([1, 2, 3, 0, 2, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 3, 5, 6], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+    neigh, counts = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+    assert counts.numpy().tolist() == [2, 1]
+    assert set(neigh.numpy()[:2]).issubset({1, 2, 3})
+    assert neigh.numpy()[2] == 0
+
+
+def test_gcn_layer_trains():
+    """A tiny GCN built from send_u_recv must train under autograd."""
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    n, d = 12, 8
+    rng = np.random.RandomState(0)
+    feats = paddle.to_tensor(rng.randn(n, d).astype(np.float32))
+    src = paddle.to_tensor(rng.randint(0, n, 40).astype(np.int32))
+    dst = paddle.to_tensor(rng.randint(0, n, 40).astype(np.int32))
+    y = paddle.to_tensor(rng.randn(n, 1).astype(np.float32))
+
+    lin = nn.Linear(d, 1)
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=lin.parameters())
+    losses = []
+    for _ in range(25):
+        h = G.send_u_recv(lin(feats), src, dst, reduce_op="mean")
+        loss = nn.MSELoss()(h, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
