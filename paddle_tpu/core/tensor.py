@@ -321,7 +321,15 @@ def _np_to_jax(data, dtype):
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
     elif arr.dtype == np.int64:
-        # stay int64? TPU prefers int32 but paddle semantics use int64 indices.
+        # paddle semantics use int64 ids/indices; TPU wants int32 (x64
+        # is off). Guard the narrowing: values beyond int32 would wrap
+        # silently — ids >2B need jax_enable_x64 or explicit chunking.
+        if arr.size and (arr.max() > np.iinfo(np.int32).max
+                         or arr.min() < np.iinfo(np.int32).min):
+            raise OverflowError(
+                "int64 tensor holds values outside int32 range; the TPU "
+                "build narrows int64->int32 (XLA x64 is disabled). Use "
+                "smaller ids or enable jax_enable_x64.")
         arr = arr.astype(np.int32)
     return jnp.asarray(arr)
 

@@ -98,8 +98,9 @@ def _launch_elastic(args, extra_env, min_n, max_n):
         if rc == 130:  # user interrupt is a stop, not a member failure
             return rc
         attempt += 1
-        new_world = mgr.decide_world(world, lost=lost)
-        mgr.clear_join_requests()
+        joins = mgr.join_requests()
+        new_world = mgr.decide_world(world, lost=lost, joins=joins)
+        mgr.consume_join_requests(joins)
         if new_world is None:
             print(f"[launch] membership fell below min={min_n}; giving up",
                   file=sys.stderr, flush=True)

@@ -118,15 +118,25 @@ class ElasticManager:
         for k in self.join_requests():
             self.store.delete(f"join/{k}")
 
-    def decide_world(self, current: int, lost: int = 0) -> Optional[int]:
+    def decide_world(self, current: int, lost: int = 0,
+                     joins: Optional[Set[str]] = None) -> Optional[int]:
         """New world size after membership change, or None = give up.
 
         scale-in: lose workers but stay >= min -> shrink; below min ->
         unrecoverable (reference: job fails when under min_nodes).
-        scale-out: pending join requests grow the world up to max."""
+        scale-out: pending join requests grow the world up to max.
+        Pass the ``joins`` snapshot you intend to consume (and delete
+        exactly that set afterwards) — re-reading here would race with
+        new arrivals and drop them uncounted."""
         want = current - lost
-        want += len(self.join_requests())
+        want += len(self.join_requests() if joins is None else joins)
         want = min(want, self.max)
         if want < self.min:
             return None
         return want
+
+    def consume_join_requests(self, joins: Set[str]):
+        """Delete exactly the counted requests; later arrivals survive
+        for the next membership decision."""
+        for j in joins:
+            self.store.delete(f"join/{j}")

@@ -141,8 +141,10 @@ class FileStore:
 
     def list(self, prefix: str = "") -> List[str]:
         pat = prefix.replace("/", "__")
+        # in-flight writes use ".tmp<pid>" names (see set); they must
+        # never surface as phantom keys to pollers
         return [f for f in os.listdir(self._dir)
-                if f.startswith(pat) and not f.endswith("tmp")]
+                if f.startswith(pat) and ".tmp" not in f]
 
     def add(self, key: str, amount: int = 1) -> int:
         # lock-free: one slot file per add, value = sum of slots

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import faulthandler
 import os
+import queue
 import sys
 import threading
 import time
@@ -47,6 +48,8 @@ class StepWatchdog:
         self._lock = threading.Lock()
         self._seq = 0
         self._monitor: Optional[threading.Thread] = None
+        self._prober: Optional[threading.Thread] = None
+        self._probe_q = None
         self.fired = False
 
     @property
@@ -81,19 +84,29 @@ class StepWatchdog:
         return eid
 
     def attach(self, eid: int, arrays) -> None:
-        """After dispatch: a prober thread blocks until the device
-        produces ``arrays`` and then clears the entry (the end record)."""
+        """After dispatch: the prober thread blocks until the device
+        produces ``arrays`` and then clears the entry (the end record).
+        One long-lived prober drains a queue — steps complete in order,
+        so serialized probing is exact and avoids per-step thread
+        churn."""
         if not eid:
             return
+        with self._lock:
+            if self._prober is None:
+                self._probe_q = queue.SimpleQueue()
+                self._prober = threading.Thread(target=self._probe_loop,
+                                                daemon=True)
+                self._prober.start()
+        self._probe_q.put((eid, arrays))
 
-        def probe():
+    def _probe_loop(self):
+        while True:
+            eid, arrays = self._probe_q.get()
             try:
                 jax.block_until_ready(arrays)
             except Exception:
                 pass  # step failure surfaces on the main thread
             self.disarm(eid)
-
-        threading.Thread(target=probe, daemon=True).start()
 
     def disarm(self, eid: int) -> None:
         with self._lock:

@@ -13,7 +13,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping"]
+           "EarlyStopping", "VisualDL"]
 
 
 class Callback:
@@ -216,3 +216,71 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print(f"Early stopping: {self.monitor} did not improve "
                           f"beyond {self.best:.5f}")
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py:883
+    VisualDL over the visualdl LogWriter). The visualdl package is
+    optional; without it scalars append to ``<log_dir>/scalars.jsonl``
+    (one {"tag", "step", "value"} record per line) so training curves
+    are still recorded and machine-readable."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._fallback = None
+        self._step = 0
+        self._epoch = 0
+
+    def _ensure_writer(self):
+        if self._writer is None and self._fallback is None:
+            import os
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            try:
+                from visualdl import LogWriter  # optional dep
+
+                self._writer = LogWriter(logdir=self.log_dir)
+            except ImportError:
+                self._fallback = open(
+                    os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _scalar(self, tag, value, step):
+        self._ensure_writer()
+        try:
+            v = float(value[0] if isinstance(value, (list, tuple))
+                      else value)
+        except (TypeError, ValueError):
+            return
+        if self._writer is not None:
+            self._writer.add_scalar(tag=tag, value=v, step=step)
+        else:
+            import json
+
+            self._fallback.write(json.dumps(
+                {"tag": tag, "step": step, "value": v}) + "\n")
+            self._fallback.flush()
+
+    def _log_all(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            if k in ("batch_size", "steps"):
+                continue
+            self._scalar(f"{prefix}/{k}", v, step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log_all("train", logs, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+        self._log_all("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log_all("eval", logs, self._epoch)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+        if self._fallback is not None:
+            self._fallback.close()

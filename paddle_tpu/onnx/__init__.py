@@ -1,0 +1,19 @@
+"""paddle.onnx surface (reference: python/paddle/onnx/export.py, a hook
+into the external paddle2onnx package).
+
+Deliberately out of scope (see README "Scope"): the TPU deployment path
+is ``paddle_tpu.jit.save`` — an AOT StableHLO module with swappable
+(optionally int8-quantized) weights. This stub keeps the import surface
+so reference code fails with an actionable message instead of an
+AttributeError.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is out of scope for the TPU build (it hooks the "
+        "external paddle2onnx package). Use paddle_tpu.jit.save(layer, "
+        "path, input_spec=...) to produce a serialized StableHLO module "
+        "that paddle_tpu.jit.load runs on any XLA backend.")

@@ -286,6 +286,47 @@ class Profiler:
         return {r[0]: {"calls": r[1], "total_ms": r[2], "avg_ms": r[3],
                        "max_ms": r[4]} for r in rows}
 
+    def device_summary(self, top: int = 40, print_table: bool = True):
+        """Per-op DEVICE time table from the captured xplane trace — the
+        device half of the reference's profiler_statistic.py report
+        (kernel stats aggregated from CUPTI there, from the TPU/XLA
+        xplane here). Requires the profiler to have run with device
+        tracing (the default when jax.profiler capture is available)."""
+        import glob
+
+        from jax.profiler import ProfileData
+
+        files = sorted(glob.glob(
+            os.path.join(self._trace_dir, "**", "*.xplane.pb"),
+            recursive=True))
+        if not files:
+            return {}
+        pd = ProfileData.from_file(files[-1])
+        agg: Dict[str, List[float]] = {}
+        for plane in pd.planes:
+            if "TPU" not in plane.name and "GPU" not in plane.name \
+                    and "device" not in plane.name.lower():
+                continue
+            for line in plane.lines:
+                if line.name not in ("XLA Ops", "XLA Modules", "Steps"):
+                    continue
+                for ev in line.events:
+                    if line.name == "XLA Ops":
+                        agg.setdefault(ev.name, []).append(
+                            ev.duration_ns / 1e6)
+        rows = [(k, len(v), sum(v), sum(v) / len(v))
+                for k, v in agg.items()]
+        rows.sort(key=lambda r: -r[2])
+        if print_table and rows:
+            hdr = (f"{'Device op':<52}{'Calls':>8}{'Total(ms)':>12}"
+                   f"{'Avg(ms)':>10}")
+            print(hdr)
+            print("-" * len(hdr))
+            for nm, c, tot, avg in rows[:top]:
+                print(f"{nm[:52]:<52}{c:>8}{tot:>12.3f}{avg:>10.3f}")
+        return {r[0]: {"calls": r[1], "total_ms": r[2], "avg_ms": r[3]}
+                for r in rows}
+
 
 # ---------------------------------------------------------------------------
 # MFU (BASELINE gate #4: >=45% at 8B)

@@ -53,9 +53,16 @@ class Cifar10(Dataset, _SyntheticImageMixin):
     def _load_real(self, path, mode):
         datas, labels = [], []
         with tarfile.open(path, "r:gz") as tf:
-            names = [m for m in tf.getmembers()
-                     if ("data_batch" in m.name if mode == "train"
-                         else "test_batch" in m.name)]
+            # CIFAR-10 members: data_batch_1..5 / test_batch;
+            # CIFAR-100 members: train / test
+            if mode == "train":
+                names = [m for m in tf.getmembers()
+                         if "data_batch" in m.name
+                         or m.name.endswith("/train")]
+            else:
+                names = [m for m in tf.getmembers()
+                         if "test_batch" in m.name
+                         or m.name.endswith("/test")]
             for m in sorted(names, key=lambda m: m.name):
                 batch = pickle.load(tf.extractfile(m), encoding="bytes")
                 datas.append(batch[b"data"].reshape(-1, 3, 32, 32))

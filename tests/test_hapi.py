@@ -106,3 +106,30 @@ def test_summary_resnet():
                          input_size=(1, 3, 32, 32))
     assert res["total_params"] > 1e7 * 1.1  # ~11.2M
     assert res["flops"] > 0
+
+
+def test_visualdl_callback_records_scalars(tmp_path):
+    """VisualDL callback (reference callbacks.py:883) — without the
+    visualdl package the scalars land in scalars.jsonl."""
+    import json
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    model = hapi.Model(net)
+    model.prepare(optimizer.SGD(learning_rate=0.05,
+                                parameters=net.parameters()),
+                  nn.MSELoss())
+    X = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    Y = np.random.RandomState(1).randn(32, 1).astype(np.float32)
+    ds = [(X[i], Y[i]) for i in range(32)]
+    logdir = str(tmp_path / "vdl")
+    model.fit(ds, batch_size=8, epochs=2, verbose=0,
+              callbacks=[VisualDL(log_dir=logdir)])
+    lines = [json.loads(l) for l in
+             open(f"{logdir}/scalars.jsonl").read().splitlines()]
+    assert lines, "no scalars recorded"
+    tags = {l["tag"] for l in lines}
+    assert any(t.startswith("train/loss") for t in tags)
+    assert all({"tag", "step", "value"} <= set(l) for l in lines)

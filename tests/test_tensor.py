@@ -97,3 +97,22 @@ def test_zero_dim():
     assert t.shape == []
     out = t * 3
     assert abs(out.item() - 6.0) < 1e-6
+
+
+def test_no_view_aliasing_documented_divergence():
+    """DOCUMENTED DIVERGENCE from the reference (README "Scope"):
+    XLA arrays are immutable, so slices/as_strided return COPIES and
+    writing through them does NOT mutate the source (the reference's
+    stride kernels give zero-copy views, phi/kernels/stride/
+    view_kernel.cc). This test pins the copy semantics so a future
+    change is deliberate."""
+    x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    y = x[0:2]
+    y.fill_(7.0)
+    # y mutated...
+    np.testing.assert_allclose(y.numpy(), np.full((2, 4), 7.0))
+    # ...but x is untouched (reference would show 7s in rows 0-1)
+    np.testing.assert_allclose(x.numpy(), np.zeros((4, 4)))
+    # in-place setitem on the SOURCE works (rebinds the whole buffer)
+    x[0:2] = 7.0
+    np.testing.assert_allclose(x.numpy()[0:2], np.full((2, 4), 7.0))
