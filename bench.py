@@ -62,7 +62,15 @@ def bench_resnet50(batch=64):
     rng = np.random.RandomState(0)
     X = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
     Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
-    return _timed_steps(lambda: step(X, Y), steps=40) * batch
+    # ~1 ms of device work per step: dispatch-bound through the tunneled
+    # backend, so use the framework's k-steps-per-dispatch path
+    # (TrainStep.run_steps, lax.scan) — numerics identical to k calls
+    k = 32
+
+    def kstep():
+        return step.run_steps(k, X, Y)[-1]
+
+    return _timed_steps(kstep, steps=4) * batch * k
 
 
 def bench_gpt_small(batch=8, seq=512):
@@ -89,7 +97,12 @@ def bench_gpt_small(batch=8, seq=512):
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     Y = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
-    sps = _timed_steps(lambda: step(X, Y), steps=20)
+    k = 8  # ~8 ms steps: still dispatch-taxed on the tunnel
+
+    def kstep():
+        return step.run_steps(k, X, Y)[-1]
+
+    sps = _timed_steps(kstep, steps=4) * k
     from paddle_tpu import profiler
     flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * \
         cfg.hidden_size * seq

@@ -108,19 +108,22 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 
 def in_dynamic_mode() -> bool:
+    from paddle_tpu import static as _static
     from paddle_tpu.jit.trace import in_tracing
-    return not in_tracing()
+    return not in_tracing() and not _static.in_static_mode()
 
 
 def disable_static():
-    pass
+    from paddle_tpu import static as _static
+    _static._disable()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph Program mode; use "
-        "paddle_tpu.jit.to_static (trace-to-XLA) instead"
-    )
+    """Enter Program mode (reference: paddle.enable_static). Registry
+    ops on static Variables are recorded into the default Program and
+    executed by paddle_tpu.static.Executor — see paddle_tpu/static/."""
+    from paddle_tpu import static as _static
+    _static._enable()
 
 
 def is_grad_enabled():
@@ -135,3 +138,4 @@ from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import onnx  # noqa: F401,E402
 from paddle_tpu import quantization  # noqa: F401,E402
+from paddle_tpu import static  # noqa: F401,E402

@@ -140,11 +140,15 @@ class FileStore:
             pass
 
     def list(self, prefix: str = "") -> List[str]:
+        import re
+
         pat = prefix.replace("/", "__")
-        # in-flight writes use ".tmp<pid>" names (see set); they must
-        # never surface as phantom keys to pollers
+        # in-flight writes use ".tmp<pid>" SUFFIX names (see set); they
+        # must never surface as phantom keys to pollers — but a user key
+        # merely containing ".tmp" (e.g. "config.tmpl") is legitimate
         return [f for f in os.listdir(self._dir)
-                if f.startswith(pat) and ".tmp" not in f]
+                if f.startswith(pat)
+                and not re.search(r"\.tmp\d+$", f)]
 
     def add(self, key: str, amount: int = 1) -> int:
         # lock-free: one slot file per add, value = sum of slots

@@ -76,7 +76,8 @@ class StepWatchdog:
             self._seq += 1
             eid = self._seq
             self._entries[eid] = (tag,
-                                  time.monotonic() + self.timeout * factor)
+                                  time.monotonic() + self.timeout * factor,
+                                  None)
             if self._monitor is None:
                 self._monitor = threading.Thread(target=self._watch,
                                                  daemon=True)
@@ -86,12 +87,16 @@ class StepWatchdog:
     def attach(self, eid: int, arrays) -> None:
         """After dispatch: the prober thread blocks until the device
         produces ``arrays`` and then clears the entry (the end record).
-        One long-lived prober drains a queue — steps complete in order,
-        so serialized probing is exact and avoids per-step thread
-        churn."""
+        One long-lived prober drains a queue (no per-step thread churn);
+        because a slow earlier probe (e.g. a cold compile) delays later
+        disarms, the monitor also checks ``is_ready()`` non-blockingly
+        before firing, so queue latency can never cause a false abort."""
         if not eid:
             return
         with self._lock:
+            ent = self._entries.get(eid)
+            if ent is not None:
+                self._entries[eid] = (ent[0], ent[1], arrays)
             if self._prober is None:
                 self._probe_q = queue.SimpleQueue()
                 self._prober = threading.Thread(target=self._probe_loop,
@@ -117,22 +122,40 @@ class StepWatchdog:
         self.attach(self.arm(tag), arrays)
 
     # -- monitor ---------------------------------------------------------
+    @staticmethod
+    def _device_done(arrays) -> bool:
+        """Non-blocking: True iff every dispatched buffer is already on
+        device (disarm merely hasn't drained the probe queue yet)."""
+        if arrays is None:
+            return False
+        try:
+            leaves = jax.tree_util.tree_leaves(arrays)
+            return all(x.is_ready() for x in leaves
+                       if hasattr(x, "is_ready"))
+        except Exception:
+            return False
+
     def _watch(self):
         while True:
             time.sleep(min(0.2, max(0.01, self.timeout / 10)))
             now = time.monotonic()
             with self._lock:
-                expired_ids = [k for k, (_, dl) in self._entries.items()
-                               if dl < now]
+                expired_ids = [k for k, (_, dl, _a) in
+                               self._entries.items() if dl < now]
                 expired = [self._entries.pop(k) for k in expired_ids]
-            if expired:
+            really_expired = []
+            for ent in expired:
+                if self._device_done(ent[2]):
+                    continue  # completed; probe queue is just behind
+                really_expired.append(ent)
+            if really_expired:
                 # default path aborts the process; a custom on_timeout
                 # handler keeps the monitor alive for later steps
-                self._fire(expired)
+                self._fire(really_expired)
 
     def _fire(self, expired):
         self.fired = True
-        tags = ", ".join(tag for tag, _ in expired)
+        tags = ", ".join(ent[0] for ent in expired)
         sys.stderr.write(
             f"\n[watchdog] step(s) [{tags}] exceeded {self.timeout}s "
             f"deadline — device appears hung; dumping host stacks and "

@@ -280,7 +280,11 @@ class VisualDL(Callback):
         self._log_all("eval", logs, self._epoch)
 
     def on_train_end(self, logs=None):
+        # reset to None so the same callback instance can serve a later
+        # fit() (otherwise _ensure_writer would reuse a closed handle)
         if self._writer is not None:
             self._writer.close()
+            self._writer = None
         if self._fallback is not None:
             self._fallback.close()
+            self._fallback = None

@@ -166,13 +166,19 @@ class TrainStep:
                 return loss, (step, chain), new_params, new_slots, \
                     new_buffers, new_scaler_state, valid
 
+            return step_fn
+
+        self._make_raw = make_step_fn  # un-jitted body (run_steps scans it)
+
+        def make_jitted(outcomes):
             # n_inputs is a static jit arg: calling with a different
             # n_model_inputs retraces instead of reusing a stale split
-            return jax.jit(step_fn, static_argnums=(0,),
+            return jax.jit(make_step_fn(outcomes), static_argnums=(0,),
                            donate_argnums=(1, 2, 3, 4))
 
-        self._make_jitted = make_step_fn
-        self._jitted = make_step_fn(None)  # optimistic whole-graph path
+        self._make_jitted = make_jitted
+        self._jitted = make_jitted(None)  # optimistic whole-graph path
+        self._multi_jitted = {}  # (k, stacked) -> scanned executable
         from paddle_tpu.jit.sot import PathCache
 
         self._sot_cache: Optional[PathCache] = None  # built on graph break
@@ -230,6 +236,88 @@ class TrainStep:
 
                 self._sot_cache = PathCache()
         return self._sot_call(n_inputs, datas)
+
+    def run_steps(self, k, *batch, n_model_inputs: Optional[int] = None,
+                  stacked: bool = False):
+        """Run ``k`` optimizer steps in ONE compiled dispatch
+        (``lax.scan`` over the step body) and return the (k,) loss vector.
+
+        With ``stacked=True`` every batch array carries a leading ``k``
+        dim (one microbatch per step); otherwise the same batch is
+        re-used each step (e.g. steady-state benchmarking). Stacking is
+        explicit, not inferred — a batch dim that happens to equal ``k``
+        must not silently change semantics. This is the standard TPU pattern
+        for host-latency-bound steps: a small model's ~1 ms step costs a
+        full host→device round-trip per dispatch (several ms through a
+        tunneled PJRT backend), so k steps per dispatch raises throughput
+        by up to k× with identical numerics. The reference's analog is
+        the static-graph executor running the whole Program without
+        returning to Python each op (SURVEY.md §3.3).
+
+        Semantics: the LR is read once per dispatch (host schedulers see
+        one ``k``-step tick); state/RNG threading is identical to k
+        ``__call__``s. Not available on SOT graph-break paths (falls back
+        to a Python loop)."""
+        n_inputs = 1 if n_model_inputs is None else n_model_inputs
+        datas = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch)
+        if self._sot_cache is not None:
+            losses = [self.__call__(*batch, n_model_inputs=n_model_inputs)
+                      for _ in range(k)]
+            return Tensor._from_data(
+                jnp.stack([l._data for l in losses]))
+        if stacked:
+            bad = [tuple(d.shape) for d in datas
+                   if d.ndim == 0 or d.shape[0] != k]
+            if bad:
+                raise ValueError(
+                    f"run_steps(stacked=True) needs a leading dim of {k} "
+                    f"on every batch array; got shapes {bad}")
+        self._sync_step_carry()
+        lr_val = float(self._opt.get_lr())
+        if self._lr_arr is None or lr_val != self._lr_val:
+            self._lr_val = lr_val
+            self._lr_arr = jax.device_put(np.float32(lr_val))
+
+        jitted = self._multi_jitted.get((k, stacked))
+        if jitted is None:
+            raw = self._make_raw(None)
+
+            def multi_fn(n_inputs, carry, param_datas, slot_list,
+                         buffer_datas, lr, scaler_state, *batch):
+                def body(state, xs):
+                    c, params, slots, bufs, sstate = state
+                    b = xs if xs is not None else batch
+                    loss, c, params, slots, bufs, sstate, valid = raw(
+                        n_inputs, c, params, slots, bufs, lr, sstate, *b)
+                    return (c, params, slots, bufs, sstate), loss
+
+                init = (carry, list(param_datas), list(slot_list),
+                        list(buffer_datas), scaler_state)
+                xs = list(batch) if stacked else None
+                (c, params, slots, bufs, sstate), losses = jax.lax.scan(
+                    body, init, xs, length=None if stacked else k)
+                return losses, c, params, slots, bufs, sstate, \
+                    jnp.asarray(True)
+
+            jitted = jax.jit(multi_fn, static_argnums=(0,),
+                             donate_argnums=(1, 2, 3, 4))
+            self._multi_jitted[(k, stacked)] = jitted
+        try:
+            losses = self._run(jitted, n_inputs, datas)
+        except jax.errors.ConcretizationTypeError:
+            # data-dependent Python control flow: scan can't trace it —
+            # fall back to per-step SOT dispatch (__call__ bumps counters)
+            from paddle_tpu.jit.sot import PathCache
+
+            self._sot_cache = self._sot_cache or PathCache()
+            losses = [self.__call__(*batch, n_model_inputs=n_model_inputs)
+                      for _ in range(k)]
+            return Tensor._from_data(jnp.stack([l._data for l in losses]))
+        # counters advance only after a successful dispatch
+        self._opt._step_count += k
+        self._host_step_mirror = self._opt._step_count
+        return losses
 
     def _run(self, jitted, n_inputs, datas):
         """Dispatch one compiled step and rebind carried state."""

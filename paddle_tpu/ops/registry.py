@@ -134,6 +134,18 @@ def set_profiler_hook(fn):
     _PROFILER_HOOK = fn
 
 
+# Static-graph hook: set by paddle_tpu.static while Program mode is
+# enabled (the reference's tracer appends an OpDesc at this same
+# dispatch point in static mode — base/framework.py). The hook returns
+# NotImplemented for purely-concrete calls, which fall through to eager.
+_STATIC_HOOK = None
+
+
+def set_static_hook(fn):
+    global _STATIC_HOOK
+    _STATIC_HOOK = fn
+
+
 def make_api(opdef: OpDef) -> Callable:
     """Build the eager+autograd wrapper for one op."""
 
@@ -162,6 +174,10 @@ def make_api(opdef: OpDef) -> Callable:
         return _api_impl(*args, **kwargs)
 
     def _api_impl(*args, **kwargs):
+        if _STATIC_HOOK is not None:
+            res = _STATIC_HOOK(opdef, args, kwargs)
+            if res is not NotImplemented:
+                return res
         bound = opdef.sig.bind(*args, **kwargs)
         bound.apply_defaults()
         arguments = bound.arguments
@@ -271,6 +287,9 @@ def rebind_inplace(self, out):
     self._grad_node = out._grad_node
     self._output_index = out._output_index
     self.stop_gradient = out.stop_gradient and self.stop_gradient
+    if hasattr(out, "_sym") and hasattr(type(self), "_sym"):
+        # static-mode Variable: keep the symbolic identity in sync
+        self._sym = out._sym
     return self
 
 

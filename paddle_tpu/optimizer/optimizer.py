@@ -180,6 +180,20 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from paddle_tpu import static as _static
+        if isinstance(loss, _static.Variable):
+            # Program mode: record the training objective; the Executor
+            # compiles grads + this optimizer's pure rule into the step
+            # (the append_backward + optimize-ops role). Parameters are
+            # discovered from the program's trainable captures, like the
+            # reference collects them from the global block.
+            prog = loss._program
+            if self._parameter_list is None:
+                self._parameter_list = [
+                    t for t in prog.captures if not t.stop_gradient]
+            prog._train = (self, loss._sym)
+            prog._bump()
+            return
         loss.backward()
         self.step()
         self.clear_grad()

@@ -308,12 +308,11 @@ class Profiler:
                     and "device" not in plane.name.lower():
                 continue
             for line in plane.lines:
-                if line.name not in ("XLA Ops", "XLA Modules", "Steps"):
+                if line.name != "XLA Ops":
                     continue
                 for ev in line.events:
-                    if line.name == "XLA Ops":
-                        agg.setdefault(ev.name, []).append(
-                            ev.duration_ns / 1e6)
+                    agg.setdefault(ev.name, []).append(
+                        ev.duration_ns / 1e6)
         rows = [(k, len(v), sum(v), sum(v) / len(v))
                 for k, v in agg.items()]
         rows.sort(key=lambda r: -r[2])

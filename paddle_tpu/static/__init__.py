@@ -1,0 +1,877 @@
+"""paddle.static — Program-mode (static graph) user API.
+
+Reference surface: python/paddle/static/ (25.2K LoC: Program-based
+graph build in python/paddle/base/framework.py, Executor in
+python/paddle/base/executor.py:1179, append_backward in
+python/paddle/base/backward.py). The reference builds a ProgramDesc op
+by op, translates it to PIR, appends gradient ops, then schedules it on
+the PirInterpreter (SURVEY.md §3.3).
+
+TPU-native redesign — the Program IS a deferred pure function:
+
+* In static mode every registry op called on symbolic ``Variable``s is
+  *recorded* into the current ``Program`` instead of executed (the seam
+  is ``ops.registry.set_static_hook`` — the same dispatch point where
+  the reference's tracer appends an OpDesc). Shape/dtype inference is
+  ``jax.eval_shape`` over the op's emitter — the InferMeta role with
+  zero per-op code.
+* Concrete eager Tensors touched by the graph (layer parameters,
+  buffers) become *captures*: run-time inputs of the program, so
+  optimizer updates between runs are visible without rebuilding.
+* ``Executor.run`` interprets the recorded node list into one pure JAX
+  function of (feeds, captures), jit-compiles it, and caches the
+  executable keyed by (program version, feed signature, fetch list) —
+  the PirInterpreter + instruction-cache role collapsed into an XLA
+  executable cache. ``Optimizer.minimize(loss)`` records a training
+  objective; the compiled function then also computes grads
+  (``jax.grad`` over the interpreted loss — the append_backward role)
+  and applies the optimizer's pure update rule, donating capture
+  buffers for in-place HBM updates.
+
+Stateful layers (BatchNorm) assign symbolic values into their eager
+buffer slots during build; the program tracks that leakage by SDS
+identity, records it as a side-update (committed after each train run,
+like the reference threading persistable vars through the scope), and
+restores the concrete values so eager state is never corrupted.
+
+Known divergences (documented, tested): re-running the startup program
+does not re-initialize parameters (they are initialized at layer
+construction); randomness (dropout) is driven by a fresh per-run key
+threaded through the generator, not by a program-recorded seed op.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.dtype import to_jax
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from paddle_tpu.ops import registry
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Variable", "Executor",
+    "CompiledProgram", "ExecutionStrategy", "BuildStrategy", "gradients",
+    "append_backward", "name_scope", "global_scope", "scope_guard",
+    "InputSpec", "save_inference_model", "load_inference_model", "nn",
+]
+
+
+# ---------------------------------------------------------------------------
+# symbolic values
+# ---------------------------------------------------------------------------
+
+class Variable(Tensor):
+    """Symbolic tensor living in a Program (reference: base/framework.py
+    Variable). ``_data`` holds a jax.ShapeDtypeStruct so .shape/.dtype/
+    .ndim and all registry dispatch work unchanged; the value exists only
+    when the Executor runs the program."""
+
+    __slots__ = ("_sym", "_program")
+
+    @classmethod
+    def _make(cls, program, sym, aval, name=None, stop_gradient=True):
+        v = cls._from_data(aval, stop_gradient=stop_gradient, name=name)
+        v._sym = sym
+        v._program = program
+        program._register_sds(aval, sym)
+        return v
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value at graph-build time; "
+            "fetch it through Executor.run(fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={tuple(self.shape)}, "
+                f"dtype={self._data.dtype})")
+
+
+# sym encodings: ("feed", name) | ("op", node_id, out_idx) |
+#                ("cap", cap_idx) | ("grad", target_sym, wrt_sym)
+_FEED, _OP, _CAP, _GRAD = "feed", "op", "cap", "grad"
+
+
+class _Node:
+    __slots__ = ("id", "opdef", "slots", "consts", "multi", "n_out")
+
+    def __init__(self, nid, opdef, slots, consts, multi, n_out):
+        self.id = nid
+        self.opdef = opdef
+        self.slots = slots      # [(argname, list_idx|None, sym|("lit",v))]
+        self.consts = consts    # dict argname -> literal
+        self.multi = multi
+        self.n_out = n_out
+
+
+class Program:
+    """Recorded op list + captured eager state (reference:
+    pir::Program, paddle/pir/include/core/program.h:40)."""
+
+    _id = 0
+
+    def __init__(self):
+        Program._id += 1
+        self.id = Program._id
+        self.nodes: List[_Node] = []
+        self.feeds: Dict[str, Variable] = {}
+        self.captures: List[Tensor] = []       # concrete tensors, by index
+        self._cap_index: Dict[int, int] = {}   # id(Tensor) -> cap idx
+        self._cap_snapshot: List[Any] = []     # concrete value at capture
+        self._sds_syms: Dict[int, tuple] = {}  # id(SDS) -> sym
+        self._sds_keep: List[Any] = []         # keep SDS objects alive
+        self.side_updates: List[Tuple[int, tuple]] = []  # (cap_idx, sym)
+        self._train: Optional[tuple] = None    # (optimizer, loss_sym)
+        self._version = 0
+        self._cache: Dict[tuple, Any] = {}
+        self.random_seed = None
+
+    # -- build-time plumbing ----------------------------------------------
+    def _register_sds(self, sds, sym):
+        self._sds_syms[id(sds)] = sym
+        self._sds_keep.append(sds)
+
+    def _sym_of(self, t: Tensor):
+        """sym for any tensor-ish: Variable, or a concrete Tensor (capture),
+        or a plain Tensor whose _data was overwritten with a symbolic SDS
+        (BatchNorm-style buffer leakage)."""
+        if isinstance(t, Variable):
+            return t._sym
+        d = t._data
+        leaked = self._sds_syms.get(id(d))
+        if leaked is not None:
+            return leaked
+        idx = self._cap_index.get(id(t))
+        if idx is None:
+            idx = len(self.captures)
+            self._cap_index[id(t)] = idx
+            self.captures.append(t)
+            self._cap_snapshot.append(d)
+        return (_CAP, idx)
+
+    def _bump(self):
+        self._version += 1
+        self._cache.clear()
+
+    def finalize_build(self):
+        """Collect BatchNorm-style side updates (captures whose _data now
+        holds a symbolic SDS) and restore their concrete snapshots so the
+        eager world stays intact."""
+        for tid, idx in list(self._cap_index.items()):
+            t = self.captures[idx]
+            sym = self._sds_syms.get(id(t._data))
+            if sym is not None:
+                if (idx, sym) not in self.side_updates:
+                    self.side_updates.append((idx, sym))
+                    self._bump()
+                t._data = self._cap_snapshot[idx]
+
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self.nodes
+
+    def all_parameters(self):
+        return [t for t in self.captures
+                if not t.stop_gradient and t.persistable]
+
+    def clone(self, for_test=False):
+        """for_test=True: same graph minus the training objective and
+        side updates (the reference prunes backward + optimize ops)."""
+        import copy
+        p = copy.copy(self)
+        if for_test:
+            p = Program()
+            p.nodes = self.nodes
+            p.feeds = self.feeds
+            p.captures = self.captures
+            p._cap_index = self._cap_index
+            p._cap_snapshot = self._cap_snapshot
+            p._sds_syms = self._sds_syms
+            p._sds_keep = self._sds_keep
+            p.side_updates = []
+            p._train = None
+        return p
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[Tuple[Program, Program]] = []
+
+
+def default_main_program() -> Program:
+    return _prog_stack[-1][0] if _prog_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _prog_stack[-1][1] if _prog_stack else _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _prog_stack.append((main_program,
+                        startup_program or default_startup_program()))
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+        main_program.finalize_build()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+# ---------------------------------------------------------------------------
+# static mode + the registry hook
+# ---------------------------------------------------------------------------
+
+_static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def _enable():
+    global _static_mode
+    _static_mode = True
+    registry.set_static_hook(_record_hook)
+
+
+def _disable():
+    global _static_mode
+    _static_mode = False
+    registry.set_static_hook(None)
+
+
+def _is_symbolic(v, prog) -> bool:
+    if isinstance(v, Variable):
+        return True
+    return isinstance(v, Tensor) and id(v._data) in prog._sds_syms
+
+
+def _record_hook(opdef, args, kwargs):
+    """Registry dispatch seam: record the op if any input is symbolic
+    (the reference appends an OpDesc at the same point via its tracer)."""
+    prog = default_main_program()
+
+    def any_sym(vals):
+        for v in vals:
+            if _is_symbolic(v, prog):
+                return True
+            if isinstance(v, (list, tuple)) and any(
+                    _is_symbolic(x, prog) for x in v):
+                return True
+        return False
+
+    if not any_sym(args) and not any_sym(kwargs.values()):
+        return NotImplemented
+
+    bound = opdef.sig.bind(*args, **kwargs)
+    bound.apply_defaults()
+    arguments = bound.arguments
+    tset = set(opdef.tensor_args)
+
+    slots, consts, avals = [], {}, {}
+    for an, v in arguments.items():
+        if an in tset:
+            if an in opdef.list_args:
+                items = list(v) if v is not None else []
+                for i, item in enumerate(items):
+                    if isinstance(item, Tensor):
+                        sym = prog._sym_of(item)
+                        slots.append((an, i, sym))
+                        avals[(an, i)] = _aval_of(item, prog, sym)
+                    else:
+                        slots.append((an, i, ("lit", item)))
+                        avals[(an, i)] = item
+                consts[an] = ["__slot__"] * len(items)
+            else:
+                if isinstance(v, Tensor):
+                    sym = prog._sym_of(v)
+                    slots.append((an, None, sym))
+                    avals[(an, None)] = _aval_of(v, prog, sym)
+                    consts[an] = "__slot__"
+                else:
+                    consts[an] = v
+        else:
+            if isinstance(v, Variable):
+                raise TypeError(
+                    f"op {opdef.name!r}: attribute {an!r} cannot be a "
+                    "static Variable in the TPU build (attributes are "
+                    "compile-time constants under XLA)")
+            consts[an] = v._data if isinstance(v, Tensor) else v
+
+    def eval_fn(**tensor_avals):
+        # copy list args BEFORE writing tracers into slots — the consts
+        # dict is shared with the recorded node
+        call = {k: (list(v) if isinstance(v, list) else v)
+                for k, v in consts.items()}
+        for (an, i), _ in avals.items():
+            if i is None:
+                call[an] = tensor_avals[f"{an}"]
+            else:
+                call[an][i] = tensor_avals[f"{an}__{i}"]
+        return opdef.emitter(**call)
+
+    kw = {}
+    for (an, i), a in avals.items():
+        kw[f"{an}" if i is None else f"{an}__{i}"] = a
+    stream_guard = _build_key_guard()
+    with stream_guard:
+        out_aval = jax.eval_shape(eval_fn, **kw)
+
+    multi = isinstance(out_aval, (tuple, list))
+    outs_av = list(out_aval) if multi else [out_aval]
+    node = _Node(len(prog.nodes), opdef, slots, consts, multi, len(outs_av))
+    prog.nodes.append(node)
+    prog._bump()
+
+    out_vars = [Variable._make(prog, (_OP, node.id, i), av,
+                               stop_gradient=False)
+                for i, av in enumerate(outs_av)]
+    return tuple(out_vars) if multi else out_vars[0]
+
+
+def _aval_of(t, prog, sym):
+    if isinstance(t, Variable):
+        return t._data
+    leaked = prog._sds_syms.get(id(t._data))
+    if leaked is not None:
+        return t._data  # already an SDS
+    d = t._data
+    return jax.ShapeDtypeStruct(d.shape, d.dtype)
+
+
+@contextlib.contextmanager
+def _build_key_guard():
+    """During build/eval_shape, generator key draws must not mutate (or
+    depend on) global eager RNG state; at run the Executor threads a real
+    per-run key through the same seam (jit/trace.py pattern)."""
+    prev = gen.Generator.next_key
+    key = jax.random.key(0)
+
+    def fake_next(self):
+        return key
+
+    gen.Generator.next_key = fake_next
+    try:
+        yield
+    finally:
+        gen.Generator.next_key = prev
+
+
+# ---------------------------------------------------------------------------
+# graph-build user API
+# ---------------------------------------------------------------------------
+
+def data(name, shape, dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed slot (reference: paddle.static.data). ``-1``/None
+    dims mean run-time-determined; the Executor re-specializes per feed
+    shape signature (XLA static shapes)."""
+    prog = default_main_program()
+    jdt = to_jax(dtype)
+    aval_shape = tuple(1 if (d is None or d < 0) else int(d) for d in shape)
+    aval = jax.ShapeDtypeStruct(aval_shape, jdt)
+    v = Variable._make(prog, (_FEED, name), aval, name=name)
+    v.desc_shape = tuple(-1 if (d is None or d < 0) else int(d)
+                         for d in shape)
+    prog.feeds[name] = v
+    prog._bump()
+    return v
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """Symbolic grads of sum(targets) wrt inputs (reference:
+    paddle.static.gradients / append_backward). Returns Variables
+    fetchable through Executor.run."""
+    prog = default_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    t_syms = [prog._sym_of(t) for t in targets]
+    outs = []
+    for x in inputs:
+        x_sym = prog._sym_of(x)
+        aval = x._data if isinstance(x._data, jax.ShapeDtypeStruct) else \
+            jax.ShapeDtypeStruct(x._data.shape, x._data.dtype)
+        g = Variable._make(prog, (_GRAD, tuple(t_syms), x_sym), aval)
+        outs.append(g)
+    prog._bump()
+    return outs
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Reference: base/backward.py append_backward — returns
+    (param, grad_var) pairs. Grads are computed by the Executor via
+    jax.grad over the interpreted program."""
+    prog = default_main_program()
+    params = parameter_list or [t for t in prog.captures
+                                if not t.stop_gradient]
+    gvars = gradients([loss], params)
+    return list(zip(params, gvars))
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+def _resolve(sym, env, feed_env, cap_vals):
+    kind = sym[0]
+    if kind == _OP:
+        return env[sym[1]][sym[2]]
+    if kind == _FEED:
+        return feed_env[sym[1]]
+    if kind == _CAP:
+        return cap_vals[sym[1]]
+    raise KeyError(sym)
+
+
+def _needed_nodes(prog, syms):
+    needed = set()
+    stack = [s for s in syms if s[0] == _OP]
+    while stack:
+        s = stack.pop()
+        nid = s[1]
+        if nid in needed:
+            continue
+        needed.add(nid)
+        for (_, _, ref) in prog.nodes[nid].slots:
+            if isinstance(ref, tuple) and ref and ref[0] == _OP:
+                stack.append(ref)
+    return needed
+
+
+def _interpret(prog, targets, feed_env, cap_vals):
+    """Evaluate the recorded node list (the PirInterpreter role —
+    new_executor/pir_interpreter.cc:1344 — but emitting one traced JAX
+    computation that XLA schedules)."""
+    flat_targets = []
+    for s in targets:
+        if s[0] == _GRAD:
+            flat_targets.extend([x for x in s[1]] + [s[2]])
+        else:
+            flat_targets.append(s)
+    needed = _needed_nodes(prog, flat_targets)
+    env = {}
+    for node in prog.nodes:
+        if node.id not in needed:
+            continue
+        call = {}
+        for k, v in node.consts.items():
+            call[k] = list(v) if isinstance(v, list) else v
+        for (an, i, ref) in node.slots:
+            val = ref[1] if ref[0] == "lit" else \
+                _resolve(ref, env, feed_env, cap_vals)
+            if i is None:
+                call[an] = val
+            else:
+                call[an][i] = val
+        out = node.opdef.emitter(**call)
+        env[node.id] = tuple(out) if node.multi else (out,)
+
+    def value_of(sym):
+        if sym[0] == _GRAD:
+            raise RuntimeError("grad syms resolved by caller")
+        return _resolve(sym, env, feed_env, cap_vals)
+
+    return value_of
+
+
+class ExecutionStrategy:
+    pass
+
+
+class BuildStrategy:
+    pass
+
+
+class CompiledProgram:
+    """Reference CompiledProgram — here every program the Executor runs
+    is XLA-compiled, so this is an identity wrapper kept for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """Compile-and-run a Program (reference: base/executor.py:1179
+    Executor.run → StandaloneExecutor::Run; here: one jitted pure
+    function per (program version, feed signature, fetch list))."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def close(self):
+        pass
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, scope=None):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        prog = program or default_main_program()
+        if prog is default_startup_program() or (
+                not prog.nodes and prog._train is None):
+            # startup: parameters were initialized at construction
+            return []
+        prog.finalize_build()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_syms = tuple(
+            prog._sym_of(v) if isinstance(v, Tensor)
+            else prog.feeds[v]._sym if isinstance(v, str) else v
+            for v in fetch_list)
+
+        feed_names = tuple(sorted(feed))
+        feed_vals = []
+        for n in feed_names:
+            a = feed[n]
+            feed_vals.append(a._data if isinstance(a, Tensor)
+                             else jnp.asarray(a))
+        feed_sig = tuple((n, v.shape, str(v.dtype))
+                         for n, v in zip(feed_names, feed_vals))
+
+        train = prog._train
+        key = (prog._version, feed_sig, fetch_syms, train is not None)
+        compiled = prog._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(prog, feed_names, fetch_syms, train)
+            prog._cache[key] = compiled
+
+        cap_vals = [t._data for t in prog.captures]
+        if train is not None:
+            opt, _ = train
+            slot_vals = [opt._slots[id(p)] for p in compiled.train_params]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step = jnp.asarray(opt._step_count + 1, jnp.float32)
+            rng = gen.default_generator.next_key()
+            fetches, new_caps, new_slots = compiled.fn(
+                list(feed_vals), cap_vals, slot_vals, lr, step, rng)
+            for p, ns in zip(compiled.train_params, new_slots):
+                opt._slots[id(p)] = ns
+            opt._step_count += 1
+        else:
+            rng = gen.default_generator.next_key()
+            fetches, new_caps = compiled.fn(list(feed_vals), cap_vals, rng)
+        # commit side updates (BN running stats) + trained params
+        for idx, t in enumerate(prog.captures):
+            if new_caps[idx] is not None:
+                t._data = new_caps[idx]
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor._from_data(f) for f in fetches]
+
+    # -- compilation -------------------------------------------------------
+    def _compile(self, prog, feed_names, fetch_syms, train):
+        side = list(prog.side_updates)
+        n_caps = len(prog.captures)
+
+        if train is not None:
+            opt, loss_sym = train
+            plist = opt._parameter_list or []
+            train_idx = [prog._cap_index[id(p)] for p in plist
+                         if id(p) in prog._cap_index
+                         and not p.stop_gradient]
+            train_params = [prog.captures[i] for i in train_idx]
+            for p in train_params:
+                if id(p) not in opt._slots:
+                    opt._slots[id(p)] = opt._init_slots_mp(p._data)
+        else:
+            train_idx, train_params = [], []
+
+        if train is not None and any(s[0] == _GRAD for s in fetch_syms):
+            raise NotImplementedError(
+                "fetching static.gradients() outputs from a program with "
+                "a minimize() objective is not supported; fetch them from "
+                "a clone(for_test=True) program instead")
+
+        def run_targets(feed_vals, cap_vals, rng):
+            feed_env = dict(zip(feed_names, feed_vals))
+            stream = _KeyStream(rng)
+            prev = gen.Generator.next_key
+            gen.Generator.next_key = lambda self: stream.next()
+            try:
+                value_of = _interpret(
+                    prog, list(fetch_syms) + [s for _, s in side] +
+                    ([train[1]] if train else []),
+                    feed_env, cap_vals)
+                plain = {s: value_of(s) for s in fetch_syms
+                         if s[0] != _GRAD}
+                side_vals = [value_of(s) for _, s in side]
+                loss_val = value_of(train[1]) if train else None
+                return plain, side_vals, loss_val
+            finally:
+                gen.Generator.next_key = prev
+
+        if train is not None:
+            opt, loss_sym = train
+
+            def fn(feed_vals, cap_vals, slot_vals, lr, step, rng):
+                def loss_of(train_vals):
+                    cv = list(cap_vals)
+                    for i, v in zip(train_idx, train_vals):
+                        cv[i] = v
+                    plain, side_vals, loss_val = run_targets(
+                        feed_vals, cv, rng)
+                    return loss_val, (plain, side_vals)
+
+                (loss_val, (plain, side_vals)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(
+                        [cap_vals[i] for i in train_idx])
+                clip = opt._grad_clip
+                clip_fn = getattr(clip, "clip_fn", None)
+                if clip_fn is not None:
+                    grads = clip_fn(grads)
+                elif clip is not None:
+                    raise NotImplementedError(
+                        "static-mode minimize supports grad clips with a "
+                        "pure clip_fn (ClipGradByGlobalNorm)")
+                new_caps = [None] * n_caps
+                new_slots = []
+                for i, p, g, s in zip(train_idx, train_params, grads,
+                                      slot_vals):
+                    g = g.astype(p._data.dtype) \
+                        if g.dtype != p._data.dtype else g
+                    opt._current_decay_enabled = opt._decay_enabled(p)
+                    np_, ns = opt._rule_mp(cap_vals[i], g, s, lr, step)
+                    opt._current_decay_enabled = True
+                    new_caps[i] = np_
+                    new_slots.append(ns)
+                for (ci, _), v in zip(side, side_vals):
+                    new_caps[ci] = v
+                return [plain[s] for s in fetch_syms], new_caps, new_slots
+
+            jitted = jax.jit(fn, donate_argnums=(1, 2))
+        else:
+            def fn(feed_vals, cap_vals, rng):
+                plain, side_vals, _ = run_targets(feed_vals, cap_vals, rng)
+                out = []
+                for s in fetch_syms:
+                    if s[0] == _GRAD:
+                        tsyms, wrt = s[1], s[2]
+
+                        def loss_fn(wv, _wrt=wrt, _ts=tsyms):
+                            if _wrt[0] == _CAP:
+                                cv = list(cap_vals)
+                                cv[_wrt[1]] = wv
+                                fv = feed_vals
+                            else:
+                                fv = list(feed_vals)
+                                fv[feed_names.index(_wrt[1])] = wv
+                                cv = cap_vals
+                            val = _interpret(prog, list(_ts),
+                                             dict(zip(feed_names, fv)), cv)
+                            return sum(jnp.sum(val(t)) for t in _ts)
+
+                        wv0 = cap_vals[wrt[1]] if wrt[0] == _CAP else \
+                            feed_vals[feed_names.index(wrt[1])]
+                        out.append(jax.grad(loss_fn)(wv0))
+                    else:
+                        out.append(plain[s])
+                new_caps = [None] * n_caps
+                for (ci, _), v in zip(side, side_vals):
+                    new_caps[ci] = v
+                return out, new_caps
+
+            jitted = jax.jit(fn)
+
+        class _Compiled:
+            pass
+
+        c = _Compiled()
+        c.fn = jitted
+        c.train_params = train_params
+        return c
+
+
+class _KeyStream:
+    def __init__(self, root):
+        self._key = root
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+# ---------------------------------------------------------------------------
+# scopes (reference: base/executor.py global_scope — minimal parity)
+# ---------------------------------------------------------------------------
+
+class _VarWrapper:
+    def __init__(self, t):
+        self._t = t
+
+    def get_tensor(self):
+        return np.asarray(self._t._data)
+
+    def set(self, value, place=None):
+        self._t._data = jnp.asarray(value, self._t._data.dtype)
+
+
+class Scope:
+    def __init__(self, program=None):
+        self._program = program
+
+    def find_var(self, name):
+        prog = self._program or default_main_program()
+        for t in prog.captures:
+            if t.name == name:
+                return _VarWrapper(t)
+        return None
+
+    var = find_var
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+# ---------------------------------------------------------------------------
+# inference save/load (reference: static save_inference_model →
+# inference/api/analysis_predictor; here AOT StableHLO like jit.save)
+# ---------------------------------------------------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    import pickle
+
+    from jax import export as jax_export
+
+    prog = program or default_main_program()
+    prog.finalize_build()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+    fetch_syms = [v._sym for v in fetch_vars]
+    cap_vals = [t._data for t in prog.captures]
+    key = jax.random.key(0)
+
+    def fwd(cap_vals, *feeds):
+        value_of = _interpret(prog, fetch_syms,
+                              dict(zip(feed_names, feeds)), cap_vals)
+        return tuple(value_of(s) for s in fetch_syms)
+
+    example = [jnp.zeros(v._data.shape, v._data.dtype) for v in feed_vars]
+    exported = jax_export.export(jax.jit(fwd))(cap_vals, *example)
+    payload = {
+        "exported": exported.serialize(),
+        "params": [np.asarray(v) for v in cap_vals],
+        "feed_names": feed_names,
+        "fetch_count": len(fetch_syms),
+    }
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    return path_prefix + ".pdmodel"
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns (program_like, feed_names, fetch_holder) where
+    program_like.run-through-Executor is replaced by a compiled callable:
+    ``exe.run(program_like, feed=..., fetch_list=fetch_holder)``."""
+    import pickle
+
+    from jax import export as jax_export
+
+    p = path_prefix if path_prefix.endswith(".pdmodel") \
+        else path_prefix + ".pdmodel"
+    with open(p, "rb") as f:
+        payload = pickle.load(f)
+    fn = jax_export.deserialize(payload["exported"]).call
+    params = [jnp.asarray(x) for x in payload["params"]]
+    feed_names = payload["feed_names"]
+
+    class _LoadedProgram:
+        def run(self, feed):
+            feeds = [jnp.asarray(feed[n]) for n in feed_names]
+            return [np.asarray(o) for o in fn(params, *feeds)]
+
+    lp = _LoadedProgram()
+    # Executor.run duck-type: allow exe.run(lp, feed=...) too
+    return lp, feed_names, list(range(payload["fetch_count"]))
+
+
+# ---------------------------------------------------------------------------
+# static.nn — layer-building helpers (reference: python/paddle/static/nn/)
+# ---------------------------------------------------------------------------
+
+class _StaticNN:
+    """fc/conv2d/batch_norm/embedding build an eager Layer (params
+    initialized immediately — the startup-program role) and record its
+    forward into the current Program."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+           weight_attr=None, bias_attr=None):
+        from paddle_tpu import nn
+
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = nn.Linear(in_features, size)
+        h = x
+        if len(x.shape) > num_flatten_dims + 1:
+            import paddle_tpu as paddle
+            h = paddle.reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
+        out = layer(h)
+        if activation:
+            from paddle_tpu.nn import functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def conv2d(x, num_filters, filter_size, stride=1, padding=0,
+               activation=None, **kw):
+        from paddle_tpu import nn
+
+        layer = nn.Conv2D(int(x.shape[1]), num_filters, filter_size,
+                          stride=stride, padding=padding)
+        out = layer(x)
+        if activation:
+            from paddle_tpu.nn import functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, act=None, is_test=False, momentum=0.9, **kw):
+        from paddle_tpu import nn
+
+        layer = nn.BatchNorm2D(int(x.shape[1]), momentum=momentum)
+        if is_test:
+            layer.eval()
+        out = layer(x)
+        if act:
+            from paddle_tpu.nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def embedding(x, size, **kw):
+        from paddle_tpu import nn
+
+        layer = nn.Embedding(size[0], size[1])
+        return layer(x)
+
+
+nn = _StaticNN()

@@ -1,0 +1,240 @@
+"""paddle.static Program mode (reference: python/paddle/static/,
+base/framework.py Program build, base/executor.py:1179 Executor.run,
+base/backward.py append_backward).
+
+The TPU build records registry ops into a Program via the dispatch-seam
+hook and compiles Executor.run into one XLA executable (see
+paddle_tpu/static/__init__.py). These tests pin: graph build + run,
+training via minimize (grads by jax.grad over the interpreted program),
+BatchNorm side updates, static.gradients, per-run dropout randomness,
+whole-Layer capture, test-mode clones, and the inference save/load
+roundtrip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_build_and_run_basic(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = x * 2.0 + 1.0
+        z = paddle.sum(y)
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.ones((4, 3), "float32")},
+                  fetch_list=[y, z])
+    np.testing.assert_allclose(out[0], np.full((4, 3), 3.0))
+    assert float(out[1]) == pytest.approx(36.0)
+
+
+def test_variable_introspection_and_no_value(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 7], "float32")
+        y = paddle.matmul(x, paddle.transpose(x, [1, 0]))
+        assert isinstance(y, static.Variable)
+        assert tuple(y.shape) == (1, 1)  # -1 dims build as 1
+        with pytest.raises(RuntimeError):
+            y.numpy()
+
+
+def test_feed_shape_respecialization(static_mode):
+    """-1 dims: the Executor re-specializes per concrete feed shape."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        s = paddle.sum(x, axis=1)
+    exe = static.Executor()
+    o4 = exe.run(main, feed={"x": np.ones((4, 2), "float32")},
+                 fetch_list=[s])
+    o9 = exe.run(main, feed={"x": np.ones((9, 2), "float32")},
+                 fetch_list=[s])
+    assert o4[0].shape == (4,) and o9[0].shape == (9,)
+
+
+def test_minimize_trains(static_mode):
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 4)).astype("float32")
+    ys = (xs @ np.array([[0.5], [-1.0], [0.25], [2.0]], "float32"))
+    first = last = None
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys.astype("float32")},
+                        fetch_list=[loss])
+        first = float(lv) if first is None else first
+        last = float(lv)
+    assert last < first * 0.1
+
+
+def test_whole_layer_capture(static_mode):
+    """An eager-defined Layer records through static mode unchanged —
+    the same registry seam serves both modes."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [5, 6], "float32")
+        out = net(x)
+        assert isinstance(out, static.Variable)
+    exe = static.Executor()
+    (o,) = exe.run(main, feed={"x": np.ones((5, 6), "float32")},
+                   fetch_list=[out])
+    # parity with eager on the same weights
+    paddle.disable_static()
+    eager = net(paddle.ones([5, 6])).numpy()
+    np.testing.assert_allclose(o, eager, rtol=1e-5)
+
+
+def test_batchnorm_side_updates_commit(static_mode):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 3, 4, 4], "float32")
+        y = static.nn.batch_norm(x)
+        m = paddle.mean(y)
+    assert len(main.side_updates) == 2  # running mean + variance
+    exe = static.Executor()
+    xs = np.random.default_rng(1).normal(
+        loc=2.0, size=(8, 3, 4, 4)).astype("float32")
+    stats_before = [np.asarray(main.captures[i]._data).copy()
+                    for i, _ in main.side_updates]
+    exe.run(main, feed={"x": xs}, fetch_list=[m])
+    stats_after = [np.asarray(main.captures[i]._data)
+                   for i, _ in main.side_updates]
+    moved = any(np.abs(a - b).sum() > 1e-6
+                for a, b in zip(stats_after, stats_before))
+    assert moved, "BN running stats were not committed"
+    # eager buffers hold concrete values (no symbolic leakage)
+    for i, _ in main.side_updates:
+        assert not hasattr(main.captures[i]._data, "sharding") or True
+        np.asarray(main.captures[i]._data)  # must not raise
+
+
+def test_static_gradients(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3, 3], "float32")
+        y = paddle.sum(x * x)
+        (gx,) = static.gradients([y], [x])
+    exe = static.Executor()
+    xs = np.arange(9, dtype="float32").reshape(3, 3)
+    out = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(out[0], 2 * xs)
+
+
+def test_append_backward_param_grads(static_mode):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 3], "float32")
+        lin = nn.Linear(3, 2)
+        loss = paddle.sum(lin(x))
+        pairs = static.append_backward(loss)
+    assert len(pairs) == 2  # weight + bias
+    exe = static.Executor()
+    outs = exe.run(main, feed={"x": np.ones((4, 3), "float32")},
+                   fetch_list=[g for _, g in pairs])
+    np.testing.assert_allclose(outs[1], np.full((2,), 4.0))  # bias grad
+
+
+def test_dropout_varies_per_run(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        a = static.data("a", [4, 64], "float32")
+        d = nn.functional.dropout(a, p=0.5, training=True)
+        s = paddle.sum(d)
+    exe = static.Executor()
+    feed = {"a": np.ones((4, 64), "float32")}
+    vals = {float(exe.run(main, feed=feed, fetch_list=[s])[0])
+            for _ in range(3)}
+    assert len(vals) > 1, "dropout mask must differ per run"
+
+
+def test_clone_for_test_drops_training(static_mode):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 2, 4, 4], "float32")
+        y = static.nn.batch_norm(x)
+        loss = paddle.mean(y)
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog._train is None and not test_prog.side_updates
+    exe = static.Executor()
+    stats = [np.asarray(main.captures[i]._data).copy()
+             for i, _ in main.side_updates]
+    exe.run(test_prog, feed={"x": np.ones((4, 2, 4, 4), "float32")},
+            fetch_list=[loss])
+    for (i, _), before in zip(main.side_updates, stats):
+        np.testing.assert_allclose(np.asarray(main.captures[i]._data),
+                                   before)  # eval run: stats frozen
+
+
+def test_executor_cache_reuse(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+    exe = static.Executor()
+    feed = {"x": np.zeros((2, 2), "float32")}
+    exe.run(main, feed=feed, fetch_list=[y])
+    n = len(main._cache)
+    exe.run(main, feed=feed, fetch_list=[y])
+    assert len(main._cache) == n, "same signature must reuse the executable"
+
+
+def test_enable_disable_static_mode():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    # eager still works after a static session
+    t = paddle.ones([2, 2]) * 3
+    assert float(paddle.sum(t)) == 12.0
+
+
+def test_attribute_variable_rejected(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        with pytest.raises(TypeError):
+            paddle.reshape(x, x)  # shape attr can't be a Variable
+
+
+def test_save_load_inference_model(static_mode, tmp_path):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 6], "float32")
+        net = nn.Linear(6, 3)
+        out = net(x)
+    exe = static.Executor()
+    xs = np.random.default_rng(0).normal(size=(4, 6)).astype("float32")
+    (ref,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    path = str(tmp_path / "inf" / "model")
+    static.save_inference_model(path, [x], [out], exe, program=main)
+    loaded, feed_names, _ = static.load_inference_model(path, exe)
+    got = loaded.run({"x": xs})
+    np.testing.assert_allclose(got[0], ref, rtol=1e-5)
