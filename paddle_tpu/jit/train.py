@@ -261,11 +261,6 @@ class TrainStep:
         n_inputs = 1 if n_model_inputs is None else n_model_inputs
         datas = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch)
-        if self._sot_cache is not None:
-            losses = [self.__call__(*batch, n_model_inputs=n_model_inputs)
-                      for _ in range(k)]
-            return Tensor._from_data(
-                jnp.stack([l._data for l in losses]))
         if stacked:
             bad = [tuple(d.shape) for d in datas
                    if d.ndim == 0 or d.shape[0] != k]
@@ -273,6 +268,20 @@ class TrainStep:
                 raise ValueError(
                     f"run_steps(stacked=True) needs a leading dim of {k} "
                     f"on every batch array; got shapes {bad}")
+
+        def loop_fallback():
+            # per-step dispatch keeps the documented k-__call__ numerics;
+            # stacked batches are sliced per step
+            losses = []
+            for i in range(k):
+                b_i = [d[i] for d in datas] if stacked else list(datas)
+                losses.append(self.__call__(
+                    *b_i, n_model_inputs=n_model_inputs))
+            return Tensor._from_data(
+                jnp.stack([l._data for l in losses]))
+
+        if self._sot_cache is not None:
+            return loop_fallback()
         self._sync_step_carry()
         lr_val = float(self._opt.get_lr())
         if self._lr_arr is None or lr_val != self._lr_val:
@@ -311,9 +320,7 @@ class TrainStep:
             from paddle_tpu.jit.sot import PathCache
 
             self._sot_cache = self._sot_cache or PathCache()
-            losses = [self.__call__(*batch, n_model_inputs=n_model_inputs)
-                      for _ in range(k)]
-            return Tensor._from_data(jnp.stack([l._data for l in losses]))
+            return loop_fallback()
         # counters advance only after a successful dispatch
         self._opt._step_count += k
         self._host_step_mirror = self._opt._step_count

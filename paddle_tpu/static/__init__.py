@@ -134,6 +134,7 @@ class Program:
         self._version = 0
         self._cache: Dict[tuple, Any] = {}
         self.random_seed = None
+        self._family = self  # shared identity across clone() programs
 
     # -- build-time plumbing ----------------------------------------------
     def _register_sds(self, sds, sym):
@@ -145,6 +146,12 @@ class Program:
         or a plain Tensor whose _data was overwritten with a symbolic SDS
         (BatchNorm-style buffer leakage)."""
         if isinstance(t, Variable):
+            owner = t._program
+            if owner._family is not self._family:  # clones share a family
+                raise RuntimeError(
+                    f"Variable {t.name!r} belongs to Program #{owner.id} "
+                    f"and cannot be used in Program #{self.id} (the "
+                    "reference raises on cross-program Variable use too)")
             return t._sym
         d = t._data
         leaked = self._sds_syms.get(id(d))
@@ -187,21 +194,24 @@ class Program:
                 if not t.stop_gradient and t.persistable]
 
     def clone(self, for_test=False):
-        """for_test=True: same graph minus the training objective and
-        side updates (the reference prunes backward + optimize ops)."""
+        """for_test=True: a snapshot of the graph minus the training
+        objective and side updates (the reference prunes backward +
+        optimize ops). The node/capture lists are copied so ops recorded
+        into the original afterwards do not leak into the clone."""
         import copy
         p = copy.copy(self)
         if for_test:
             p = Program()
-            p.nodes = self.nodes
-            p.feeds = self.feeds
-            p.captures = self.captures
-            p._cap_index = self._cap_index
-            p._cap_snapshot = self._cap_snapshot
+            p.nodes = list(self.nodes)
+            p.feeds = dict(self.feeds)
+            p.captures = list(self.captures)
+            p._cap_index = dict(self._cap_index)
+            p._cap_snapshot = list(self._cap_snapshot)
             p._sds_syms = self._sds_syms
             p._sds_keep = self._sds_keep
             p.side_updates = []
             p._train = None
+            p._family = self._family
         return p
 
 
@@ -557,18 +567,25 @@ class Executor:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step = jnp.asarray(opt._step_count + 1, jnp.float32)
             rng = gen.default_generator.next_key()
-            fetches, new_caps, new_slots = compiled.fn(
-                list(feed_vals), cap_vals, slot_vals, lr, step, rng)
+            # only the rebound captures (trained params + side updates)
+            # are donated; frozen params/constants keep their buffers
+            don_vals = [cap_vals[i] for i in compiled.donated_idx]
+            held_vals = [cap_vals[i] for i in compiled.held_idx]
+            fetches, new_don, new_slots = compiled.fn(
+                list(feed_vals), don_vals, held_vals, slot_vals, lr,
+                step, rng)
             for p, ns in zip(compiled.train_params, new_slots):
                 opt._slots[id(p)] = ns
             opt._step_count += 1
+            for i, idx in enumerate(compiled.donated_idx):
+                prog.captures[idx]._data = new_don[i]
         else:
             rng = gen.default_generator.next_key()
             fetches, new_caps = compiled.fn(list(feed_vals), cap_vals, rng)
-        # commit side updates (BN running stats) + trained params
-        for idx, t in enumerate(prog.captures):
-            if new_caps[idx] is not None:
-                t._data = new_caps[idx]
+            # commit side updates (BN running stats)
+            for idx, t in enumerate(prog.captures):
+                if new_caps[idx] is not None:
+                    t._data = new_caps[idx]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor._from_data(f) for f in fetches]
@@ -617,8 +634,20 @@ class Executor:
 
         if train is not None:
             opt, loss_sym = train
+            donated_idx = sorted(set(train_idx)
+                                 | {ci for ci, _ in side})
+            held_idx = [i for i in range(n_caps) if i not in
+                        set(donated_idx)]
+            don_pos = {idx: p for p, idx in enumerate(donated_idx)}
 
-            def fn(feed_vals, cap_vals, slot_vals, lr, step, rng):
+            def fn(feed_vals, don_vals, held_vals, slot_vals, lr, step,
+                   rng):
+                cap_vals = [None] * n_caps
+                for p, idx in enumerate(donated_idx):
+                    cap_vals[idx] = don_vals[p]
+                for p, idx in enumerate(held_idx):
+                    cap_vals[idx] = held_vals[p]
+
                 def loss_of(train_vals):
                     cv = list(cap_vals)
                     for i, v in zip(train_idx, train_vals):
@@ -638,7 +667,7 @@ class Executor:
                     raise NotImplementedError(
                         "static-mode minimize supports grad clips with a "
                         "pure clip_fn (ClipGradByGlobalNorm)")
-                new_caps = [None] * n_caps
+                new_don = [don_vals[p] for p in range(len(donated_idx))]
                 new_slots = []
                 for i, p, g, s in zip(train_idx, train_params, grads,
                                       slot_vals):
@@ -647,13 +676,13 @@ class Executor:
                     opt._current_decay_enabled = opt._decay_enabled(p)
                     np_, ns = opt._rule_mp(cap_vals[i], g, s, lr, step)
                     opt._current_decay_enabled = True
-                    new_caps[i] = np_
+                    new_don[don_pos[i]] = np_
                     new_slots.append(ns)
                 for (ci, _), v in zip(side, side_vals):
-                    new_caps[ci] = v
-                return [plain[s] for s in fetch_syms], new_caps, new_slots
+                    new_don[don_pos[ci]] = v
+                return [plain[s] for s in fetch_syms], new_don, new_slots
 
-            jitted = jax.jit(fn, donate_argnums=(1, 2))
+            jitted = jax.jit(fn, donate_argnums=(1, 3))
         else:
             def fn(feed_vals, cap_vals, rng):
                 plain, side_vals, _ = run_targets(feed_vals, cap_vals, rng)
@@ -693,6 +722,9 @@ class Executor:
         c = _Compiled()
         c.fn = jitted
         c.train_params = train_params
+        if train is not None:
+            c.donated_idx = donated_idx
+            c.held_idx = held_idx
         return c
 
 
@@ -773,7 +805,19 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                               dict(zip(feed_names, feeds)), cap_vals)
         return tuple(value_of(s) for s in fetch_syms)
 
-    example = [jnp.zeros(v._data.shape, v._data.dtype) for v in feed_vars]
+    # feed dims declared None/-1 export as SYMBOLIC dims so the saved
+    # module accepts any batch size (the reference's saved models are
+    # batch-polymorphic; XLA re-specializes at load-run time)
+    example = []
+    for fi, v in enumerate(feed_vars):
+        desc = getattr(v, "desc_shape", tuple(v._data.shape))
+        if any(d == -1 for d in desc):
+            spec = ", ".join(f"b{fi}_{di}" if d == -1 else str(d)
+                             for di, d in enumerate(desc))
+            sym = jax_export.symbolic_shape(spec)
+            example.append(jax.ShapeDtypeStruct(sym, v._data.dtype))
+        else:
+            example.append(jnp.zeros(v._data.shape, v._data.dtype))
     exported = jax_export.export(jax.jit(fwd))(cap_vals, *example)
     payload = {
         "exported": exported.serialize(),
@@ -833,7 +877,12 @@ class _StaticNN:
         h = x
         if len(x.shape) > num_flatten_dims + 1:
             import paddle_tpu as paddle
-            h = paddle.reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
+
+            # leading (batch) dim is run-time dynamic: -1 it, keep the
+            # declared middle dims, flatten the trailing ones
+            shape = [-1] + list(x.shape[1:num_flatten_dims]) \
+                + [in_features]
+            h = paddle.reshape(x, shape)
         out = layer(h)
         if activation:
             from paddle_tpu.nn import functional as F

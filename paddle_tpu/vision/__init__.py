@@ -1,2 +1,2 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
-from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401

@@ -1,0 +1,655 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference: python/paddle/vision/ops.py (yolo_loss:58, yolo_box:266,
+prior_box:427, box_coder:573, deform_conv2d:753, DeformConv2D:960,
+distribute_fpn_proposals:1156, psroi_pool:1393, roi_pool:1514,
+roi_align:1640, ConvNormActivation:1810, nms:1867,
+generate_proposals:2038, matrix_nms:2236).
+
+TPU-native split:
+* Differentiable feature ops (roi_align/roi_pool/psroi_pool/
+  deform_conv2d) are registry emitters (ops/vision_ops.py): pure JAX
+  gather+matmul graphs, autograd via the registry's vjp, static shapes
+  → jit/Program-mode safe.
+* Post-processing (nms/matrix_nms/generate_proposals/
+  distribute_fpn_proposals) returns data-dependent-sized results, so
+  these run eagerly: device compute for the O(n²) IoU/suppression math,
+  host-side boolean indexing for the final variable-length selection —
+  same split the reference uses (CUDA kernel + host copy_back). Inside
+  a compiled region, use the fixed-size mask/score outputs instead.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "psroi_pool", "PSRoIPool",
+    "roi_pool", "RoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+    "generate_proposals", "ConvNormActivation", "read_file", "decode_jpeg",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(d):
+    return Tensor._from_data(d)
+
+
+def _boxes_to_flat(boxes, boxes_num):
+    """Reference RoI ops take per-image box counts (LoD); the TPU ops
+    take a flat (R,4) + (R,) image index — convert host-side."""
+    bn = np.asarray(_data(boxes_num)).astype(np.int64)
+    idx = np.repeat(np.arange(len(bn)), bn)
+    return jnp.asarray(idx, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# RoI family + deformable conv (registry ops)
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    from paddle_tpu import ops
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    idx = _boxes_to_flat(boxes, boxes_num)
+    return ops.roi_align(x, boxes, _wrap(idx),
+                         output_size=tuple(output_size),
+                         spatial_scale=float(spatial_scale),
+                         sampling_ratio=int(sampling_ratio),
+                         aligned=bool(aligned))
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    from paddle_tpu import ops
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    idx = _boxes_to_flat(boxes, boxes_num)
+    return ops.roi_pool(x, boxes, _wrap(idx),
+                        output_size=tuple(output_size),
+                        spatial_scale=float(spatial_scale))
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    from paddle_tpu import ops
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    idx = _boxes_to_flat(boxes, boxes_num)
+    return ops.psroi_pool(x, boxes, _wrap(idx),
+                          output_size=tuple(output_size),
+                          spatial_scale=float(spatial_scale))
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    from paddle_tpu import ops
+
+    return ops.deform_conv2d(x, offset, weight, mask, bias,
+                             stride=stride, padding=padding,
+                             dilation=dilation,
+                             deformable_groups=deformable_groups,
+                             groups=groups)
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference vision/ops.py:960). v1 when
+    forward gets no mask, v2 (modulated) with one."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        import math as _m
+
+        from paddle_tpu.nn.initializer import Uniform
+
+        fan_in = in_channels * kernel_size[0] * kernel_size[1] // groups
+        bound = 1.0 / _m.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *kernel_size],
+            default_initializer=Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels],
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# box codecs / anchors (pure broadcast math — jit-safe)
+# ---------------------------------------------------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against anchors (reference vision/ops.py:573,
+    phi/kernels/gpu/box_coder_kernel.cu)."""
+    pb = _data(prior_box).astype(jnp.float32)
+    tb = _data(target_box).astype(jnp.float32)
+    if prior_box_var is None:
+        pbv = jnp.ones((4,), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.asarray(prior_box_var, jnp.float32)
+    else:
+        pbv = _data(prior_box_var).astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type == "encode_center_size":
+        # tb: (M, 4) targets vs each prior: out (M, N, 4)
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        out = out / (pbv.reshape(1, -1, 4) if pbv.ndim == 2
+                     else pbv.reshape(1, 1, 4))
+        return _wrap(out)
+    elif code_type == "decode_center_size":
+        # tb: (N, M, 4) deltas; priors broadcast along `axis`
+        var = pbv if pbv.ndim == 1 else pbv
+        if pbv.ndim == 2:
+            var = pbv[:, None, :] if axis == 0 else pbv[None, :, :]
+        else:
+            var = pbv.reshape(1, 1, 4)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+        d = tb * var
+        ocx = d[..., 0] * pw_ + pcx_
+        ocy = d[..., 1] * ph_ + pcy_
+        ow = jnp.exp(d[..., 2]) * pw_
+        oh = jnp.exp(d[..., 3]) * ph_
+        out = jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                         ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm],
+                        axis=-1)
+        return _wrap(out)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference vision/ops.py:427). Anchor
+    geometry is shape-only → computed host-side in numpy, returned as
+    device constants."""
+    _, _, fh, fw = _data(input).shape
+    _, _, ih, iw = _data(image).shape
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    boxes = []
+    vars_ = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                ms = float(ms)
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        sq = np.sqrt(ms * float(max_sizes[k]))
+                        cell.append((cx, cy, sq, sq))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                    if max_sizes:
+                        sq = np.sqrt(ms * float(max_sizes[k]))
+                        cell.append((cx, cy, sq, sq))
+            for (ccx, ccy, w, h) in cell:
+                boxes.append(((ccx - w / 2) / iw, (ccy - h / 2) / ih,
+                              (ccx + w / 2) / iw, (ccy + h / 2) / ih))
+                vars_.append(variance)
+    n_per_cell = len(boxes) // (fh * fw)
+    b = np.asarray(boxes, np.float32).reshape(fh, fw, n_per_cell, 4)
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    v = np.asarray(vars_, np.float32).reshape(fh, fw, n_per_cell, 4)
+    return _wrap(jnp.asarray(b)), _wrap(jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# YOLO head (pure math — jit-safe)
+# ---------------------------------------------------------------------------
+
+def _yolo_grid(x, anchors, class_num, downsample_ratio, scale_x_y):
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+    p = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gx) / w
+    by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gy) / h
+    bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (
+        w * downsample_ratio)
+    bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (
+        h * downsample_ratio)
+    conf = jax.nn.sigmoid(p[:, :, 4])
+    cls = jax.nn.sigmoid(p[:, :, 5:])
+    return bx, by, bw, bh, conf, cls
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head into boxes+scores (reference
+    vision/ops.py:266). Fixed-size outputs (thresholding zeroes scores
+    instead of dropping rows) → jit-safe."""
+    xd = _data(x).astype(jnp.float32)
+    imgs = _data(img_size).astype(jnp.float32)
+    if iou_aware:
+        n, c, h, w = xd.shape
+        na = len(anchors) // 2
+        ioup = jax.nn.sigmoid(xd[:, :na])
+        xd = xd[:, na:]
+    bx, by, bw, bh, conf, cls = _yolo_grid(
+        xd, anchors, class_num, downsample_ratio, scale_x_y)
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) * \
+            ioup ** iou_aware_factor
+    n, na, h, w = conf.shape
+    ih = imgs[:, 0].reshape(n, 1, 1, 1)
+    iw = imgs[:, 1].reshape(n, 1, 1, 1)
+    x1 = (bx - bw * 0.5) * iw
+    y1 = (by - bh * 0.5) * ih
+    x2 = (bx + bw * 0.5) * iw
+    y2 = (by + bh * 0.5) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, iw - 1)
+        y1 = jnp.clip(y1, 0.0, ih - 1)
+        x2 = jnp.clip(x2, 0.0, iw - 1)
+        y2 = jnp.clip(y2, 0.0, ih - 1)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = cls * (conf * keep)[:, :, None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w,
+                                                     class_num)
+    return _wrap(boxes), _wrap(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference vision/ops.py:58). Routed through
+    the registry (emitter in ops/vision_ops.py) so autograd records the
+    vjp — differentiable end-to-end."""
+    from paddle_tpu import ops
+
+    return ops.yolo_loss(x, gt_box, gt_label, gt_score,
+                         anchors=tuple(anchors),
+                         anchor_mask=tuple(anchor_mask),
+                         class_num=int(class_num),
+                         ignore_thresh=float(ignore_thresh),
+                         downsample_ratio=int(downsample_ratio),
+                         use_label_smooth=bool(use_label_smooth),
+                         scale_x_y=float(scale_x_y))
+
+
+# ---------------------------------------------------------------------------
+# NMS family (eager: variable-length outputs)
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    iw = jnp.maximum(jnp.minimum(x2[:, None], x2[None, :])
+                     - jnp.maximum(x1[:, None], x1[None, :]), 0)
+    ih = jnp.maximum(jnp.minimum(y2[:, None], y2[None, :])
+                     - jnp.maximum(y1[:, None], y1[None, :]), 0)
+    inter = iw * ih
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                               1e-10)
+
+
+def _nms_keep_mask(boxes, iou_threshold):
+    """Greedy NMS as a fixed-trip-count device loop: boxes must already
+    be sorted by descending score. Returns a (R,) bool keep mask."""
+    r = boxes.shape[0]
+    iou = _iou_matrix(boxes)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & keep[i] & \
+            (jnp.arange(r) > i)
+        return keep & ~sup
+
+    return jax.lax.fori_loop(0, r, body, jnp.ones((r,), bool))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy (optionally per-category) NMS (reference
+    vision/ops.py:1867). Suppression runs on device; the final
+    variable-length index selection is host-side — eager only."""
+    bd = _data(boxes).astype(jnp.float32)
+    r = bd.shape[0]
+    if scores is None:
+        keep = np.asarray(_nms_keep_mask(bd, iou_threshold))
+        return _wrap(jnp.asarray(np.nonzero(keep)[0].astype(np.int64)))
+    sd = _data(scores).astype(jnp.float32)
+    order = jnp.argsort(-sd)
+    if category_idxs is not None:
+        # per-category: offset boxes by category so cross-category pairs
+        # never overlap (the standard batched-NMS trick)
+        cd = _data(category_idxs).astype(jnp.float32)
+        span = (bd.max() - bd.min()) + 1.0
+        bd_off = bd + (cd * span)[:, None]
+    else:
+        bd_off = bd
+    keep_sorted = _nms_keep_mask(bd_off[order], iou_threshold)
+    kept = np.asarray(order)[np.asarray(keep_sorted)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return _wrap(jnp.asarray(kept.astype(np.int64)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2., background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py:2236; SOLOv2 paper): decay
+    every score by the max-IoU overlap with higher-scored same-class
+    boxes — no sequential suppression, so the whole thing is one
+    batched device computation (TPU-friendly), with host-side
+    thresholding at the end."""
+    bd = _data(bboxes).astype(jnp.float32)    # (N, M, 4)
+    sd = _data(scores).astype(jnp.float32)    # (N, C, M)
+    n, c, m = sd.shape
+    outs, idxs, nums = [], [], []
+    for b in range(n):
+        cls_ids, box_ids, final = [], [], []
+        flat_scores = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            s = sd[b, ci]
+            sel = np.asarray(s > score_threshold).nonzero()[0]
+            if sel.size == 0:
+                continue
+            s_sel = np.asarray(s)[sel]
+            order = np.argsort(-s_sel)[:nms_top_k]
+            sel = sel[order]
+            bx = bd[b][jnp.asarray(sel)]
+            iou = np.array(_iou_matrix(bx))  # writable copy
+            np.fill_diagonal(iou, 0.0)
+            iou = np.triu(iou)  # iou[i,j]: box j vs higher-scored box i
+            comp = iou.max(axis=0)  # per-box max IoU with higher-scored
+            # decay_j = min_i f(iou_ij)/f(comp_i): each suppressor i is
+            # compensated by its own overlap with boxes above it
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[:, None],
+                                                1e-10)).min(axis=0)
+            dec_scores = np.asarray(s)[sel] * decay
+            keep = dec_scores >= post_threshold
+            for k in np.nonzero(keep)[0]:
+                cls_ids.append(ci)
+                box_ids.append(int(sel[k]))
+                flat_scores.append(float(dec_scores[k]))
+        order = np.argsort(-np.asarray(flat_scores)) if flat_scores \
+            else np.array([], np.int64)
+        order = order[:keep_top_k]
+        rows = [[float(cls_ids[i]), flat_scores[i],
+                 *np.asarray(bd[b][box_ids[i]]).tolist()] for i in order]
+        outs.append(np.asarray(rows, np.float32).reshape(-1, 6))
+        idxs.extend(int(b * m + box_ids[i]) for i in order)
+        nums.append(len(order))
+    out = _wrap(jnp.asarray(np.concatenate(outs, axis=0)
+                            if outs else np.zeros((0, 6), np.float32)))
+    ret = [out]
+    if return_index:
+        ret.append(_wrap(jnp.asarray(np.asarray(idxs, np.int64))))
+    if return_rois_num:
+        ret.append(_wrap(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference vision/ops.py:2038): decode
+    anchor deltas, clip, filter tiny boxes, NMS — per image, eager."""
+    sd = np.asarray(_data(scores))            # (N, A, H, W)
+    dd = np.asarray(_data(bbox_deltas))       # (N, 4A, H, W)
+    iszs = np.asarray(_data(img_size))        # (N, 2) (h, w)
+    an = np.asarray(_data(anchors)).reshape(-1, 4)
+    va = np.asarray(_data(variances)).reshape(-1, 4)
+    n = sd.shape[0]
+    offset = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, nums = [], [], []
+    for b in range(n):
+        s = sd[b].transpose(1, 2, 0).reshape(-1)
+        d = dd[b].reshape(-1, 4, sd.shape[2], sd.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        aw = a[:, 2] - a[:, 0] + offset
+        ah = a[:, 3] - a[:, 1] + offset
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        props = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - offset, cy + h * 0.5 - offset],
+                         axis=1)
+        ih, iw = iszs[b][0], iszs[b][1]
+        props[:, 0] = props[:, 0].clip(0, iw - offset)
+        props[:, 1] = props[:, 1].clip(0, ih - offset)
+        props[:, 2] = props[:, 2].clip(0, iw - offset)
+        props[:, 3] = props[:, 3].clip(0, ih - offset)
+        ws = props[:, 2] - props[:, 0] + offset
+        hs = props[:, 3] - props[:, 1] + offset
+        keep = (ws >= min_size) & (hs >= min_size)
+        props, s = props[keep], s[keep]
+        if props.shape[0]:
+            km = np.asarray(_nms_keep_mask(jnp.asarray(props),
+                                           nms_thresh))
+            sel = np.nonzero(km)[0][:post_nms_top_n]
+            props, s = props[sel], s[sel]
+        all_rois.append(props.astype(np.float32))
+        all_scores.append(s.astype(np.float32))
+        nums.append(props.shape[0])
+    rois = _wrap(jnp.asarray(np.concatenate(all_rois, axis=0)))
+    rscores = _wrap(jnp.asarray(np.concatenate(all_scores, axis=0)))
+    if return_rois_num:
+        return rois, rscores, _wrap(jnp.asarray(np.asarray(nums,
+                                                           np.int32)))
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference vision/ops.py:1156)
+    — host-side grouping (variable-size splits)."""
+    rois = np.asarray(_data(fpn_rois))
+    offset = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + offset
+    h = rois[:, 3] - rois[:, 1] + offset
+    scale = np.sqrt(np.maximum(w * h, 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    outs, restore = [], []
+    order = []
+    for li in range(n_levels):
+        idx = np.nonzero(lvl == min_level + li)[0]
+        outs.append(_wrap(jnp.asarray(rois[idx].astype(np.float32))))
+        order.extend(idx.tolist())
+    restore_idx = np.empty(len(order), np.int64)
+    restore_idx[np.asarray(order, np.int64)] = np.arange(len(order))
+    rois_num_per_level = None
+    if rois_num is not None:
+        rn = np.asarray(_data(rois_num))
+        img_of = np.repeat(np.arange(len(rn)), rn)
+        rois_num_per_level = [
+            _wrap(jnp.asarray(np.bincount(
+                img_of[lvl == min_level + li],
+                minlength=len(rn)).astype(np.int32)))
+            for li in range(n_levels)]
+    restore = _wrap(jnp.asarray(restore_idx.reshape(-1, 1)))
+    if rois_num_per_level is not None:
+        return outs, restore, rois_num_per_level
+    return outs, restore
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+class ConvNormActivation(object):
+    """Conv2D + Norm + Activation block (reference vision/ops.py:1810).
+    Returns an nn.Sequential."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size=3, stride=1,
+                padding=None, groups=1, norm_layer=None,
+                activation_layer=None, dilation=1, bias=None):
+        from paddle_tpu import nn
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is None:
+            norm_layer = nn.BatchNorm2D
+        if activation_layer is None:
+            activation_layer = nn.ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size,
+                            stride, padding, dilation=dilation,
+                            groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        return nn.Sequential(*layers)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference vision/ops.py:1301)."""
+    with open(filename, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+    return _wrap(jnp.asarray(raw))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference vision/ops.py:1344, nvjpeg-backed).
+    Host-side via Pillow when available."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs Pillow on the host (the reference uses "
+            "nvjpeg, which has no TPU analog); install pillow or decode "
+            "in the input pipeline") from e
+    import io as _io
+
+    raw = bytes(np.asarray(_data(x)).astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return _wrap(jnp.asarray(arr))

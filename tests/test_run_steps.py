@@ -82,3 +82,17 @@ def test_run_steps_batch_dim_equal_k_not_stacked():
     _, _, step_b = _fresh()
     scanned = np.asarray(step_b.run_steps(4, X, Y)._data)
     np.testing.assert_allclose(serial, scanned, rtol=2e-4, atol=1e-5)
+
+
+def test_run_steps_stacked_fallback_slices_microbatches():
+    """Graph-break fallback must slice stacked batches per step, not
+    feed the whole (k, ...) stack to every step."""
+    rng = np.random.default_rng(3)
+    Xk = paddle.to_tensor(rng.normal(size=(3, 16, 8)).astype("float32"))
+    Yk = paddle.to_tensor(rng.integers(0, 4, (3, 16)).astype("int64"))
+    _, opt, step = _fresh()
+    from paddle_tpu.jit.sot import PathCache
+    step._sot_cache = PathCache()  # force the per-step fallback path
+    losses = step.run_steps(3, Xk, Yk, stacked=True)
+    assert tuple(np.asarray(losses._data).shape) == (3,)
+    assert opt._step_count == 3

@@ -238,3 +238,78 @@ def test_save_load_inference_model(static_mode, tmp_path):
     loaded, feed_names, _ = static.load_inference_model(path, exe)
     got = loaded.run({"x": xs})
     np.testing.assert_allclose(got[0], ref, rtol=1e-5)
+
+
+def test_frozen_param_survives_train_donation(static_mode):
+    """A stop_gradient capture must keep a live buffer across train runs
+    (donation covers only rebound captures)."""
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        frozen = nn.Linear(4, 4)
+        for p in frozen.parameters():
+            p.stop_gradient = True
+        head = nn.Linear(4, 1)
+        loss = paddle.mean(head(frozen(x)) ** 2)
+        opt = optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = static.Executor()
+    feed = {"x": np.ones((8, 4), "float32")}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    exe.run(main, feed=feed, fetch_list=[loss])  # buffer must be alive
+    w = frozen.parameters()[0]
+    np.asarray(w._data)  # not deleted
+    # and the frozen weights did not move
+    assert not np.isnan(np.asarray(w._data)).any()
+
+
+def test_fc_dynamic_batch_with_flatten(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4, 4], "float32")
+        y = static.nn.fc(x, 5)
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.ones((7, 3, 4, 4), "float32")},
+                  fetch_list=[y])
+    assert out[0].shape == (7, 5)
+
+
+def test_cross_program_variable_rejected(static_mode):
+    p1, p2 = static.Program(), static.Program()
+    with static.program_guard(p1):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+    with static.program_guard(p2):
+        with pytest.raises(RuntimeError):
+            y * 2.0
+
+
+def test_clone_is_a_snapshot(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+        test_prog = main.clone(for_test=True)
+        z = y * 3.0  # recorded AFTER the clone
+    assert len(test_prog.nodes) < len(main.nodes)
+    exe = static.Executor()
+    (o,) = exe.run(test_prog, feed={"x": np.zeros((2, 2), "float32")},
+                   fetch_list=[y])
+    np.testing.assert_allclose(o, 1.0)
+
+
+def test_save_inference_model_batch_polymorphic(static_mode, tmp_path):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        net = nn.Linear(6, 3)
+        out = net(x)
+    exe = static.Executor()
+    path = str(tmp_path / "poly" / "model")
+    static.save_inference_model(path, [x], [out], exe, program=main)
+    loaded, names, _ = static.load_inference_model(path, exe)
+    for bs in (1, 4, 9):
+        got = loaded.run({"x": np.ones((bs, 6), "float32")})
+        assert got[0].shape == (bs, 3)

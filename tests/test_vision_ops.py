@@ -1,0 +1,312 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py; kernels
+phi/kernels/gpu/{roi_align,roi_pool,psroi_pool,deformable_conv,
+box_coder}_kernel.cu). Numeric references are hand-built numpy
+implementations (the OpTest pattern, test/legacy_test/op_test.py:418)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# nms
+# ---------------------------------------------------------------------------
+
+def _nms_ref(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            bi, bj = boxes[i], boxes[j]
+            iw = max(0.0, min(bi[2], bj[2]) - max(bi[0], bj[0]))
+            ih = max(0.0, min(bi[3], bj[3]) - max(bi[1], bj[1]))
+            inter = iw * ih
+            ai = (bi[2] - bi[0]) * (bi[3] - bi[1])
+            aj = (bj[2] - bj[0]) * (bj[3] - bj[1])
+            if inter / (ai + aj - inter + 1e-10) > thr:
+                sup[j] = True
+    return np.asarray(keep)
+
+
+def test_nms_matches_reference():
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 50, (40, 2))
+    wh = rng.uniform(5, 25, (40, 2))
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype("float32")
+    scores = rng.uniform(size=40).astype("float32")
+    got = vops.nms(_t(boxes), 0.4, _t(scores)).numpy()
+    ref = _nms_ref(boxes, scores, 0.4)
+    np.testing.assert_array_equal(np.sort(got), np.sort(ref))
+    # scores must be descending along the kept order
+    assert (np.diff(scores[got]) <= 1e-6).all()
+
+
+def test_nms_categories_do_not_cross_suppress():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10.5, 10.5]], "float32")
+    scores = np.asarray([0.9, 0.8], "float32")
+    cats = np.asarray([0, 1], "int64")
+    got = vops.nms(_t(boxes), 0.3, _t(scores), _t(cats), [0, 1])
+    assert len(got.numpy()) == 2  # same box, different class: both kept
+
+
+def test_nms_top_k():
+    boxes = np.asarray([[i * 20, 0, i * 20 + 10, 10] for i in range(6)],
+                       "float32")
+    scores = np.linspace(1, 0.5, 6).astype("float32")
+    got = vops.nms(_t(boxes), 0.5, _t(scores), top_k=3).numpy()
+    assert len(got) == 3
+
+
+# ---------------------------------------------------------------------------
+# roi_align / roi_pool / psroi_pool
+# ---------------------------------------------------------------------------
+
+def test_roi_align_constant_map():
+    """On a constant feature map every aligned average is the constant."""
+    x = np.full((1, 3, 16, 16), 7.0, "float32")
+    boxes = np.asarray([[2, 2, 10, 10], [0, 0, 15, 15]], "float32")
+    out = vops.roi_align(_t(x), _t(boxes), _t(np.asarray([2])),
+                         output_size=4, spatial_scale=1.0)
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)
+
+
+def test_roi_align_linear_ramp_center():
+    """On f(y,x)=x the aligned value equals the sample-x mean (exact
+    under bilinear interpolation of a linear function)."""
+    H = W = 16
+    x = np.tile(np.arange(W, dtype="float32"), (H, 1))[None, None]
+    boxes = np.asarray([[4.0, 4.0, 12.0, 12.0]], "float32")
+    out = vops.roi_align(_t(x), _t(boxes), _t(np.asarray([1])),
+                         output_size=2, spatial_scale=1.0,
+                         sampling_ratio=2, aligned=True)
+    # aligned=True: bin 0 covers [3.5, 7.5) in x; 2x2 samples at
+    # 3.5 + {1,3}*8/2/2/2... centers: x1=3.5, bin_w=4, samples at
+    # 3.5 + (0.5, 1.5)*4/2 -> 4.5, 6.5 -> mean 5.5
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 5.5, atol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(1, 2, 8, 8))
+        .astype("float32"))
+    x.stop_gradient = False
+    boxes = _t(np.asarray([[1, 1, 6, 6]], "float32"))
+    out = vops.roi_align(x, boxes, _t(np.asarray([1])), output_size=3)
+    paddle.sum(out).backward()
+    g = x.grad.numpy()
+    assert g.shape == (1, 2, 8, 8) and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 5, 5] = 9.0
+    boxes = np.asarray([[0, 0, 7, 7]], "float32")
+    out = vops.roi_pool(_t(x), _t(boxes), _t(np.asarray([1])),
+                        output_size=2)
+    o = out.numpy()[0, 0]
+    assert o[0, 0] == 5.0 and o[1, 1] == 9.0
+
+
+def test_psroi_pool_channel_groups():
+    ph = pw = 2
+    out_c = 3
+    x = np.zeros((1, out_c * ph * pw, 6, 6), "float32")
+    # each position-sensitive channel holds its own constant
+    for c in range(out_c * ph * pw):
+        x[0, c] = float(c)
+    boxes = np.asarray([[0, 0, 6, 6]], "float32")
+    out = vops.psroi_pool(_t(x), _t(boxes), _t(np.asarray([1])),
+                          output_size=(ph, pw))
+    assert tuple(out.shape) == (1, out_c, ph, pw)
+    o = out.numpy()[0]
+    # channel group layout: out[c, i, j] pools channel c*ph*pw + i*pw + j
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert o[c, i, j] == c * ph * pw + i * pw + j
+
+
+# ---------------------------------------------------------------------------
+# deform_conv2d
+# ---------------------------------------------------------------------------
+
+def test_deform_conv_zero_offset_matches_conv():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 4, 9, 9)).astype("float32")
+    w = rng.normal(size=(6, 4, 3, 3)).astype("float32") * 0.1
+    off = np.zeros((2, 2 * 9, 7, 7), "float32")
+    got = vops.deform_conv2d(_t(x), _t(off), _t(w)).numpy()
+    import paddle_tpu.nn.functional as F
+    ref = F.conv2d(_t(x), _t(w)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_mask_scales_v2():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 2, 6, 6)).astype("float32")
+    w = rng.normal(size=(3, 2, 3, 3)).astype("float32")
+    off = np.zeros((1, 18, 4, 4), "float32")
+    half = np.full((1, 9, 4, 4), 0.5, "float32")
+    full_ = vops.deform_conv2d(_t(x), _t(off), _t(w)).numpy()
+    masked = vops.deform_conv2d(_t(x), _t(off), _t(w),
+                                mask=_t(half)).numpy()
+    np.testing.assert_allclose(masked, full_ * 0.5, rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv_layer_trains():
+    layer = vops.DeformConv2D(2, 4, 3, padding=1)
+    x = paddle.ones([1, 2, 5, 5])
+    off = paddle.zeros([1, 18, 5, 5])
+    out = layer(x, off)
+    assert tuple(out.shape) == (1, 4, 5, 5)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# box_coder / prior_box / yolo
+# ---------------------------------------------------------------------------
+
+def test_box_coder_roundtrip():
+    rng = np.random.default_rng(0)
+    priors = np.asarray([[10, 10, 30, 40], [5, 5, 20, 25]], "float32")
+    targets = np.asarray([[12, 11, 28, 35]], "float32")
+    enc = vops.box_coder(_t(priors), [1., 1., 1., 1.], _t(targets),
+                         code_type="encode_center_size").numpy()
+    dec = vops.box_coder(_t(priors), [1., 1., 1., 1.],
+                         _t(enc.transpose(1, 0, 2)),
+                         code_type="decode_center_size", axis=0).numpy()
+    # decode(encode(t)) must give back the target against each prior
+    for pi in range(2):
+        np.testing.assert_allclose(dec[pi, 0], targets[0], atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    x = paddle.ones([1, 8, 4, 4])
+    img = paddle.ones([1, 3, 32, 32])
+    boxes, vars_ = vops.prior_box(x, img, min_sizes=[8.0],
+                                  aspect_ratios=[2.0], clip=True)
+    assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+    b = boxes.numpy()
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    assert vars_.numpy().shape == b.shape
+
+
+def test_yolo_box_shapes_and_threshold():
+    n, na, cn, h = 1, 3, 5, 4
+    x = np.zeros((n, na * (5 + cn), h, h), "float32")
+    x[:, 4::5 + cn] = -10.0  # all conf ~ 0 -> below threshold
+    boxes, scores = vops.yolo_box(
+        _t(x), _t(np.asarray([[64, 64]], "int32")),
+        anchors=[10, 13, 16, 30, 33, 23], class_num=cn,
+        conf_thresh=0.5, downsample_ratio=16)
+    assert tuple(boxes.shape) == (n, na * h * h, 4)
+    assert tuple(scores.shape) == (n, na * h * h, cn)
+    assert np.abs(scores.numpy()).max() == 0.0  # thresholded out
+
+
+def test_yolo_loss_decreases_on_fit():
+    """Training signal sanity: optimizing the head on one gt reduces
+    the loss (differentiability + target construction)."""
+    rng = np.random.default_rng(0)
+    cn = 3
+    x = paddle.to_tensor(
+        rng.normal(scale=0.1, size=(1, 3 * (5 + cn), 4, 4))
+        .astype("float32"))
+    x.stop_gradient = False
+    gtb = _t(np.asarray([[[0.5, 0.5, 0.3, 0.4]]], "float32"))
+    gtl = _t(np.asarray([[1]], "int32"))
+    anchors = [10, 13, 16, 30, 33, 23]
+    loss0 = None
+    opt_x = x
+    for i in range(25):
+        loss = vops.yolo_loss(opt_x, gtb, gtl, anchors, [0, 1, 2], cn,
+                              ignore_thresh=0.7, downsample_ratio=8)
+        lv = float(paddle.sum(loss))
+        if loss0 is None:
+            loss0 = lv
+        paddle.sum(loss).backward()
+        opt_x = paddle.to_tensor(
+            opt_x.numpy() - 0.1 * opt_x.grad.numpy())
+        opt_x.stop_gradient = False
+    assert lv < loss0
+
+
+# ---------------------------------------------------------------------------
+# proposals / fpn routing / matrix nms
+# ---------------------------------------------------------------------------
+
+def test_generate_proposals_runs_and_clips():
+    rng = np.random.default_rng(0)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.uniform(size=(n, a, h, w)).astype("float32")
+    deltas = rng.normal(scale=0.1, size=(n, 4 * a, h, w)).astype("float32")
+    anchors = rng.uniform(0, 30, (h, w, a, 4)).astype("float32")
+    anchors[..., 2:] += anchors[..., :2] + 5
+    var = np.full((h, w, a, 4), 1.0, "float32")
+    rois, rscores, num = vops.generate_proposals(
+        _t(scores), _t(deltas), _t(np.asarray([[32, 32]], "float32")),
+        _t(anchors), _t(var), pre_nms_top_n=40, post_nms_top_n=10,
+        nms_thresh=0.6, min_size=1.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] == int(num.numpy()[0])
+    assert r.min() >= 0.0 and r.max() <= 32.0
+
+
+def test_distribute_fpn_proposals_routing_and_restore():
+    rois = np.asarray([
+        [0, 0, 10, 10],      # small -> low level
+        [0, 0, 200, 200],    # large -> high level
+        [0, 0, 56, 56],      # refer scale @ refer level
+    ], "float32")
+    outs, restore = vops.distribute_fpn_proposals(
+        _t(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    sizes = [o.numpy().shape[0] for o in outs]
+    assert sum(sizes) == 3 and len(outs) == 4
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([o.numpy() for o in outs if o.numpy().size],
+                         axis=0)
+    ri = restore.numpy().reshape(-1)
+    np.testing.assert_allclose(cat[ri], rois)
+
+
+def test_matrix_nms_decay_keeps_best():
+    boxes = np.asarray([[[0, 0, 10, 10], [0.5, 0.5, 10, 10],
+                         [20, 20, 30, 30]]], "float32")
+    scores = np.asarray([[[0.9, 0.85, 0.8]]], "float32")
+    out, nums = vops.matrix_nms(_t(boxes), _t(scores),
+                                score_threshold=0.1, post_threshold=0.5,
+                                background_label=-1)
+    o = out.numpy()
+    # best box and the disjoint box survive; the heavy overlap decays
+    assert int(nums.numpy()[0]) == 2
+    assert o[0, 1] == pytest.approx(0.9, abs=1e-5)
+
+
+def test_conv_norm_activation_block():
+    block = vops.ConvNormActivation(3, 8, 3)
+    out = block(paddle.ones([2, 3, 8, 8]))
+    assert tuple(out.shape) == (2, 8, 8, 8)
+    assert float(out.numpy().min()) >= 0.0  # ReLU applied
+
+
+def test_read_file_roundtrip(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(16)))
+    t = vops.read_file(str(p))
+    np.testing.assert_array_equal(t.numpy(), np.arange(16, dtype="uint8"))
