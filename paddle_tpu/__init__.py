@@ -139,3 +139,4 @@ from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import onnx  # noqa: F401,E402
 from paddle_tpu import quantization  # noqa: F401,E402
 from paddle_tpu import static  # noqa: F401,E402
+import paddle_tpu.signal  # noqa: F401,E402

@@ -9,6 +9,7 @@ TPU-native: every transform is framing + rfft + matmuls over registry
 ops, so the whole feature pipeline fuses into the training graph
 (the reference binds to a C++ frame/stft kernel chain).
 """
+from paddle_tpu.audio import datasets  # noqa: F401
 from paddle_tpu.audio import functional  # noqa: F401
 from paddle_tpu.audio.features import (  # noqa: F401
     LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram,

@@ -1,0 +1,164 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+tess.py TESS, esc50.py ESC50). Real wav trees are parsed when present
+(stdlib wave module — no soundfile dependency in this image); synthetic
+class-conditional tones otherwise, so feature/classifier pipelines are
+runnable and testable offline."""
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _read_wav(path):
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        rate = w.getframerate()
+    if width == 1:
+        # WAV stores 8-bit PCM as UNSIGNED bytes with a 128 offset
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32)
+             - 128.0) / 128.0
+        return x, rate
+    dtype = {2: np.int16, 4: np.int32}[width]
+    x = np.frombuffer(raw, dtype).astype(np.float32)
+    x /= float(np.iinfo(dtype).max)
+    return x, rate
+
+
+class _SyntheticAudioMixin:
+    def _make_synthetic(self, n, n_classes, sr, dur, seed):
+        rng = np.random.RandomState(seed)
+        t = np.arange(int(sr * dur)) / sr
+        waves, labels = [], []
+        for i in range(n):
+            cls = rng.randint(0, n_classes)
+            f0 = 120.0 + 35.0 * cls  # class-conditional pitch
+            sig = np.sin(2 * np.pi * f0 * t) \
+                + 0.3 * np.sin(2 * np.pi * 2 * f0 * t) \
+                + 0.05 * rng.randn(len(t))
+            waves.append(sig.astype(np.float32))
+            labels.append(cls)
+        return waves, np.asarray(labels, np.int64)
+
+
+class TESS(Dataset, _SyntheticAudioMixin):
+    """Toronto emotional speech set (reference audio/datasets/tess.py):
+    7 emotion classes; (waveform, label) or (feature, label) when
+    ``feat_type`` is a paddle.audio feature name."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                "ps", "sad"]
+    SAMPLE_RATE = 24414
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, data_dir=None, **feat_kwargs):
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        root = data_dir or os.path.expanduser(
+            "~/.cache/paddle/dataset/tess/TESS")
+        files: List[Tuple[str, int]] = []
+        if os.path.isdir(root):
+            for dirpath, _, names in os.walk(root):
+                for nm in sorted(names):
+                    if not nm.lower().endswith(".wav"):
+                        continue
+                    emo = nm.rsplit("_", 1)[-1][:-4].lower()
+                    if emo in self.EMOTIONS:
+                        files.append((os.path.join(dirpath, nm),
+                                      self.EMOTIONS.index(emo)))
+        if files:
+            rng = np.random.RandomState(0)
+            idx = rng.permutation(len(files))
+            fold = np.arange(len(files)) % n_folds
+            keep = (fold != (split - 1)) if mode == "train" \
+                else (fold == (split - 1))
+            self._files = [files[i] for i in idx if keep[i]]
+            self._waves = None
+        else:
+            n = 140 if mode == "train" else 35
+            self._waves, self._labels = self._make_synthetic(
+                n, len(self.EMOTIONS), 4000, 0.5,
+                seed=0 if mode == "train" else 1)
+            self._files = None
+
+    def _featurize(self, x):
+        if self.feat_type == "raw":
+            return x
+        import paddle_tpu as paddle
+        from paddle_tpu.audio import features as AF
+
+        layer = {"spectrogram": AF.Spectrogram,
+                 "melspectrogram": AF.MelSpectrogram,
+                 "logmelspectrogram": AF.LogMelSpectrogram,
+                 "mfcc": AF.MFCC}[self.feat_type](**self.feat_kwargs)
+        return np.asarray(
+            layer(paddle.to_tensor(x[None]))._data)[0]
+
+    def __getitem__(self, i):
+        if self._files is not None:
+            path, label = self._files[i]
+            x, _ = _read_wav(path)
+        else:
+            x, label = self._waves[i], int(self._labels[i])
+        return self._featurize(x), np.int64(label)
+
+    def __len__(self):
+        return len(self._files) if self._files is not None \
+            else len(self._waves)
+
+
+class ESC50(Dataset, _SyntheticAudioMixin):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    50 classes, fold-based split from meta/esc50.csv when the real
+    tree is present."""
+
+    NUM_CLASSES = 50
+    SAMPLE_RATE = 44100
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **feat_kwargs):
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        root = data_dir or os.path.expanduser(
+            "~/.cache/paddle/dataset/esc50/ESC-50-master")
+        meta = os.path.join(root, "meta", "esc50.csv")
+        if os.path.exists(meta):
+            rows = []
+            with open(meta) as f:
+                next(f)
+                for ln in f:
+                    fn, fold, target = ln.split(",")[:3]
+                    rows.append((os.path.join(root, "audio", fn),
+                                 int(fold), int(target)))
+            keep = [(p, t) for p, f_, t in rows
+                    if (f_ != split if mode == "train" else f_ == split)]
+            self._files = keep
+            self._waves = None
+        else:
+            n = 200 if mode == "train" else 50
+            self._waves, self._labels = self._make_synthetic(
+                n, self.NUM_CLASSES, 4000, 0.5,
+                seed=0 if mode == "train" else 1)
+            self._files = None
+
+    _featurize = TESS._featurize
+
+    def __getitem__(self, i):
+        if self._files is not None:
+            path, label = self._files[i]
+            x, _ = _read_wav(path)
+        else:
+            x, label = self._waves[i], int(self._labels[i])
+        return self._featurize(x), np.int64(label)
+
+    def __len__(self):
+        return len(self._files) if self._files is not None \
+            else len(self._waves)

@@ -113,3 +113,63 @@ def compiled_memory_analysis(jitted_or_lowered) -> dict:
         if v is not None:
             out[k] = int(v)
     return out
+
+
+# ---------------------------------------------------------------------------
+# custom-device plugin seam (reference: paddle/phi/capi/ C ABI +
+# backends/custom/custom_device.cc:47 C_DeviceInterface; python
+# discovery device/__init__.py:46-50 CUSTOM_DEVICE_ROOT)
+# ---------------------------------------------------------------------------
+
+_registered_backends = {}
+
+
+def register_backend(name, pjrt_plugin_path=None, factory=None,
+                     priority=400, experimental=True):
+    """Plug an external accelerator backend without modifying the
+    framework — the reference's custom-device mechanism re-based on
+    PJRT: hardware vendors ship a PJRT C-API plugin (`.so`), the
+    framework registers it with the runtime and every op/collective
+    works through the same XLA path (the role of the C kernel/CCL ABI
+    in paddle/phi/capi/).
+
+    ``pjrt_plugin_path``: path to a PJRT plugin shared library, loaded
+    via jax's plugin discovery. ``factory``: alternatively a callable
+    returning an xla_client.Client (in-process backends, tests).
+    """
+    import jax
+
+    if name in _registered_backends:
+        raise ValueError(f"backend {name!r} already registered")
+    if (pjrt_plugin_path is None) == (factory is None):
+        raise ValueError(
+            "register_backend needs exactly one of pjrt_plugin_path "
+            "(vendor .so) or factory (in-process client constructor)")
+    if pjrt_plugin_path is not None:
+        from jax._src.xla_bridge import register_plugin
+
+        register_plugin(name, library_path=pjrt_plugin_path,
+                        priority=priority)
+    else:
+        from jax._src.xla_bridge import register_backend_factory
+
+        register_backend_factory(name, factory, priority=priority,
+                                 experimental=experimental)
+    _registered_backends[name] = pjrt_plugin_path or factory
+    return name
+
+
+def registered_backends():
+    """Names registered through register_backend (the reference lists
+    discovered custom devices in get_all_custom_device_type)."""
+    return sorted(_registered_backends)
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return registered_backends()

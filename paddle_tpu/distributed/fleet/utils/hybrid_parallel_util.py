@@ -1,0 +1,120 @@
+"""Fleet grad-sync utilities.
+
+Reference: python/paddle/distributed/fleet/utils/hybrid_parallel_util.py
+(broadcast_mp_parameters:213, broadcast_dp_parameters:221,
+fused_allreduce_gradients:241) and tensor_fusion_helper.py
+(fused_parameters:797, obtain_storage:629).
+
+TPU-native: the wrapper-init parameter broadcasts and the manual
+fused-gradient allreduce used by hybrid training loops. Fusion here is
+flat-buffer concatenation before ONE collective per dtype bucket — the
+role of the reference's coalesced-tensor kernels — and XLA further
+fuses the split/concat glue around the collective."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import communication as dist
+
+__all__ = [
+    "broadcast_dp_parameters", "broadcast_mp_parameters",
+    "broadcast_sharding_parameters", "fused_allreduce_gradients",
+    "fused_parameters",
+]
+
+
+def _broadcast_params(params: List[Tensor], group, src_rank_in_group=0):
+    """Broadcast every parameter from the group's src rank — rank-0
+    weights win, exactly how the reference wrappers align replicas at
+    init. Buffers ride along (they are part of state alignment)."""
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        return
+    for p in params:
+        dist.broadcast(p, src=src_rank_in_group, group=group)
+
+
+def broadcast_dp_parameters(model, hcg):
+    """reference hybrid_parallel_util.py:221"""
+    _broadcast_params(list(model.parameters()),
+                      hcg.get_data_parallel_group())
+
+
+def broadcast_mp_parameters(model, hcg):
+    """reference hybrid_parallel_util.py:213 — aligns the NON-sharded
+    (replicated) parameters across the tensor-parallel group; sharded
+    mp params (is_distributed) are intentionally left alone."""
+    group = hcg.get_model_parallel_group()
+    params = [p for p in model.parameters()
+              if not getattr(p, "is_distributed", False)]
+    _broadcast_params(params, group)
+
+
+def broadcast_sharding_parameters(model, hcg):
+    """reference hybrid_parallel_util.py (sharding group variant)."""
+    group = hcg.get_sharding_parallel_group() \
+        if hasattr(hcg, "get_sharding_parallel_group") else None
+    _broadcast_params(list(model.parameters()), group)
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None, group=None,
+                              bucket_mb: float = 25.0, scale=None):
+    """One fused allreduce per ~bucket_mb of gradients (reference
+    hybrid_parallel_util.py:241 over coalesced tensors). Grads are
+    flattened+concatenated per (dtype, bucket), all-reduced in one
+    collective, then split back — a manual version of what
+    ``DataParallel``'s reducer does automatically on backward."""
+    group = group if group is not None else (
+        hcg.get_data_parallel_group() if hcg is not None else None)
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        return
+    if scale is None:
+        scale = 1.0 / group.nranks
+    with_grad = [p for p in parameter_list
+                 if getattr(p, "grad", None) is not None]
+    # dtype buckets (cannot concat across dtypes)
+    by_dtype = {}
+    for p in with_grad:
+        by_dtype.setdefault(str(p.grad._data.dtype), []).append(p)
+    for _, ps in by_dtype.items():
+        bucket, size = [], 0
+        limit = int(bucket_mb * 1024 * 1024)
+        for p in ps:
+            bucket.append(p)
+            size += p.grad._data.size * p.grad._data.dtype.itemsize
+            if size >= limit:
+                _reduce_bucket(bucket, group, scale)
+                bucket, size = [], 0
+        if bucket:
+            _reduce_bucket(bucket, group, scale)
+
+
+def _reduce_bucket(params, group, scale):
+    shapes = [p.grad._data.shape for p in params]
+    sizes = [int(np.prod(s)) for s in shapes]
+    flat = jnp.concatenate([p.grad._data.reshape(-1) for p in params])
+    holder = Tensor._from_data(flat)
+    dist.all_reduce(holder, group=group)
+    flat = holder._data * scale
+    off = 0
+    for p, s, shp in zip(params, sizes, shapes):
+        p.grad._data = flat[off:off + s].reshape(shp)
+        off += s
+
+
+def fused_parameters(parameters, use_main_grad=False, fuse_param=True,
+                     comm_overlap=False, comm_group=None, act=None,
+                     dst=-1, scale_after_comm=False, group_params=False,
+                     apply_decay_param_fun=None):
+    """tensor_fusion_helper.fused_parameters role: returns dtype-grouped
+    parameter buckets (the flat-storage planning step). On TPU the
+    actual flat storage is XLA's concern — buffers live in HBM laid out
+    by the compiler — so this returns the grouping metadata the callers
+    iterate over."""
+    by_dtype = {}
+    for p in parameters:
+        by_dtype.setdefault(str(p._data.dtype), []).append(p)
+    return list(by_dtype.values())

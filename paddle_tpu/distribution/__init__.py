@@ -317,3 +317,83 @@ def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     raise NotImplementedError(
         f"kl_divergence not registered for "
         f"({type(p).__name__}, {type(q).__name__})")
+
+
+# ---------------------------------------------------------------------------
+# wider zoo + transforms (reference: beta.py, gamma.py, dirichlet.py,
+# lognormal.py, cauchy.py, studentT, multivariate_normal.py, poisson.py,
+# geometric.py, binomial.py, multinomial.py, continuous_bernoulli.py,
+# independent.py, transform.py, transformed_distribution.py)
+# ---------------------------------------------------------------------------
+from paddle_tpu.distribution.extra import (  # noqa: F401,E402
+    AbsTransform, AffineTransform, Beta, Binomial, Cauchy, ChainTransform,
+    ContinuousBernoulli, Dirichlet, ExponentialFamily, ExpTransform, Gamma,
+    Geometric, Independent, IndependentTransform, LogNormal, Multinomial,
+    MultivariateNormal, Poisson, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, StudentT, TanhTransform, Transform,
+    TransformedDistribution,
+)
+
+__all__ += [
+    "Beta", "Binomial", "Cauchy", "ContinuousBernoulli", "Dirichlet",
+    "ExponentialFamily", "Gamma", "Geometric", "Independent", "LogNormal",
+    "Multinomial", "MultivariateNormal", "Poisson", "StudentT",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]
+
+
+def _kl_extra(p, q):
+    """Additional registered KL pairs (reference distribution/kl.py)."""
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        sp = p.alpha + p.beta
+        dg = _ops["digamma"]
+        return ((_ops["lgamma"](q.alpha) + _ops["lgamma"](q.beta)
+                 - _ops["lgamma"](q.alpha + q.beta))
+                - (_ops["lgamma"](p.alpha) + _ops["lgamma"](p.beta)
+                   - _ops["lgamma"](sp))
+                + (p.alpha - q.alpha) * dg(p.alpha)
+                + (p.beta - q.beta) * dg(p.beta)
+                + (q.alpha + q.beta - p.alpha - p.beta) * dg(sp))
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        dg = _ops["digamma"]
+        return ((p.concentration - q.concentration) * dg(p.concentration)
+                - _ops["lgamma"](p.concentration)
+                + _ops["lgamma"](q.concentration)
+                + q.concentration * (_ops["log"](p.rate)
+                                     - _ops["log"](q.rate))
+                + p.concentration * (q.rate / p.rate - 1.0))
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        dg = _ops["digamma"]
+        a0 = _ops["sum"](p.concentration, axis=-1, keepdim=True)
+        t = (p.concentration - q.concentration) * (
+            dg(p.concentration) - dg(a0))
+        return (_ops["lgamma"](_ops["sum"](p.concentration, axis=-1))
+                - _ops["lgamma"](_ops["sum"](q.concentration, axis=-1))
+                - _ops["sum"](_ops["lgamma"](p.concentration), axis=-1)
+                + _ops["sum"](_ops["lgamma"](q.concentration), axis=-1)
+                + _ops["sum"](t, axis=-1))
+    if isinstance(p, Poisson) and isinstance(q, Poisson):
+        return p.rate * (_ops["log"](p.rate) - _ops["log"](q.rate)) \
+            - p.rate + q.rate
+    if isinstance(p, Geometric) and isinstance(q, Geometric):
+        a, b = p.probs, q.probs
+        return (_ops["log"](a) - _ops["log"](b)) + (1.0 - a) / a * (
+            _ops["log"](1.0 - a) - _ops["log"](1.0 - b))
+    if isinstance(p, LogNormal) and isinstance(q, LogNormal):
+        return kl_divergence(p._normal, q._normal)
+    return None
+
+
+_kl_base = kl_divergence
+
+
+def kl_divergence(p, q):  # noqa: F811
+    extra = _kl_extra(p, q)
+    if extra is not None:
+        return extra
+    return _kl_base(p, q)

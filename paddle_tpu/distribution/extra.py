@@ -1,0 +1,783 @@
+"""paddle.distribution — the wider distribution zoo + transforms.
+
+Reference: python/paddle/distribution/ (beta.py, binomial.py, cauchy.py,
+continuous_bernoulli.py, dirichlet.py, gamma.py, geometric.py,
+independent.py, lognormal.py, multinomial.py, multivariate_normal.py,
+poisson.py, transform.py, transformed_distribution.py,
+exponential_family.py, kl.py).
+
+Same construction discipline as the core module: densities/KLs are
+built from registry Tensor ops so gradients flow to distribution
+parameters through the autograd tape; raw draws come from the global
+threefry generator and are stop-gradient (rsample reparameterizes where
+the pathwise gradient exists — jax's gamma/beta/dirichlet samplers are
+differentiable via implicit reparameterization, which the TPU build
+inherits for free where the draw is used directly)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _ops
+
+__all__ = [
+    "Beta", "Binomial", "Cauchy", "ContinuousBernoulli", "Dirichlet",
+    "ExponentialFamily", "Gamma", "Geometric", "Independent",
+    "LogNormal", "Multinomial", "MultivariateNormal", "Poisson",
+    "StudentT", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform", "TransformedDistribution",
+]
+
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+def _core():
+    from paddle_tpu import distribution as D
+    return D
+
+
+def _t(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype")
+                  else jnp.asarray(x))
+
+
+def _draw(shape, sampler) -> Tensor:
+    return Tensor._from_data(sampler(gen.active_key(), tuple(shape)))
+
+
+def _bshape(*ts):
+    return jnp.broadcast_shapes(*(tuple(t.shape) for t in ts))
+
+
+class ExponentialFamily:
+    """Marker base (reference exponential_family.py) — entropy via the
+    Bregman identity is specialized per subclass here."""
+
+
+# ---------------------------------------------------------------------------
+# continuous families
+# ---------------------------------------------------------------------------
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        self._batch_shape = _bshape(self.alpha, self.beta)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (_ops["square"](s) * (s + 1.0))
+
+    def sample(self, shape=()):
+        a = jnp.broadcast_to(self.alpha._data, self._batch_shape)
+        b = jnp.broadcast_to(self.beta._data, self._batch_shape)
+        full = tuple(shape) + self._batch_shape
+        return Tensor._from_data(jax.random.beta(
+            gen.active_key(), a, b, shape=full))
+
+    rsample = sample
+
+    def _log_beta(self):
+        return (_ops["lgamma"](self.alpha) + _ops["lgamma"](self.beta)
+                - _ops["lgamma"](self.alpha + self.beta))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return ((self.alpha - 1.0) * _ops["log"](v)
+                + (self.beta - 1.0) * _ops["log"](1.0 - v)
+                - self._log_beta())
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        return (self._log_beta()
+                - (a - 1.0) * _ops["digamma"](a)
+                - (b - 1.0) * _ops["digamma"](b)
+                + (s - 2.0) * _ops["digamma"](s))
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        self._batch_shape = _bshape(self.concentration, self.rate)
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / _ops["square"](self.rate)
+
+    def sample(self, shape=()):
+        k = jnp.broadcast_to(self.concentration._data, self._batch_shape)
+        full = tuple(shape) + self._batch_shape
+        g = jax.random.gamma(gen.active_key(), k, shape=full)
+        return Tensor._from_data(g) / self.rate
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        return (self.concentration * _ops["log"](self.rate)
+                + (self.concentration - 1.0) * _ops["log"](v)
+                - self.rate * v - _ops["lgamma"](self.concentration))
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        k = self.concentration
+        return (k - _ops["log"](self.rate) + _ops["lgamma"](k)
+                + (1.0 - k) * _ops["digamma"](k))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        self._batch_shape = tuple(self.concentration.shape[:-1])
+        self._event_shape = tuple(self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / _ops["sum"](self.concentration,
+                                                axis=-1, keepdim=True)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        return Tensor._from_data(jax.random.dirichlet(
+            gen.active_key(), self.concentration._data, shape=full))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        a = self.concentration
+        return (_ops["sum"]((a - 1.0) * _ops["log"](v), axis=-1)
+                + _ops["lgamma"](_ops["sum"](a, axis=-1))
+                - _ops["sum"](_ops["lgamma"](a), axis=-1))
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = _ops["sum"](a, axis=-1)
+        k = float(self.concentration.shape[-1])
+        logB = _ops["sum"](_ops["lgamma"](a), axis=-1) \
+            - _ops["lgamma"](a0)
+        return (logB + (a0 - k) * _ops["digamma"](a0)
+                - _ops["sum"]((a - 1.0) * _ops["digamma"](a), axis=-1))
+
+
+class LogNormal:
+    def __init__(self, loc, scale):
+        D = _core()
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._normal = D.Normal(loc, scale)
+        self._batch_shape = self._normal._batch_shape
+
+    @property
+    def mean(self):
+        return _ops["exp"](self.loc + _ops["square"](self.scale) * 0.5)
+
+    @property
+    def variance(self):
+        s2 = _ops["square"](self.scale)
+        return (_ops["exp"](s2) - 1.0) * _ops["exp"](2.0 * self.loc + s2)
+
+    def sample(self, shape=()):
+        return _ops["exp"](self._normal.sample(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        return self._normal.log_prob(_ops["log"](v)) - _ops["log"](v)
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        return self._normal.entropy() + self.loc
+
+
+class Cauchy:
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._batch_shape = _bshape(self.loc, self.scale)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        c = _draw(full, jax.random.cauchy)
+        return self.loc + self.scale * c
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        z = (v - self.loc) / self.scale
+        return -_ops["log"](1.0 + _ops["square"](z)) \
+            - _ops["log"](self.scale) - math.log(math.pi)
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        out = _ops["log"](4.0 * math.pi * self.scale)
+        return out
+
+    def cdf(self, value):
+        v = _t(value)
+        return _ops["atan"]((v - self.loc) / self.scale) / math.pi + 0.5
+
+
+class StudentT:
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._batch_shape = _bshape(self.df, self.loc, self.scale)
+
+    def sample(self, shape=()):
+        df = jnp.broadcast_to(self.df._data, self._batch_shape)
+        full = tuple(shape) + self._batch_shape
+        z = jax.random.t(gen.active_key(), df, shape=full)
+        return self.loc + self.scale * Tensor._from_data(z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        z = (v - self.loc) / self.scale
+        n = self.df
+        return (_ops["lgamma"]((n + 1.0) / 2.0)
+                - _ops["lgamma"](n / 2.0)
+                - 0.5 * _ops["log"](n * math.pi)
+                - _ops["log"](self.scale)
+                - (n + 1.0) / 2.0
+                * _ops["log"](1.0 + _ops["square"](z) / n))
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+
+class MultivariateNormal:
+    """Full-covariance normal (reference multivariate_normal.py)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "provide exactly one of covariance_matrix / scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._tril = _ops["cholesky"](self.covariance_matrix)
+        else:
+            self._tril = _t(scale_tril)
+            self.covariance_matrix = _ops["matmul"](
+                self._tril, _t(jnp.swapaxes(self._tril._data, -1, -2)))
+        self._event_shape = tuple(self.loc.shape[-1:])
+        self._batch_shape = tuple(self.loc.shape[:-1])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        d = self.loc.shape[-1]
+        full = tuple(shape) + self._batch_shape + (d,)
+        eps = _draw(full, jax.random.normal)
+        return self.loc + _t(jnp.einsum(
+            "...ij,...j->...i", self._tril._data, eps._data))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        d = float(self.loc.shape[-1])
+        diff = (v - self.loc)._data
+        sol = jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(self._tril._data,
+                             diff.shape[:-1] + self._tril._data.shape[-2:]),
+            diff[..., None], lower=True)[..., 0]
+        maha = _t(jnp.sum(sol * sol, axis=-1))
+        logdet = _t(2.0 * jnp.sum(jnp.log(jnp.diagonal(
+            self._tril._data, axis1=-2, axis2=-1)), axis=-1))
+        return -0.5 * (maha + d * _LOG2PI) - 0.5 * logdet
+
+    def entropy(self):
+        d = float(self.loc.shape[-1])
+        logdet = _t(2.0 * jnp.sum(jnp.log(jnp.diagonal(
+            self._tril._data, axis1=-2, axis2=-1)), axis=-1))
+        return 0.5 * (d * (1.0 + _LOG2PI) + logdet)
+
+
+# ---------------------------------------------------------------------------
+# discrete families
+# ---------------------------------------------------------------------------
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        self._batch_shape = tuple(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    variance = mean
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        lam = jnp.broadcast_to(self.rate._data, self._batch_shape)
+        return Tensor._from_data(jax.random.poisson(
+            gen.active_key(), lam, shape=full).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * _ops["log"](self.rate) - self.rate \
+            - _ops["lgamma"](v + 1.0)
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+
+class Geometric:
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (reference geometric.py)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        self._batch_shape = tuple(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        p = jnp.broadcast_to(self.probs._data, self._batch_shape)
+        u = jax.random.uniform(gen.active_key(), full,
+                               minval=1e-7, maxval=1.0)
+        k = jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return Tensor._from_data(k.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * _ops["log"](1.0 - self.probs) + _ops["log"](self.probs)
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        p = self.probs
+        q = 1.0 - p
+        return -(q * _ops["log"](q) + p * _ops["log"](p)) / p
+
+
+class Binomial:
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        self._batch_shape = _bshape(self.total_count, self.probs)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        n = jnp.broadcast_to(self.total_count._data, self._batch_shape)
+        p = jnp.broadcast_to(self.probs._data, self._batch_shape)
+        return Tensor._from_data(jax.random.binomial(
+            gen.active_key(), n, p, shape=full).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        n = self.total_count
+        comb = (_ops["lgamma"](n + 1.0) - _ops["lgamma"](v + 1.0)
+                - _ops["lgamma"](n - v + 1.0))
+        return comb + v * _ops["log"](self.probs) \
+            + (n - v) * _ops["log"](1.0 - self.probs)
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+
+class Multinomial:
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        self._batch_shape = tuple(self.probs.shape[:-1])
+        self._event_shape = tuple(self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        k = self.probs.shape[-1]
+        logits = jnp.log(jnp.broadcast_to(
+            self.probs._data, full + (k,)))
+        draws = jax.random.categorical(
+            gen.active_key(), logits, axis=-1,
+            shape=(self.total_count,) + full)
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor._from_data(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        n = float(self.total_count)
+        return (_ops["lgamma"](_t(n + 1.0))
+                - _ops["sum"](_ops["lgamma"](v + 1.0), axis=-1)
+                + _ops["sum"](v * _ops["log"](self.probs), axis=-1))
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+
+class ContinuousBernoulli:
+    """reference continuous_bernoulli.py: CB(λ) on [0,1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        self._batch_shape = tuple(self.probs.shape)
+
+    def _log_norm(self):
+        lam = self.probs
+        # C(λ) = 2 atanh(1-2λ) / (1-2λ), with the λ→0.5 limit of 2;
+        # use a safe λ away from 0.5 in the singular band
+        d = self.probs._data
+        near = jnp.abs(d - 0.5) < (self._lims[1] - 0.5)
+        safe = jnp.where(near, 0.6, d)
+        c = 2.0 * jnp.arctanh(1.0 - 2.0 * safe) / (1.0 - 2.0 * safe)
+        # 2nd-order Taylor around 0.5: C ≈ 2 + (4/3)(λ-1/2)^2
+        taylor = 2.0 + (4.0 / 3.0) * jnp.square(d - 0.5) * 4.0
+        return _t(jnp.log(jnp.where(near, taylor, c)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return (v * _ops["log"](self.probs)
+                + (1.0 - v) * _ops["log"](1.0 - self.probs)
+                + self._log_norm())
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(gen.active_key(), full, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        lam = jnp.broadcast_to(self.probs._data, full)
+        near = jnp.abs(lam - 0.5) < 1e-3
+        safe = jnp.where(near, 0.6, lam)
+        x = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor._from_data(jnp.where(near, u, x))
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+class Independent:
+    """Reinterpret batch dims as event dims (reference independent.py):
+    log_prob sums over the reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = tuple(base._batch_shape)
+        self._batch_shape = bs[:len(bs) - self.rank]
+        self._event_shape = bs[len(bs) - self.rank:] + tuple(
+            getattr(base, "_event_shape", ()))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = _ops["sum"](lp, axis=-1)
+        return lp
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = _ops["sum"](e, axis=-1)
+        return e
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference transform.py)
+# ---------------------------------------------------------------------------
+
+class Transform:
+    """y = f(x) with log|det J| bookkeeping."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * _t(x)
+
+    def inverse(self, y):
+        return (_t(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        out = _ops["log"](_ops["abs"](self.scale))
+        return out + _t(x) * 0.0  # broadcast to x's shape
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _ops["exp"](_t(x))
+
+    def inverse(self, y):
+        return _ops["log"](_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return _ops["pow"](_t(x), self.power)
+
+    def inverse(self, y):
+        return _ops["pow"](_t(y), 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return _ops["log"](_ops["abs"](
+            self.power * _ops["pow"](x, self.power - 1.0)))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _ops["abs"](_t(x))
+
+    def inverse(self, y):
+        return _t(y)  # principal branch
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _ops["sigmoid"](_t(x))
+
+    def inverse(self, y):
+        y = _t(y)
+        return _ops["log"](y) - _ops["log"](1.0 - y)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        s = _ops["sigmoid"](x)
+        return _ops["log"](s) + _ops["log"](1.0 - s)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _ops["tanh"](_t(x))
+
+    def inverse(self, y):
+        return _ops["atanh"](_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return 2.0 * (math.log(2.0) - x - _ops["softplus"](-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    def forward(self, x):
+        return _ops["softmax"](_t(x), axis=-1)
+
+    def inverse(self, y):
+        return _ops["log"](_t(y))
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → simplex^K (reference transform.py StickBreaking)."""
+
+    def forward(self, x):
+        d = _t(x)._data
+        offset = jnp.arange(d.shape[-1], 0, -1, dtype=d.dtype)
+        z = jax.nn.sigmoid(d - jnp.log(offset))
+        zp = jnp.concatenate(
+            [jnp.zeros_like(z[..., :1]), z], axis=-1)
+        cum = jnp.cumprod(1.0 - zp[..., :-1], axis=-1)
+        head = z * cum
+        last = jnp.prod(1.0 - z, axis=-1, keepdims=True)
+        return _t(jnp.concatenate([head, last], axis=-1))
+
+    def inverse(self, y):
+        d = _t(y)._data
+        cum = jnp.cumsum(d[..., :-1], axis=-1)
+        rem = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        z = d[..., :-1] / rem
+        offset = jnp.arange(d.shape[-1] - 1, 0, -1, dtype=d.dtype)
+        return _t(jnp.log(z / (1.0 - z)) + jnp.log(offset))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        x = _t(x)
+        lead = tuple(x.shape)[:len(tuple(x.shape))
+                              - len(self.in_event_shape)]
+        return _ops["reshape"](x, list(lead + self.out_event_shape))
+
+    def inverse(self, y):
+        y = _t(y)
+        lead = tuple(y.shape)[:len(tuple(y.shape))
+                              - len(self.out_event_shape)]
+        return _ops["reshape"](y, list(lead + self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(0.0)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        for _ in range(self.rank):
+            ldj = _ops["sum"](ldj, axis=-1)
+        return ldj
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else total + ldj
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        x = _t(x)
+        arrs = jnp.moveaxis(x._data, self.axis, 0)
+        outs = [self.transforms[i].forward(_t(arrs[i]))._data
+                for i in range(len(self.transforms))]
+        return _t(jnp.moveaxis(jnp.stack(outs), 0, self.axis))
+
+    def inverse(self, y):
+        y = _t(y)
+        arrs = jnp.moveaxis(y._data, self.axis, 0)
+        outs = [self.transforms[i].inverse(_t(arrs[i]))._data
+                for i in range(len(self.transforms))]
+        return _t(jnp.moveaxis(jnp.stack(outs), 0, self.axis))
+
+
+class TransformedDistribution:
+    """base distribution pushed through transforms (reference
+    transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _t(value)
+        lp = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            lp = (-ldj) if lp is None else (lp - ldj)
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp + lp if lp is not None else base_lp
+
+    def prob(self, value):
+        return _ops["exp"](self.log_prob(value))

@@ -23,6 +23,28 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _update_metric(m, res):
+    """Reference pattern: metric.update(*to_list(compute_out)) — base
+    Metric.compute returns the args tuple (Precision/Recall/Auc), while
+    Accuracy returns a single correct-matrix."""
+    if isinstance(res, tuple):
+        return m.update(*res)
+    return m.update(res)
+
+
+def _log_metric(logs, m, value):
+    """Metric.name() may return a list (Accuracy(topk=(1,5)) →
+    [acc_top1, acc_top5]); fan the values out to one log key each."""
+    names = m.name()
+    if isinstance(names, (list, tuple)):
+        vals = value if isinstance(value, (list, tuple)) \
+            else [value] * len(names)
+        for nm, v in zip(names, vals):
+            logs[nm] = v
+    else:
+        logs[names] = value
+
+
 def _as_loader(data, batch_size, shuffle, num_workers=0):
     from paddle_tpu.io import DataLoader, Dataset
 
@@ -45,24 +67,59 @@ class Model:
 
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
-        self._n_inputs = max(len(_to_list(inputs)), 1) if inputs is not None \
-            else 1
+        self._inputs_spec = _to_list(inputs)
+        self._labels_spec = _to_list(labels)
+        self._n_inputs = max(len(self._inputs_spec), 1) \
+            if inputs is not None else 1
         self._optimizer = None
         self._loss = None
         self._metrics = []
         self._train_step = None
         self._eval_fn = None
+        self._amp_level = "O0"
+        self._scaler = None
         self.stop_training = False
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
+        """Reference model.py prepare: bind optimizer/loss/metrics and
+        AMP config. ``amp_configs`` accepts "O1"/"O2" or a dict with
+        ``level`` and GradScaler kwargs (``init_loss_scaling`` etc.)."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         self._train_step = None  # (re)built lazily on first train_batch
         self._eval_fn = None
+        self._amp_level = "O0"
+        self._scaler = None
+        if amp_configs:
+            from paddle_tpu import amp as _amp
+
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                cfg = dict(amp_configs)
+                self._amp_level = cfg.pop("level", "O1")
+                scaler_kw = {k: v for k, v in cfg.items()
+                             if k in ("init_loss_scaling", "incr_ratio",
+                                      "decr_ratio", "incr_every_n_steps",
+                                      "decr_every_n_nan_or_inf",
+                                      "use_dynamic_loss_scaling")}
+                if scaler_kw:
+                    self._scaler = _amp.GradScaler(**scaler_kw)
+            if self._amp_level not in ("O0", "O1", "O2"):
+                raise ValueError(f"bad amp level {self._amp_level!r}")
         return self
+
+    def _autocast(self):
+        import contextlib
+
+        if self._amp_level in ("O1", "O2"):
+            from paddle_tpu import amp as _amp
+
+            return _amp.auto_cast(enable=True, level=self._amp_level)
+        return contextlib.nullcontext()
 
     def _ensure_train_step(self):
         if self._train_step is None:
@@ -72,7 +129,8 @@ class Model:
                 raise RuntimeError(
                     "call prepare(optimizer=..., loss=...) before training")
             self._train_step = paddle.jit.TrainStep(
-                self.network, self._loss, self._optimizer)
+                self.network, self._loss, self._optimizer,
+                scaler=self._scaler)
         return self._train_step
 
     def _ensure_eval_fn(self):
@@ -88,7 +146,8 @@ class Model:
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         self.network.train()
-        loss = step(*(inputs + labels), n_model_inputs=len(inputs))
+        with self._autocast():
+            loss = step(*(inputs + labels), n_model_inputs=len(inputs))
         return [float(loss.item())]
 
     def eval_batch(self, inputs, labels=None):
@@ -105,7 +164,7 @@ class Model:
         metrics = []
         for m in self._metrics:
             res = m.compute(*(outs_l + labels))
-            metrics.append(m.update(res))
+            metrics.append(_update_metric(m, res))
         return (logs.get("loss", [0.0]), metrics) if self._metrics \
             else logs.get("loss", [0.0])
 
@@ -144,11 +203,24 @@ class Model:
         for epoch in range(epochs):
             cbks.call("on_epoch_begin", epoch, {})
             logs = {}
+            for m in self._metrics:
+                m.reset()
             for i, batch in enumerate(loader):
                 batch = _to_list(batch)
                 cbks.call("on_train_batch_begin", i, {})
-                loss = step_obj(*batch, n_model_inputs=n_in)
+                with self._autocast():
+                    loss = step_obj(*batch, n_model_inputs=n_in)
                 logs = {"loss": float(loss.item())}
+                if self._metrics and (i % log_freq == 0):
+                    # train metrics ride a separate compiled forward so
+                    # the fused train step stays loss-only (reference
+                    # computes them in-step; sampling at log_freq keeps
+                    # the fast path fast — documented divergence)
+                    outs = _to_list(self._ensure_eval_fn()(*batch[:n_in]))
+                    for m in self._metrics:
+                        _update_metric(
+                            m, m.compute(*(outs + batch[n_in:])))
+                        _log_metric(logs, m, m.accumulate())
                 cbks.call("on_train_batch_end", i, logs)
             cbks.call("on_epoch_end", epoch, logs)
             history.append(logs)
@@ -195,13 +267,13 @@ class Model:
                 logs["loss"] = v
             for m in self._metrics:
                 res = m.compute(*(outs + labels))
-                logs[m.name()] = m.update(res)
+                _log_metric(logs, m, _update_metric(m, res))
             cbks.call("on_eval_batch_end", i, logs)
         final = {}
         if losses:
             final["loss"] = float(np.mean(losses))
         for m in self._metrics:
-            final[m.name()] = m.accumulate()
+            _log_metric(final, m, m.accumulate())
         cbks.call("on_eval_end", final)
         return final
 
@@ -223,11 +295,25 @@ class Model:
 
     # -- persistence (reference save:1310/load:1387) ---------------------
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: inference export via jit.save (serialized
+        StableHLO, the reference's save_inference_model role) — needs
+        Model(inputs=[InputSpec...])."""
         import paddle_tpu as paddle
 
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if not training:
+            specs = [s for s in self._inputs_spec
+                     if hasattr(s, "shape")]
+            if not specs:
+                raise RuntimeError(
+                    "Model.save(training=False) exports an inference "
+                    "module and needs Model(inputs=[InputSpec(...)])")
+            self.network.eval()
+            paddle.jit.save(self.network, path, input_spec=specs)
+            return
         paddle.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             paddle.save(self._optimizer.state_dict(), path + ".pdopt")

@@ -14,7 +14,14 @@ import jax.numpy as jnp
 from paddle_tpu.ops import registry as _registry
 from paddle_tpu.ops.registry import register_emitter as _register
 
-__all__ = ["fused_rotary_position_embedding", "fused_rms_norm", "swiglu"]
+from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: F401
+    block_multihead_attention, paged_attention,
+    variable_length_memory_efficient_attention,
+)
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm", "swiglu",
+           "variable_length_memory_efficient_attention",
+           "paged_attention", "block_multihead_attention"]
 
 
 @_register(name="swiglu")

@@ -1,0 +1,216 @@
+"""Block / variable-length attention (serving-path attention variants).
+
+Reference: python/paddle/incubate/nn/functional/
+block_multihead_attention.py (paged KV-cache attention over fused CUDA
+kernels) and variable_length_memory_efficient_attention.py (cutlass
+memory-efficient varlen attention).
+
+TPU-native redesign:
+* ``variable_length_memory_efficient_attention`` — per-sequence length
+  masking composed into one batched softmax-attention einsum; XLA fuses
+  the mask+softmax+matmul chain (the "memory-efficient" part the
+  reference gets from cutlass), and the long-sequence path is the
+  Pallas flash kernel (ops/pallas/flash_attention.py).
+* ``paged_attention`` / ``block_multihead_attention`` — the KV cache
+  lives in fixed-size blocks indexed by a per-sequence block table
+  (vLLM-style paging); block gathers are XLA dynamic-gathers and the
+  attention math is batched on the MXU. Functional semantics: updated
+  caches are RETURNED (the reference mutates them in place — in-place
+  cache update on TPU is XLA buffer donation at the jit boundary).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["variable_length_memory_efficient_attention",
+           "paged_attention", "block_multihead_attention"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(d):
+    return Tensor._from_data(d)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """query: (B, H, S, D); key/value: (B, KH, Sk, D) (KH may divide H —
+    GQA broadcast); seq_lens/kv_seq_lens: (B,) or (B,1) valid lengths.
+    Returns (B, H, S, D) with padding rows zeroed.
+
+    Reference: variable_length_memory_efficient_attention.py (cutlass
+    varlen kernel)."""
+    q = _data(query)
+    k = _data(key)
+    v = _data(value)
+    ql = _data(seq_lens).reshape(-1).astype(jnp.int32)
+    kl = _data(kv_seq_lens).reshape(-1).astype(jnp.int32)
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    if kh != h:
+        rep = h // kh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+    t = k.shape[2]
+    q_pos = jnp.arange(s)[None, :]                    # (1, S)
+    k_pos = jnp.arange(t)[None, :]                    # (1, Sk)
+    q_valid = q_pos < ql[:, None]                     # (B, S)
+    k_valid = k_pos < kl[:, None]                     # (B, Sk)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    att_mask = k_valid[:, None, None, :]              # (B,1,1,Sk)
+    if causal:
+        causal_m = (jnp.arange(s)[:, None] + pre_cache_length
+                    >= jnp.arange(t)[None, :])
+        att_mask = att_mask & causal_m[None, None]
+    logits = jnp.where(att_mask, logits, neg)
+    if mask is not None:
+        logits = logits + _data(mask).astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+    return _wrap(out * q_valid[:, None, :, None].astype(out.dtype))
+
+
+def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                    scale=None):
+    """Decode-phase attention over a paged KV cache.
+
+    q: (B, H, D) — one new token per sequence;
+    key_cache/value_cache: (num_blocks, block_size, KH, D);
+    block_tables: (B, max_blocks) int32 physical-block ids (-1 pads);
+    seq_lens: (B,) tokens already in cache (including the new one).
+    Returns (B, H, D)."""
+    qd = _data(q)
+    kc = _data(key_cache)
+    vc = _data(value_cache)
+    bt = _data(block_tables).astype(jnp.int32)
+    sl = _data(seq_lens).reshape(-1).astype(jnp.int32)
+    b, h, d = qd.shape
+    nb, bs, kh, _ = kc.shape
+    mb = bt.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    safe_bt = jnp.maximum(bt, 0)
+    # gather each sequence's blocks: (B, mb, bs, KH, D) -> (B, T, KH, D)
+    k_seq = kc[safe_bt].reshape(b, mb * bs, kh, d)
+    v_seq = vc[safe_bt].reshape(b, mb * bs, kh, d)
+    if kh != h:
+        rep = h // kh
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    logits = jnp.einsum("bhd,bthd->bht", qd, k_seq) * scale
+    pos = jnp.arange(mb * bs)[None, :]
+    valid = (pos < sl[:, None]) & (bt >= 0).repeat(bs, axis=1)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    logits = jnp.where(valid[:, None, :], logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return _wrap(jnp.einsum("bht,bthd->bhd", probs.astype(v_seq.dtype),
+                            v_seq))
+
+
+def _write_cache(cache, blocks, block_tables, positions):
+    """Scatter new K/V rows into their paged slots. positions: (B, S)
+    absolute token positions (-1 = skip); blocks: (B, S, KH, D)."""
+    bt = block_tables
+    bs = cache.shape[1]
+    blk = jnp.where(positions >= 0, positions // bs, 0)
+    off = jnp.where(positions >= 0, positions % bs, 0)
+    phys = jnp.take_along_axis(jnp.maximum(bt, 0), blk, axis=1)
+    valid = (positions >= 0)
+    flat_idx = phys * bs + off                     # (B, S)
+    cache_flat = cache.reshape(-1, *cache.shape[2:])
+    upd = blocks.reshape(-1, *blocks.shape[2:])
+    n_slots = cache_flat.shape[0]
+    # padded rows scatter to an out-of-range index and are DROPPED —
+    # routing them to slot 0 would clobber the real token-0 write when
+    # duplicate indices resolve against us
+    fi = jnp.where(valid.reshape(-1), flat_idx.reshape(-1), n_slots)
+    cache_flat = cache_flat.at[fi].set(upd, mode="drop")
+    return cache_flat.reshape(cache.shape)
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, block_tables, max_seq_len=None,
+        block_size=None, pre_key_cache=None, pre_value_cache=None,
+        rope_emb=None, mask=None, causal=True, num_heads=None,
+        kv_num_heads=None, head_dim=None) -> Tuple[Tensor, Tensor, Tensor]:
+    """Unified prefill/decode attention over a paged KV cache
+    (reference block_multihead_attention.py; the vLLM-style serving
+    attention). Two modes per sequence, chosen by the length tensors:
+
+    * prefill (seq_lens_encoder[b] > 0): the b-th sequence's S new
+      tokens attend causally among themselves; their K/V are written
+      into the paged cache.
+    * decode (seq_lens_decoder[b] > 0): one new token attends to the
+      whole cached prefix + itself.
+
+    qkv: (B, S, 3, H, D) packed (padded to the longest sequence this
+    step); returns (out (B, S, H, D), key_cache', value_cache').
+    Divergence (documented): caches are returned, not mutated; the
+    reference's int8/cachekv-quant variants ride the quantization
+    module instead."""
+    qkvd = _data(qkv)
+    kc = _data(key_cache)
+    vc = _data(value_cache)
+    bt = _data(block_tables).astype(jnp.int32)
+    enc = _data(seq_lens_encoder).reshape(-1).astype(jnp.int32)
+    dec = _data(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    now = _data(seq_lens_this_time).reshape(-1).astype(jnp.int32)
+    b, s, three, h, d = qkvd.shape
+    kh = kc.shape[2]
+    bs = kc.shape[1] if block_size is None else block_size
+    q = qkvd[:, :, 0]
+    # qkv carries H heads per slot (the caller unpacks (H+2*KH)-wide
+    # fused projections); GQA keeps the first kh K/V heads
+    k_new = qkvd[:, :, 1, :kh]
+    v_new = qkvd[:, :, 2, :kh]
+
+    # write new K/V into the cache at [start, start+now) where start is
+    # the already-cached prefix (decode) or 0 (prefill)
+    start = jnp.where(dec > 0, dec, 0)
+    pos = start[:, None] + jnp.arange(s)[None, :]
+    pos = jnp.where(jnp.arange(s)[None, :] < now[:, None], pos, -1)
+    kc = _write_cache(kc, k_new, bt, pos)
+    vc = _write_cache(vc, v_new, bt, pos)
+
+    # attention against the updated cache: every query token at
+    # absolute position p attends to cache positions <= p (causal)
+    total = jnp.where(dec > 0, dec + now, now)      # (B,) tokens valid
+    mb = bt.shape[1]
+    safe_bt = jnp.maximum(bt, 0)
+    k_seq = kc[safe_bt].reshape(b, mb * bs, kh, d)
+    v_seq = vc[safe_bt].reshape(b, mb * bs, kh, d)
+    if kh != h:
+        rep = h // kh
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    scale = 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k_seq) * scale
+    t = mb * bs
+    tpos = jnp.arange(t)[None, :]
+    cache_valid = (tpos < total[:, None]) & (bt >= 0).repeat(bs, axis=1)
+    att = cache_valid[:, None, None, :]
+    if causal:
+        qpos = pos  # (B, S) absolute positions (-1 pad)
+        cm = qpos[:, None, :, None] >= tpos[:, None, None, :]
+        att = att & cm
+    if mask is not None:
+        logits = logits + _data(mask).astype(logits.dtype)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    logits = jnp.where(att, logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs.astype(v_seq.dtype), v_seq)
+    q_valid = (jnp.arange(s)[None, :] < now[:, None])
+    out = out * q_valid[:, :, None, None].astype(out.dtype)
+    return _wrap(out), _wrap(kc), _wrap(vc)

@@ -50,7 +50,10 @@ class Accuracy(Metric):
     def update(self, correct):
         correct = np.asarray(correct.numpy() if isinstance(correct, Tensor)
                              else correct)
-        n = correct.shape[0]
+        # count ALL samples: the correct matrix is (..., maxk) where the
+        # leading dims are sample dims (a (B, S, k) seq batch counts B*S
+        # — counting shape[0] alone lets the ratio exceed 1.0)
+        n = int(np.prod(correct.shape[:-1]))
         for i, k in enumerate(self.topk):
             c = correct[..., :k].any(axis=-1).sum()
             self.total[i] += float(c)

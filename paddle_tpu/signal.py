@@ -1,0 +1,106 @@
+"""paddle.signal — short-time Fourier transform (reference:
+python/paddle/signal.py stft:183 / istft:326 over frame+fft kernels).
+
+TPU-native: framing is one strided gather (XLA WindowedGather fuses it),
+the FFT rides XLA's native fft HLO via paddle_tpu.fft emitters, and the
+istft overlap-add is a scatter-add — all static-shaped, jit-safe."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _frame(x, frame_length, hop_length):
+    """(..., T) -> (..., n_frames, frame_length) via strided gather."""
+    t = x.shape[-1]
+    n = 1 + (t - frame_length) // hop_length
+    idx = (jnp.arange(n)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None) -> Tensor:
+    """(..., T) -> complex (..., n_fft//2+1 or n_fft, n_frames)
+    (reference signal.py:183)."""
+    xd = _data(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), xd.dtype)
+    else:
+        win = _data(window).astype(xd.dtype)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    if center:
+        pad = n_fft // 2
+        cfg = [(0, 0)] * (xd.ndim - 1) + [(pad, pad)]
+        xd = jnp.pad(xd, cfg, mode=pad_mode)
+    frames = _frame(xd, n_fft, hop_length) * win  # (..., n, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+        else jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # paddle layout: (..., freq, n_frames)
+    return Tensor._from_data(jnp.swapaxes(spec, -1, -2))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None) -> Tensor:
+    """Inverse STFT with window-envelope-normalized overlap-add
+    (reference signal.py:326)."""
+    xd = _data(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _data(window).astype(jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    spec = jnp.swapaxes(xd, -1, -2)  # (..., n_frames, freq)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+        else jnp.fft.ifft(spec, axis=-1)
+    if not return_complex and jnp.iscomplexobj(frames):
+        frames = frames.real
+    frames = frames * win
+    n_frames = frames.shape[-2]
+    t = n_fft + hop_length * (n_frames - 1)
+    lead = frames.shape[:-2]
+    out = jnp.zeros(lead + (t,), frames.dtype)
+    env = jnp.zeros((t,), jnp.float32)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])        # (n, n_fft)
+    flat_idx = idx.reshape(-1)
+    add = frames.reshape(lead + (-1,))
+    out = out.at[..., flat_idx].add(add)
+    env = env.at[flat_idx].add(
+        jnp.broadcast_to(jnp.square(win), idx.shape).reshape(-1))
+    out = out / jnp.maximum(env, 1e-11)
+    if center:
+        out = out[..., n_fft // 2: t - n_fft // 2]
+    if length is not None:
+        # reference istft: trim OR zero-pad to the requested length
+        cur = out.shape[-1]
+        if cur >= length:
+            out = out[..., :length]
+        else:
+            cfg = [(0, 0)] * (out.ndim - 1) + [(0, length - cur)]
+            out = jnp.pad(out, cfg)
+    return Tensor._from_data(out)

@@ -16,7 +16,7 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io import Dataset
 from paddle_tpu.nn.layer import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+__all__ = ["Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16", "viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -186,3 +186,187 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram language-model dataset (reference
+    text/datasets/imikolov.py): real ptb.{train,valid,test}.txt parsing
+    when the simple-examples archive is present, synthetic Zipfian
+    n-grams otherwise."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        self.window_size = window_size
+        self.data_type = data_type
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/imikolov/simple-examples.tgz")
+        tokens = None
+        if os.path.exists(path):
+            import tarfile
+
+            split = {"train": "train", "valid": "valid",
+                     "test": "test"}[mode]
+            with tarfile.open(path, "r:gz") as tf:
+                # the vocabulary ALWAYS comes from the train split
+                # (reference imikolov.py build_dict) — ids must agree
+                # across train/valid/test
+                train_text = tf.extractfile(
+                    "./simple-examples/data/ptb.train.txt").read().decode()
+                text = train_text if split == "train" else tf.extractfile(
+                    f"./simple-examples/data/ptb.{split}.txt"
+                ).read().decode()
+            freq = {}
+            for w in train_text.split():
+                freq[w] = freq.get(w, 0) + 1
+            vocab = {w for w, c in freq.items() if c >= min_word_freq}
+            self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+            self.word_idx["<unk>"] = len(self.word_idx)
+            unk = self.word_idx["<unk>"]
+            tokens = [[self.word_idx.get(w, unk) for w in
+                       ln.split()] for ln in text.splitlines()]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab_size = 2000
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            # Zipf-ish token stream in sentences
+            probs = 1.0 / np.arange(1, vocab_size + 1)
+            probs /= probs.sum()
+            tokens = [rng.choice(vocab_size, size=rng.randint(8, 30),
+                                 p=probs).tolist() for _ in range(500)]
+        grams = []
+        for sent in tokens:
+            if len(sent) >= window_size:
+                for i in range(len(sent) - window_size + 1):
+                    grams.append(sent[i:i + window_size])
+        self.data = np.asarray(grams, np.int64)
+
+    def __getitem__(self, i):
+        g = self.data[i]
+        return g[:-1], g[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M rating triples (reference
+    text/datasets/movielens.py): real ml-1m.zip parsing when present,
+    synthetic preference matrix otherwise. Items are
+    (user_id, gender, age, job, movie_id, title_ids, categories,
+    rating) per the reference's feature layout — compressed here to the
+    ids + rating the models consume."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        path = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/movielens/ml-1m.zip")
+        if os.path.exists(path):
+            import zipfile
+
+            with zipfile.ZipFile(path) as zf:
+                raw = zf.read("ml-1m/ratings.dat").decode(
+                    "latin1").splitlines()
+            rows = [ln.split("::") for ln in raw if ln.strip()]
+            data = np.asarray([[int(u), int(m), float(r)]
+                               for u, m, r, _ in rows], np.float32)
+        else:
+            rng = np.random.RandomState(rand_seed)
+            n = 5000
+            users = rng.randint(1, 500, n)
+            movies = rng.randint(1, 800, n)
+            # low-rank preference structure so recommenders can learn
+            uf = rng.randn(500, 4)
+            mf = rng.randn(800, 4)
+            scores = (uf[users] * mf[movies]).sum(1)
+            ratings = np.clip(np.round(3 + scores), 1, 5)
+            data = np.stack([users, movies, ratings], 1).astype(
+                np.float32)
+        rng = np.random.RandomState(rand_seed)
+        idx = rng.permutation(len(data))
+        cut = int(len(data) * (1 - test_ratio))
+        sel = idx[:cut] if mode == "train" else idx[cut:]
+        self.data = data[sel]
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return (row[0:1].astype(np.int64), row[1:2].astype(np.int64),
+                row[2:3])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role-labeling dataset (reference
+    text/datasets/conll05.py). The real corpus is license-gated (the
+    reference downloads only the test split); synthetic tagged
+    sentences otherwise. Items: (word_ids, predicate_ids, label_ids)."""
+
+    NUM_LABELS = 67
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 max_len=30):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 400
+        self.vocab_size = 3000
+        sents, preds, labels = [], [], []
+        for _ in range(n):
+            ln = rng.randint(5, max_len)
+            sents.append(rng.randint(0, self.vocab_size, ln))
+            preds.append(np.full(ln, rng.randint(0, ln)))
+            labels.append(rng.randint(0, self.NUM_LABELS, ln))
+        self.sents, self.preds, self.labels = sents, preds, labels
+
+    def __getitem__(self, i):
+        return (self.sents[i].astype(np.int64),
+                self.preds[i].astype(np.int64),
+                self.labels[i].astype(np.int64))
+
+    def __len__(self):
+        return len(self.sents)
+
+
+class _WMTBase(Dataset):
+    """Shared parallel-corpus shape for WMT14/WMT16 (reference
+    text/datasets/wmt14.py, wmt16.py): (src_ids, trg_ids, trg_ids_next)
+    with <s>/<e>/<unk> special tokens."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    _SEED_BASE = 0
+    _OFFSET = 7
+
+    def __init__(self, mode="train", src_dict_size=3000,
+                 trg_dict_size=3000, lang="en"):
+        rng = np.random.RandomState(
+            self._SEED_BASE + (0 if mode == "train" else 1))
+        n = 300
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.pairs = []
+        for _ in range(n):
+            ln = rng.randint(4, 20)
+            src = rng.randint(3, src_dict_size, ln)
+            # deterministic "translation": reversed + offset (learnable)
+            trg = ((src[::-1] + self._OFFSET) % (trg_dict_size - 3)) + 3
+            self.pairs.append((src, trg))
+
+    def __getitem__(self, i):
+        src, trg = self.pairs[i]
+        t = np.concatenate([[self.BOS], trg])
+        t_next = np.concatenate([trg, [self.EOS]])
+        return (src.astype(np.int64), t.astype(np.int64),
+                t_next.astype(np.int64))
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_WMTBase):
+    pass
+
+
+class WMT16(_WMTBase):
+    # distinct corpus from WMT14 (different seed + mapping offset)
+    _SEED_BASE = 100
+    _OFFSET = 11

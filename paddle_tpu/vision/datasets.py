@@ -16,7 +16,7 @@ import numpy as np
 
 from paddle_tpu.io import Dataset
 
-__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "DatasetFolder",
+__all__ = ["Flowers", "VOC2012", "Cifar10", "Cifar100", "MNIST", "FashionMNIST", "DatasetFolder",
            "ImageFolder", "RandomImageDataset"]
 
 
@@ -194,3 +194,144 @@ def _default_loader(path):
             return np.asarray(img.convert("RGB"), dtype=np.float32) / 255.0
     except ImportError:
         raise RuntimeError("PIL unavailable; use .npy images")
+
+
+class Flowers(Dataset, _SyntheticImageMixin):
+    """Oxford-102 flowers (reference vision/datasets/flowers.py): real
+    archives when present (102flowers.tgz + imagelabels.mat +
+    setid.mat, parsed via Pillow/scipy), synthetic class-conditional
+    images otherwise."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        self.mode = mode
+        self.transform = transform
+        root = os.path.expanduser("~/.cache/paddle/dataset/flowers")
+        data_file = data_file or os.path.join(root, "102flowers.tgz")
+        label_file = label_file or os.path.join(root, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(root, "setid.mat")
+        if all(os.path.exists(p) for p in
+               (data_file, label_file, setid_file)):
+            self._load_real(data_file, label_file, setid_file, mode)
+        else:
+            n = 1020 if mode == "train" else 512
+            self.data, self.labels = self._make_synthetic(
+                n, (3, 64, 64), self.NUM_CLASSES,
+                seed=0 if mode == "train" else 1)
+            self._images = None
+
+    def _load_real(self, data_file, label_file, setid_file, mode):
+        from scipy.io import loadmat
+
+        labels = loadmat(label_file)["labels"][0]
+        setid = loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        ids = setid[key][0]
+        self._tar_path = data_file
+        self._tar = None       # opened lazily, per process (fork-safe)
+        self._tar_pid = None
+        self._ids = ids
+        self.labels = (labels[ids - 1] - 1).astype(np.int64)
+        self.data = None
+        self._images = {}
+
+    def _get_tar(self):
+        # DataLoader workers fork: a shared tarfile handle has a shared
+        # file offset, so concurrent reads interleave — every process
+        # opens its own handle. (r:gz re-decompresses per member; fine
+        # for preprocessing, use DatasetFolder for hot loops.)
+        if self._tar is None or self._tar_pid != os.getpid():
+            self._tar = tarfile.open(self._tar_path, "r:gz")
+            self._tar_pid = os.getpid()
+        return self._tar
+
+    def __getitem__(self, i):
+        if self.data is not None:
+            img, label = self.data[i], self.labels[i]
+        else:
+            from PIL import Image
+
+            idx = int(self._ids[i])
+            name = f"jpg/image_{idx:05d}.jpg"
+            f = self._get_tar().extractfile(name)
+            img = np.asarray(Image.open(f).convert("RGB"),
+                             np.float32).transpose(2, 0, 1) / 255.0
+            label = self.labels[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py): (image, label-mask) tuples from the
+    devkit tar when present, synthetic blob masks otherwise."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle/dataset/voc2012/VOCtrainval_11-May-2012.tar")
+        if os.path.exists(data_file):
+            self._load_real(data_file, mode)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 64
+            self.images = rng.uniform(
+                0, 1, size=(n, 3, 64, 64)).astype(np.float32)
+            masks = np.zeros((n, 64, 64), np.int64)
+            for i in range(n):
+                cy, cx = rng.randint(8, 56, 2)
+                cls = rng.randint(1, 21)
+                masks[i, cy - 8:cy + 8, cx - 8:cx + 8] = cls
+            self.masks = masks
+            self._tar = None
+
+    def _load_real(self, data_file, mode):
+        from PIL import Image  # noqa: F401 (needed at getitem)
+
+        self._tar_path = data_file
+        self._tar_pid = None
+        self._tar = tarfile.open(data_file, "r")
+        self._tar_pid = os.getpid()
+        base = "VOCdevkit/VOC2012"
+        split = {"train": "train", "valid": "val",
+                 "test": "trainval"}[mode]
+        lst = self._tar.extractfile(
+            f"{base}/ImageSets/Segmentation/{split}.txt")
+        self._names = [ln.strip().decode() for ln in lst.readlines()]
+        self._base = base
+
+    def _get_tar(self):
+        if self._tar is None or self._tar_pid != os.getpid():
+            self._tar = tarfile.open(self._tar_path, "r")
+            self._tar_pid = os.getpid()
+        return self._tar
+
+    def __getitem__(self, i):
+        if self._tar is None:
+            img, mask = self.images[i], self.masks[i]
+        else:
+            from PIL import Image
+
+            name = self._names[i]
+            tar = self._get_tar()
+            img = np.asarray(Image.open(tar.extractfile(
+                f"{self._base}/JPEGImages/{name}.jpg")).convert("RGB"),
+                np.float32).transpose(2, 0, 1) / 255.0
+            mask = np.asarray(Image.open(tar.extractfile(
+                f"{self._base}/SegmentationClass/{name}.png")),
+                np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.masks) if self._tar is None else len(self._names)

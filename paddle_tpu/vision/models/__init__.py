@@ -2,6 +2,8 @@
 from paddle_tpu.vision.models.resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
     wide_resnet50_2, wide_resnet101_2,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
 )
 from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
 from paddle_tpu.vision.models.alexnet import AlexNet, alexnet  # noqa: F401

@@ -169,3 +169,335 @@ class Pad:
         chw = img.shape[0] in (1, 3, 4)
         pads = [(0, 0), (p, p), (p, p)] if chw else [(p, p), (p, p), (0, 0)]
         return np.pad(img, pads, mode="constant")
+
+
+# ---------------------------------------------------------------------------
+# color / photometric ops (reference transforms.py BrightnessTransform:
+# ContrastTransform/SaturationTransform/HueTransform/ColorJitter/
+# Grayscale; host-side preprocessing, so numpy like the rest)
+# ---------------------------------------------------------------------------
+
+def _as_chw(img):
+    """Match the file's dual-layout convention (chw = shape[0] in
+    1/3/4): return (CHW float array, layout tag)."""
+    img = np.asarray(img, dtype=np.float32)
+    if img.ndim == 2:
+        return img[None], "hw"
+    if img.shape[0] in (1, 3, 4):
+        return img, "chw"
+    return img.transpose(2, 0, 1), "hwc"
+
+
+def _restore(img, fmt):
+    if fmt == "hw":
+        return img[0]
+    if fmt == "hwc":
+        return img.transpose(1, 2, 0)
+    return img
+
+
+def _chw_float(img):
+    img, fmt = _as_chw(img)
+    scale = 255.0 if img.max() > 1.5 else 1.0
+    return img / scale, scale, fmt
+
+
+def _rand_factor(delta):
+    return float(np.random.uniform(max(0.0, 1.0 - delta), 1.0 + delta))
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        x, scale, fmt = _chw_float(img)
+        out = np.clip(x * _rand_factor(self.value), 0.0, 1.0)
+        return _restore(out * scale, fmt)
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        x, scale, fmt = _chw_float(img)
+        mean = x.mean()
+        out = np.clip((x - mean) * _rand_factor(self.value) + mean,
+                      0.0, 1.0)
+        return _restore(out * scale, fmt)
+
+
+class SaturationTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        x, scale, fmt = _chw_float(img)
+        gray = (0.299 * x[0] + 0.587 * x[1] + 0.114 * x[2])[None]
+        out = np.clip(gray + (x - gray) * _rand_factor(self.value),
+                      0.0, 1.0)
+        return _restore(out * scale, fmt)
+
+
+def _rgb_to_hsv(x):
+    r, g, b = x[0], x[1], x[2]
+    mx = np.max(x, axis=0)
+    mn = np.min(x, axis=0)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    nz = d > 1e-8
+    idx = nz & (mx == r)
+    h[idx] = ((g - b)[idx] / d[idx]) % 6
+    idx = nz & (mx == g)
+    h[idx] = (b - r)[idx] / d[idx] + 2
+    idx = nz & (mx == b)
+    h[idx] = (r - g)[idx] / d[idx] + 4
+    h = h / 6.0
+    s = np.where(mx > 1e-8, d / np.maximum(mx, 1e-8), 0.0)
+    return np.stack([h, s, mx])
+
+
+def _hsv_to_rgb(x):
+    h, s, v = x[0] * 6.0, x[1], x[2]
+    i = np.floor(h).astype(np.int32) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r, g, b])
+
+
+class HueTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)  # in [0, 0.5]
+
+    def __call__(self, img):
+        x, scale, fmt = _chw_float(img)
+        hsv = _rgb_to_hsv(x)
+        shift = float(np.random.uniform(-self.value, self.value))
+        hsv[0] = (hsv[0] + shift) % 1.0
+        return _restore(np.clip(_hsv_to_rgb(hsv), 0.0, 1.0) * scale,
+                        fmt)
+
+
+class ColorJitter:
+    """reference transforms.py ColorJitter: random order of the four
+    photometric jitters."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0, keys=None):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        x, fmt = _as_chw(img)
+        gray = (0.299 * x[0] + 0.587 * x[1] + 0.114 * x[2])[None]
+        return _restore(np.repeat(gray, self.n, axis=0), fmt)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            img = np.asarray(img)
+            chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+            # vertical = flip the HEIGHT axis in either layout
+            return (img[:, ::-1, :] if chw else img[::-1]).copy()
+        return np.asarray(img)
+
+
+# ---------------------------------------------------------------------------
+# geometric warps (inverse-map bilinear resampling; reference uses cv2/
+# PIL backends — same math)
+# ---------------------------------------------------------------------------
+
+def _warp_affine(img, mat, fill=0.0):
+    """img: CHW; mat: 2x3 OUTPUT->INPUT affine (inverse map)."""
+    from scipy import ndimage
+
+    c, h, w = img.shape
+    out = np.empty_like(img, dtype=np.float32)
+    for ci in range(c):
+        out[ci] = ndimage.affine_transform(
+            img[ci].astype(np.float32), mat[:, :2], offset=mat[:, 2],
+            output_shape=(h, w), order=1, mode="constant", cval=fill)
+    return out
+
+
+def _center_affine(h, w, angle_deg, translate, scale, shear_deg):
+    """Build the OUTPUT->INPUT matrix for rotate/translate/scale/shear
+    about the image center."""
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    a = np.deg2rad(angle_deg)
+    sx = np.deg2rad(shear_deg[0])
+    sy = np.deg2rad(shear_deg[1])
+    # forward: T(center) R S Shear T(-center) + translate
+    rs = np.asarray([
+        [np.cos(a + sy), -np.sin(a + sx)],
+        [np.sin(a + sy), np.cos(a + sx)],
+    ]) * scale
+    # operate in (y, x): build the full forward matrix, then invert
+    fwd = np.eye(3)
+    fwd[:2, :2] = rs
+    fwd[0, 2] = cy - rs[0, 0] * cy - rs[0, 1] * cx + translate[1]
+    fwd[1, 2] = cx - rs[1, 0] * cy - rs[1, 1] * cx + translate[0]
+    bwd = np.linalg.inv(fwd)
+    return bwd[:2, :]
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        if np.isscalar(degrees):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        x, fmt = _as_chw(img)
+        ang = float(np.random.uniform(*self.degrees))
+        m = _center_affine(x.shape[1], x.shape[2], ang, (0, 0), 1.0,
+                           (0, 0))
+        return _restore(_warp_affine(x, m, fill=self.fill), fmt)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if np.isscalar(degrees):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def __call__(self, img):
+        x, fmt = _as_chw(img)
+        h, w = x.shape[1:]
+        ang = float(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = float(np.random.uniform(-self.translate[0],
+                                         self.translate[0]) * w)
+            ty = float(np.random.uniform(-self.translate[1],
+                                         self.translate[1]) * h)
+        sc = 1.0 if self.scale is None else \
+            float(np.random.uniform(*self.scale))
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shd = self.shear if not np.isscalar(self.shear) \
+                else (-abs(self.shear), abs(self.shear))
+            sh = (float(np.random.uniform(shd[0], shd[1])), 0.0)
+        m = _center_affine(h, w, ang, (tx, ty), sc, sh)
+        return _restore(_warp_affine(x, m, fill=self.fill), fmt)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        from scipy import ndimage
+
+        x, fmt = _as_chw(img)
+        if np.random.rand() >= self.prob:
+            return _restore(x, fmt)
+        c, h, w = x.shape
+        d = self.distortion_scale
+        dx = w * d / 2.0
+        dy = h * d / 2.0
+        src = np.asarray([[0, 0], [0, w - 1], [h - 1, 0],
+                          [h - 1, w - 1]], np.float64)
+        dst = src + np.stack([
+            np.random.uniform(-dy, dy, 4),
+            np.random.uniform(-dx, dx, 4)], axis=1)
+        # homography dst->src (inverse map): solve 8-dof DLT
+        A, b = [], []
+        for (ys, xs), (yd, xd) in zip(src, dst):
+            A.append([yd, xd, 1, 0, 0, 0, -ys * yd, -ys * xd])
+            b.append(ys)
+            A.append([0, 0, 0, yd, xd, 1, -xs * yd, -xs * xd])
+            b.append(xs)
+        p = np.linalg.solve(np.asarray(A), np.asarray(b))
+        H = np.asarray([[p[0], p[1], p[2]], [p[3], p[4], p[5]],
+                        [p[6], p[7], 1.0]])
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        ones = np.ones_like(yy, np.float64)
+        pts = np.stack([yy, xx, ones]).reshape(3, -1)
+        mapped = H @ pts
+        my = (mapped[0] / mapped[2]).reshape(h, w)
+        mx = (mapped[1] / mapped[2]).reshape(h, w)
+        out = np.empty_like(x)
+        for ci in range(c):
+            out[ci] = ndimage.map_coordinates(
+                x[ci], [my, mx], order=1, mode="constant",
+                cval=self.fill)
+        return _restore(out, fmt)
+
+
+class RandomErasing:
+    """reference transforms.py RandomErasing (cutout with random box)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        x, fmt = _as_chw(img)
+        x = x.copy()
+        if np.random.rand() >= self.prob:
+            return _restore(x, fmt)
+        c, h, w = x.shape
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    x[:, i:i + eh, j:j + ew] = np.random.normal(
+                        size=(c, eh, ew))
+                else:
+                    x[:, i:i + eh, j:j + ew] = self.value
+                return _restore(x, fmt)
+        return _restore(x, fmt)
+
+
+__all__ += ["BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "Grayscale", "RandomVerticalFlip", "RandomRotation",
+            "RandomAffine", "RandomPerspective", "RandomErasing"]

@@ -1,0 +1,195 @@
+"""Serving-path attention variants (reference:
+incubate/nn/functional/block_multihead_attention.py,
+variable_length_memory_efficient_attention.py). References are dense
+numpy attention with explicit masks."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _dense_attn(q, k, v, scale=None, causal=False, klen=None):
+    """numpy reference: q (H,S,D), k/v (H,T,D)."""
+    h, s, d = q.shape
+    t = k.shape[1]
+    scale = scale or 1.0 / np.sqrt(d)
+    logits = np.einsum("hsd,htd->hst", q, k) * scale
+    if klen is not None:
+        logits[:, :, klen:] = -1e30
+    if causal:
+        for i in range(s):
+            logits[:, i, i + 1:] = -1e30
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("hst,htd->hsd", p, v)
+
+
+def test_varlen_attention_matches_dense_per_sequence():
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 2, 6, 8
+    q = rng.normal(size=(b, h, s, d)).astype("float32")
+    k = rng.normal(size=(b, h, s, d)).astype("float32")
+    v = rng.normal(size=(b, h, s, d)).astype("float32")
+    lens = np.asarray([4, 6], "int32")
+    out = F.variable_length_memory_efficient_attention(
+        _t(q), _t(k), _t(v), _t(lens), _t(lens)).numpy()
+    for bi in range(b):
+        L = lens[bi]
+        ref = _dense_attn(q[bi, :, :L], k[bi, :, :L], v[bi, :, :L])
+        np.testing.assert_allclose(out[bi, :, :L], ref, rtol=2e-4,
+                                   atol=2e-5)
+        np.testing.assert_allclose(out[bi, :, L:], 0.0)  # padding zeroed
+
+
+def test_varlen_attention_gqa_broadcast():
+    rng = np.random.default_rng(1)
+    b, h, kh, s, d = 1, 4, 2, 5, 8
+    q = rng.normal(size=(b, h, s, d)).astype("float32")
+    k = rng.normal(size=(b, kh, s, d)).astype("float32")
+    v = rng.normal(size=(b, kh, s, d)).astype("float32")
+    lens = np.asarray([s], "int32")
+    out = F.variable_length_memory_efficient_attention(
+        _t(q), _t(k), _t(v), _t(lens), _t(lens)).numpy()
+    kk = np.repeat(k, 2, axis=1)
+    vv = np.repeat(v, 2, axis=1)
+    ref = _dense_attn(q[0], kk[0], vv[0])
+    np.testing.assert_allclose(out[0], ref, rtol=2e-4, atol=2e-5)
+
+
+def _fill_paged_cache(rng, b, lens, bs, kh, d, n_blocks):
+    """Build a paged cache + the equivalent dense K/V."""
+    mb = (max(lens) + bs - 1) // bs
+    kc = np.zeros((n_blocks, bs, kh, d), "float32")
+    vc = np.zeros((n_blocks, bs, kh, d), "float32")
+    bt = np.full((b, mb), -1, "int32")
+    dense_k = np.zeros((b, max(lens), kh, d), "float32")
+    dense_v = np.zeros((b, max(lens), kh, d), "float32")
+    nxt = 0
+    for bi in range(b):
+        for blk in range((lens[bi] + bs - 1) // bs):
+            bt[bi, blk] = nxt
+            n_tok = min(bs, lens[bi] - blk * bs)
+            kv = rng.normal(size=(n_tok, kh, d)).astype("float32")
+            vv = rng.normal(size=(n_tok, kh, d)).astype("float32")
+            kc[nxt, :n_tok] = kv
+            vc[nxt, :n_tok] = vv
+            dense_k[bi, blk * bs: blk * bs + n_tok] = kv
+            dense_v[bi, blk * bs: blk * bs + n_tok] = vv
+            nxt += 1
+    return kc, vc, bt, dense_k, dense_v
+
+
+def test_paged_attention_matches_dense():
+    rng = np.random.default_rng(2)
+    b, h, d, bs = 2, 2, 8, 4
+    lens = [6, 10]
+    kc, vc, bt, dk, dv = _fill_paged_cache(rng, b, lens, bs, h, d, 8)
+    q = rng.normal(size=(b, h, d)).astype("float32")
+    out = F.paged_attention(_t(q), _t(kc), _t(vc), _t(bt),
+                            _t(np.asarray(lens, "int32"))).numpy()
+    for bi in range(b):
+        L = lens[bi]
+        ref = _dense_attn(q[bi][:, None, :],
+                          dk[bi, :L].transpose(1, 0, 2),
+                          dv[bi, :L].transpose(1, 0, 2))
+        np.testing.assert_allclose(out[bi], ref[:, 0], rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    """Prefill writes the paged cache; a decode step then attends to
+    prefix+self and must match dense causal attention over the full
+    sequence."""
+    rng = np.random.default_rng(3)
+    b, h, d, bs, s = 1, 2, 8, 4, 6
+    n_blocks = 4
+    kc = np.zeros((n_blocks, bs, h, d), "float32")
+    vc = np.zeros((n_blocks, bs, h, d), "float32")
+    bt = np.asarray([[0, 1]], "int32")
+
+    qkv = rng.normal(size=(b, s, 3, h, d)).astype("float32")
+    out_p, kc2, vc2 = F.block_multihead_attention(
+        _t(qkv), _t(kc), _t(vc),
+        seq_lens_encoder=_t(np.asarray([s], "int32")),
+        seq_lens_decoder=_t(np.asarray([0], "int32")),
+        seq_lens_this_time=_t(np.asarray([s], "int32")),
+        block_tables=_t(bt), block_size=bs)
+    # prefill output == dense causal attention over the s tokens
+    ref = _dense_attn(qkv[0, :, 0].transpose(1, 0, 2),
+                      qkv[0, :, 1].transpose(1, 0, 2),
+                      qkv[0, :, 2].transpose(1, 0, 2), causal=True)
+    np.testing.assert_allclose(out_p.numpy()[0].transpose(1, 0, 2), ref,
+                               rtol=2e-4, atol=2e-5)
+
+    # decode one token
+    qkv_d = rng.normal(size=(b, 1, 3, h, d)).astype("float32")
+    out_d, kc3, vc3 = F.block_multihead_attention(
+        _t(qkv_d), kc2, vc2,
+        seq_lens_encoder=_t(np.asarray([0], "int32")),
+        seq_lens_decoder=_t(np.asarray([s], "int32")),
+        seq_lens_this_time=_t(np.asarray([1], "int32")),
+        block_tables=_t(bt), block_size=bs)
+    full_k = np.concatenate([qkv[0, :, 1], qkv_d[0, :, 1]], axis=0)
+    full_v = np.concatenate([qkv[0, :, 2], qkv_d[0, :, 2]], axis=0)
+    ref_d = _dense_attn(qkv_d[0, :, 0].transpose(1, 0, 2),
+                        full_k.transpose(1, 0, 2),
+                        full_v.transpose(1, 0, 2))
+    np.testing.assert_allclose(out_d.numpy()[0, 0], ref_d[:, 0],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_device_plugin_registry():
+    from paddle_tpu import device
+
+    with np.testing.assert_raises(ValueError):
+        device.register_backend("bad")  # neither path nor factory
+    name = device.register_backend(
+        "demo_backend", factory=lambda *a, **k: None)
+    assert name == "demo_backend"
+    assert "demo_backend" in device.registered_backends()
+    assert "demo_backend" in device.get_all_custom_device_type()
+    with np.testing.assert_raises(ValueError):
+        device.register_backend("demo_backend",
+                                factory=lambda *a, **k: None)
+
+
+def test_fused_allreduce_gradients_single_process_noop():
+    """World size 1: utility must be a no-op that leaves grads intact
+    (multi-process behavior is pinned by tests/mp_scripts)."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.utils import (
+        fused_allreduce_gradients, fused_parameters,
+    )
+
+    m = nn.Linear(4, 2)
+    out = m(paddle.ones([3, 4]))
+    paddle.sum(out).backward()
+    g0 = m.parameters()[0].grad.numpy().copy()
+    fused_allreduce_gradients(list(m.parameters()), group=None)
+    np.testing.assert_allclose(m.parameters()[0].grad.numpy(), g0)
+    groups = fused_parameters(m.parameters())
+    assert sum(len(g) for g in groups) == len(list(m.parameters()))
+
+
+def test_prefill_with_padding_keeps_token0():
+    """Padded qkv rows (seq_lens_this_time < S) must not clobber cached
+    K/V of real tokens (regression: pad rows scattered to slot 0)."""
+    rng = np.random.default_rng(5)
+    b, h, d, bs = 1, 2, 4, 4
+    kc = np.zeros((4, bs, h, d), "float32")
+    vc = np.zeros((4, bs, h, d), "float32")
+    bt = np.asarray([[0, 1]], "int32")
+    qkv = rng.normal(size=(b, 6, 3, h, d)).astype("float32")
+    _, kc2, vc2 = F.block_multihead_attention(
+        _t(qkv), _t(kc), _t(vc),
+        seq_lens_encoder=_t(np.asarray([3], "int32")),
+        seq_lens_decoder=_t(np.asarray([0], "int32")),
+        seq_lens_this_time=_t(np.asarray([3], "int32")),
+        block_tables=_t(bt), block_size=bs)
+    np.testing.assert_allclose(kc2.numpy()[0, 0], qkv[0, 0, 1],
+                               rtol=1e-6)  # token 0 intact
+    np.testing.assert_allclose(kc2.numpy()[0, 3], 0.0)  # pad not written

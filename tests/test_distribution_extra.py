@@ -1,0 +1,193 @@
+"""Wider distribution zoo + transforms + signal.stft/istft (reference:
+python/paddle/distribution/*.py, python/paddle/signal.py). Numeric
+references: scipy.stats where available, else closed forms."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+try:
+    from scipy import stats as S
+    HAVE_SCIPY = True
+except ImportError:
+    HAVE_SCIPY = False
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+@pytest.mark.skipif(not HAVE_SCIPY, reason="scipy unavailable")
+@pytest.mark.parametrize("dist,ref,xs", [
+    (lambda: D.Beta(2.0, 3.0), lambda: S.beta(2, 3), [0.2, 0.5, 0.9]),
+    (lambda: D.Gamma(2.0, 1.5), lambda: S.gamma(2, scale=1 / 1.5),
+     [0.5, 1.0, 3.0]),
+    (lambda: D.LogNormal(0.3, 0.8), lambda: S.lognorm(0.8,
+     scale=np.exp(0.3)), [0.5, 1.0, 2.0]),
+    (lambda: D.Cauchy(0.5, 2.0), lambda: S.cauchy(0.5, 2.0),
+     [-1.0, 0.5, 3.0]),
+    (lambda: D.StudentT(5.0, 0.0, 1.0), lambda: S.t(5), [-1.0, 0.0, 2.0]),
+    (lambda: D.Poisson(3.0), lambda: S.poisson(3.0), [0.0, 2.0, 5.0]),
+    (lambda: D.Geometric(0.3), lambda: S.geom(0.3, loc=-1),
+     [0.0, 1.0, 4.0]),
+    (lambda: D.Binomial(10.0, 0.4), lambda: S.binom(10, 0.4),
+     [0.0, 4.0, 10.0]),
+])
+def test_log_prob_matches_scipy(dist, ref, xs):
+    d, r = dist(), ref()
+    for x in xs:
+        got = float(_np(d.log_prob(paddle.to_tensor(np.float32(x)))))
+        want = r.logpmf(x) if hasattr(r, "pmf") else r.logpdf(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(not HAVE_SCIPY, reason="scipy unavailable")
+def test_dirichlet_and_multinomial_log_prob():
+    conc = np.asarray([1.5, 2.0, 3.0], "float32")
+    d = D.Dirichlet(paddle.to_tensor(conc))
+    x = np.asarray([0.2, 0.3, 0.5], "float32")
+    np.testing.assert_allclose(
+        float(_np(d.log_prob(paddle.to_tensor(x)))),
+        S.dirichlet(conc).logpdf(x), rtol=1e-4)
+    m = D.Multinomial(6, paddle.to_tensor(
+        np.asarray([0.2, 0.3, 0.5], "float32")))
+    counts = np.asarray([1.0, 2.0, 3.0], "float32")
+    np.testing.assert_allclose(
+        float(_np(m.log_prob(paddle.to_tensor(counts)))),
+        S.multinomial(6, [0.2, 0.3, 0.5]).logpmf([1, 2, 3]), rtol=1e-4)
+
+
+@pytest.mark.skipif(not HAVE_SCIPY, reason="scipy unavailable")
+def test_multivariate_normal_log_prob_and_sampling():
+    mu = np.asarray([1.0, -1.0], "float32")
+    cov = np.asarray([[2.0, 0.6], [0.6, 1.0]], "float32")
+    d = D.MultivariateNormal(paddle.to_tensor(mu), paddle.to_tensor(cov))
+    x = np.asarray([0.5, 0.0], "float32")
+    np.testing.assert_allclose(
+        float(_np(d.log_prob(paddle.to_tensor(x)))),
+        S.multivariate_normal(mu, cov).logpdf(x), rtol=1e-4)
+    paddle.seed(0)
+    s = _np(d.sample((20000,)))
+    np.testing.assert_allclose(s.mean(0), mu, atol=0.05)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+
+def test_sampling_moments():
+    paddle.seed(0)
+    g = D.Gamma(3.0, 2.0)
+    s = _np(g.sample((20000,)))
+    np.testing.assert_allclose(s.mean(), 1.5, atol=0.05)
+    b = D.Beta(2.0, 2.0)
+    np.testing.assert_allclose(_np(b.sample((20000,))).mean(), 0.5,
+                               atol=0.02)
+    p = D.Poisson(4.0)
+    np.testing.assert_allclose(_np(p.sample((20000,))).mean(), 4.0,
+                               atol=0.1)
+
+
+def test_kl_closed_forms_vs_monte_carlo():
+    paddle.seed(0)
+    for p, q in [(D.Gamma(2.0, 1.0), D.Gamma(3.0, 1.5)),
+                 (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+                 (D.Poisson(3.0), D.Poisson(5.0))]:
+        kl = float(_np(D.kl_divergence(p, q)))
+        s = p.sample((50000,))
+        mc = float(_np(p.log_prob(s) - q.log_prob(s)).mean())
+        np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02)
+        assert kl > 0
+
+
+def test_kl_base_pairs_still_work():
+    kl = float(_np(D.kl_divergence(D.Normal(0.0, 1.0),
+                                   D.Normal(1.0, 2.0))))
+    assert kl > 0
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(paddle.to_tensor(np.zeros((3, 4), "float32")),
+                    paddle.to_tensor(np.ones((3, 4), "float32")))
+    ind = D.Independent(base, 1)
+    x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+    lp = _np(ind.log_prob(x))
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(lp, _np(base.log_prob(x)).sum(-1),
+                               rtol=1e-6)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    """exp(Normal) through TransformedDistribution == LogNormal."""
+    td = D.TransformedDistribution(D.Normal(0.2, 0.7),
+                                   [D.ExpTransform()])
+    ln = D.LogNormal(0.2, 0.7)
+    for x in (0.5, 1.0, 2.5):
+        np.testing.assert_allclose(
+            float(_np(td.log_prob(paddle.to_tensor(np.float32(x))))),
+            float(_np(ln.log_prob(paddle.to_tensor(np.float32(x))))),
+            rtol=1e-5)
+
+
+def test_affine_chain_transform_roundtrip():
+    t = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                          D.TanhTransform()])
+    x = paddle.to_tensor(np.asarray([0.1, -0.3], "float32"))
+    y = t.forward(x)
+    back = t.inverse(y)
+    np.testing.assert_allclose(_np(back), _np(x), rtol=1e-4, atol=1e-6)
+
+
+def test_stickbreaking_simplex_roundtrip():
+    t = D.StickBreakingTransform()
+    x = paddle.to_tensor(np.asarray([0.3, -0.2, 0.5], "float32"))
+    y = _np(t.forward(x))
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    np.testing.assert_allclose(_np(t.inverse(paddle.to_tensor(y))),
+                               _np(x), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_flows_through_log_prob():
+    a = paddle.to_tensor(np.float32(2.0))
+    a.stop_gradient = False
+    d = D.Gamma(a, 1.0)
+    lp = d.log_prob(paddle.to_tensor(np.float32(1.5)))
+    lp.backward()
+    assert a.grad is not None and np.isfinite(float(a.grad._data))
+
+
+# ---------------------------------------------------------------------------
+# signal
+# ---------------------------------------------------------------------------
+
+def test_stft_istft_roundtrip():
+    paddle.seed(0)
+    t = 2048
+    x = np.random.default_rng(0).normal(size=(2, t)).astype("float32")
+    n_fft, hop = 256, 64
+    win = np.hanning(n_fft).astype("float32")
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft,
+                              hop_length=hop,
+                              window=paddle.to_tensor(win))
+    assert tuple(spec.shape)[:2] == (2, n_fft // 2 + 1)
+    back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                               window=paddle.to_tensor(win), length=t)
+    got = np.asarray(back._data)
+    # interior reconstruction exact (edges lose half-window coverage)
+    sl = slice(n_fft, t - n_fft)
+    np.testing.assert_allclose(got[:, sl], x[:, sl], rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_stft_matches_numpy_frame_dft():
+    x = np.random.default_rng(1).normal(size=(512,)).astype("float32")
+    n_fft, hop = 128, 32
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft,
+                              hop_length=hop, center=False)
+    got = np.asarray(spec._data)
+    n_frames = 1 + (512 - n_fft) // hop
+    assert got.shape == (n_fft // 2 + 1, n_frames)
+    for fi in (0, 3, n_frames - 1):
+        frame = x[fi * hop: fi * hop + n_fft]
+        ref = np.fft.rfft(frame)
+        np.testing.assert_allclose(got[:, fi], ref, rtol=1e-3, atol=1e-3)

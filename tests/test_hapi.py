@@ -133,3 +133,102 @@ def test_visualdl_callback_records_scalars(tmp_path):
     tags = {l["tag"] for l in lines}
     assert any(t.startswith("train/loss") for t in tags)
     assert all({"tag", "step", "value"} <= set(l) for l in lines)
+
+
+def test_model_prepare_amp_and_fit():
+    """prepare(amp_configs='O1') trains under bf16 autocast with the
+    compiled step (reference model.py prepare amp_configs)."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = hapi.Model(net)
+    model.prepare(optimizer.Adam(0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), amp_configs="O1")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype("float32")
+    Y = rng.integers(0, 4, (32,)).astype("int64")
+    ds = [(X[i:i + 8], Y[i:i + 8]) for i in range(0, 32, 8)]
+    hist = model.fit(ds, epochs=6, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_model_train_metrics_in_fit():
+    Accuracy = metric.Accuracy
+
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    model = hapi.Model(net)
+    model.prepare(optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), metrics=Accuracy())
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(16, 4)).astype("float32")
+    Y = rng.integers(0, 3, (16,)).astype("int64")
+    ds = [(X[i:i + 4], Y[i:i + 4]) for i in range(0, 16, 4)]
+    logs = {}
+
+    class Grab(hapi.Callback):
+        def on_train_batch_end(self, step, l=None):
+            logs.update(l or {})
+
+    model.fit(ds, epochs=1, verbose=0, callbacks=[Grab()], log_freq=1)
+    assert "acc" in logs, f"train metrics missing from logs: {logs}"
+
+
+def test_model_save_inference_and_reload(tmp_path):
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(0)
+    net = nn.Linear(6, 2)
+    model = hapi.Model(net, inputs=[InputSpec([4, 6], "float32")])
+    path = str(tmp_path / "exp" / "m")
+    model.save(path, training=False)
+    loaded = paddle.jit.load(path)
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype("float32")
+    got = loaded(paddle.to_tensor(x))
+    ref = net(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._data),
+                               np.asarray(ref._data), rtol=1e-5)
+
+
+def test_model_save_inference_without_spec_raises(tmp_path):
+    model = hapi.Model(nn.Linear(2, 2))
+    with pytest.raises(RuntimeError):
+        model.save(str(tmp_path / "x"), training=False)
+
+
+def test_accuracy_counts_all_sample_dims():
+    """A (B, S, k) correct matrix counts B*S samples — the ratio can
+    never exceed 1.0 (regression: shape[0]-only counting)."""
+    acc = metric.Accuracy()
+    pred = np.zeros((2, 4, 3), "float32")
+    pred[..., 1] = 1.0  # argmax = class 1 everywhere
+    label = np.ones((2, 4), "int64")
+    acc.update(acc.compute(paddle.to_tensor(pred),
+                           paddle.to_tensor(label)))
+    assert acc.accumulate() == 1.0
+    assert acc.count[0] == 8
+
+
+def test_fit_with_multi_topk_accuracy():
+    """Accuracy(topk=(1,2)) names a list; fit/evaluate must fan values
+    out instead of using the list as a dict key."""
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    model = hapi.Model(net)
+    model.prepare(optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  metrics=metric.Accuracy(topk=(1, 2)))
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(16, 4)).astype("float32")
+    Y = rng.integers(0, 3, (16,)).astype("int64")
+
+    class DS(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+    model.fit(DS(), batch_size=4, epochs=1, verbose=0, log_freq=1)
+    ev = model.evaluate(DS(), batch_size=4, verbose=0)
+    assert "acc_top1" in ev and "acc_top2" in ev
+    assert 0.0 <= ev["acc_top1"] <= ev["acc_top2"] <= 1.0
