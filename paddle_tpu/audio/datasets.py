@@ -34,6 +34,35 @@ def _read_wav(path):
 
 
 class _SyntheticAudioMixin:
+    """Shared: synthetic tones, feature extraction, item access —
+    the files-vs-synthetic split is identical for every audio
+    dataset."""
+
+    def _featurize(self, x):
+        if self.feat_type == "raw":
+            return x
+        import paddle_tpu as paddle
+        from paddle_tpu.audio import features as AF
+
+        layer = {"spectrogram": AF.Spectrogram,
+                 "melspectrogram": AF.MelSpectrogram,
+                 "logmelspectrogram": AF.LogMelSpectrogram,
+                 "mfcc": AF.MFCC}[self.feat_type](**self.feat_kwargs)
+        return np.asarray(
+            layer(paddle.to_tensor(x[None]))._data)[0]
+
+    def __getitem__(self, i):
+        if self._files is not None:
+            path, label = self._files[i]
+            x, _ = _read_wav(path)
+        else:
+            x, label = self._waves[i], int(self._labels[i])
+        return self._featurize(x), np.int64(label)
+
+    def __len__(self):
+        return len(self._files) if self._files is not None \
+            else len(self._waves)
+
     def _make_synthetic(self, n, n_classes, sr, dur, seed):
         rng = np.random.RandomState(seed)
         t = np.arange(int(sr * dur)) / sr
@@ -49,7 +78,7 @@ class _SyntheticAudioMixin:
         return waves, np.asarray(labels, np.int64)
 
 
-class TESS(Dataset, _SyntheticAudioMixin):
+class TESS(_SyntheticAudioMixin, Dataset):
     """Toronto emotional speech set (reference audio/datasets/tess.py):
     7 emotion classes; (waveform, label) or (feature, label) when
     ``feat_type`` is a paddle.audio feature name."""
@@ -89,33 +118,8 @@ class TESS(Dataset, _SyntheticAudioMixin):
                 seed=0 if mode == "train" else 1)
             self._files = None
 
-    def _featurize(self, x):
-        if self.feat_type == "raw":
-            return x
-        import paddle_tpu as paddle
-        from paddle_tpu.audio import features as AF
 
-        layer = {"spectrogram": AF.Spectrogram,
-                 "melspectrogram": AF.MelSpectrogram,
-                 "logmelspectrogram": AF.LogMelSpectrogram,
-                 "mfcc": AF.MFCC}[self.feat_type](**self.feat_kwargs)
-        return np.asarray(
-            layer(paddle.to_tensor(x[None]))._data)[0]
-
-    def __getitem__(self, i):
-        if self._files is not None:
-            path, label = self._files[i]
-            x, _ = _read_wav(path)
-        else:
-            x, label = self._waves[i], int(self._labels[i])
-        return self._featurize(x), np.int64(label)
-
-    def __len__(self):
-        return len(self._files) if self._files is not None \
-            else len(self._waves)
-
-
-class ESC50(Dataset, _SyntheticAudioMixin):
+class ESC50(_SyntheticAudioMixin, Dataset):
     """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
     50 classes, fold-based split from meta/esc50.csv when the real
     tree is present."""
@@ -149,16 +153,3 @@ class ESC50(Dataset, _SyntheticAudioMixin):
                 seed=0 if mode == "train" else 1)
             self._files = None
 
-    _featurize = TESS._featurize
-
-    def __getitem__(self, i):
-        if self._files is not None:
-            path, label = self._files[i]
-            x, _ = _read_wav(path)
-        else:
-            x, label = self._waves[i], int(self._labels[i])
-        return self._featurize(x), np.int64(label)
-
-    def __len__(self):
-        return len(self._files) if self._files is not None \
-            else len(self._waves)

@@ -8,8 +8,8 @@ from paddle_tpu.distributed.env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
 from paddle_tpu.distributed.mesh import (  # noqa: F401
-    Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh, get_mesh,
-    init_mesh, set_mesh,
+    Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh,
+    create_hybrid_mesh, get_mesh, init_mesh, set_mesh,
 )
 from paddle_tpu.distributed.api import (  # noqa: F401
     DistModel, ShardDataloader, ShardingStage1, ShardingStage2,

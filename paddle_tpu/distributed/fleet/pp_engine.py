@@ -234,6 +234,53 @@ class PipelineTrainStep:
         self._wd_warm = None  # last batch shapes (compile detection)
 
     # ------------------------------------------------------------------
+    def _rotated_forward(self, body_pd, h_mbs, key, remat):
+        """Rotate microbatches through the body stack — the ONE
+        microbatch-rotation forward, shared by training (chunk_loss)
+        and inference (predict) so the two cannot diverge."""
+        mesh = self._mesh
+        jmesh = mesh.jax_mesh()
+        S, V = self.S, self.V
+        n_body = len(self._body_layer_params)
+        pp_axis = self._pp_axis
+        body_apply = self._body_template_apply
+
+        def body_block(params_leaves, h):
+            def layer_step(hh, leaves):
+                out, _ = body_apply(list(leaves), [], key, hh)
+                return out, None
+
+            step = jax.checkpoint(layer_step) if remat else layer_step
+            h, _ = lax.scan(step, h, tuple(params_leaves))
+            return h
+
+        if S > 1:
+            if V > 1:
+                Lvl = (n_body // S) // V
+
+                def vapply(leaves, s, hh):
+                    sub = tuple(l[s * Lvl:(s + 1) * Lvl]
+                                for l in leaves)
+                    return body_block(sub, hh)
+
+                def spmd_body(body_leaves, mbs):
+                    return pipeline_forward_interleaved(
+                        vapply, body_leaves, mbs, S, V, pp_axis)
+            else:
+                def spmd_body(body_leaves, mbs):
+                    return pipeline_forward(
+                        lambda lp, hh: body_block(lp, hh),
+                        body_leaves, mbs, S, pp_axis)
+
+            body_specs = tuple(PartitionSpec(pp_axis) for _ in body_pd)
+            return jax.shard_map(
+                spmd_body, mesh=jmesh,
+                in_specs=(body_specs, PartitionSpec()),
+                out_specs=PartitionSpec(),
+                axis_names={pp_axis},
+                check_vma=False)(tuple(body_pd), h_mbs)
+        return jax.vmap(lambda mb: body_block(body_pd, mb))(h_mbs)
+
     def _make_step_fn(self):
         mesh = self._mesh
         jmesh = mesh.jax_mesh()
@@ -247,15 +294,6 @@ class PipelineTrainStep:
         loss_fn = self._loss_fn
         opt = self._opt
         remat = self._remat
-
-        def body_block(params_leaves, h, key):
-            def layer_step(hh, leaves):
-                out, _ = body_apply(list(leaves), [], key, hh)
-                return out, None
-
-            step = jax.checkpoint(layer_step) if remat else layer_step
-            h, _ = lax.scan(step, h, tuple(params_leaves))
-            return h
 
         def step_fn(carry, pre_p, body_p, post_p, pre_s, body_s, post_s,
                     pre_b, post_b, lr, scaler_state, x, y):
@@ -282,38 +320,8 @@ class PipelineTrainStep:
                 # microbatch: [B, ...] -> [CM, B/CM, ...]
                 B = h.shape[0]
                 h_mbs = h.reshape((CM, B // CM) + h.shape[1:])
-
-                if S > 1:
-                    if V > 1:
-                        # VPP: each rank's shard holds V virtual-stage
-                        # chunks of Lvl layers (rank-major reorder)
-                        Lvl = (n_body // S) // V
-
-                        def vapply(leaves, s, hh):
-                            sub = tuple(
-                                l[s * Lvl:(s + 1) * Lvl] for l in leaves)
-                            return body_block(sub, hh, k2)
-
-                        def spmd_body(body_leaves, mbs):
-                            return pipeline_forward_interleaved(
-                                vapply, body_leaves, mbs, S, V, pp_axis)
-                    else:
-                        def spmd_body(body_leaves, mbs):
-                            return pipeline_forward(
-                                lambda lp, hh: body_block(lp, hh, k2),
-                                body_leaves, mbs, S, pp_axis)
-
-                    body_specs = tuple(
-                        PartitionSpec(pp_axis) for _ in body_pd)
-                    out_mbs = jax.shard_map(
-                        spmd_body, mesh=jmesh,
-                        in_specs=(body_specs, PartitionSpec()),
-                        out_specs=PartitionSpec(),
-                        axis_names={pp_axis},
-                        check_vma=False)(tuple(body_pd), h_mbs)
-                else:
-                    out_mbs = jax.vmap(
-                        lambda mb: body_block(body_pd, mb, k2))(h_mbs)
+                out_mbs = self._rotated_forward(body_pd, h_mbs, k2,
+                                                remat)
                 h2 = out_mbs.reshape((B,) + out_mbs.shape[2:])
                 out, new_post_b = post_apply(post_pd, post_bufs, k3, h2)
                 outs = out if isinstance(out, tuple) else (out,)
@@ -530,6 +538,82 @@ class PipelineTrainStep:
         (same shape for the reverse/backward rotation)."""
         ring = self.S * self.V
         return (ring - 1) / (self._chunk_mb + ring - 1)
+
+    def _make_infer_fn(self):
+        """Forward-only pipeline (the FleetExecutor distributed-inference
+        role — paddle/fluid/distributed/fleet_executor/fleet_executor.h:36
+        runs an actor/interceptor pipeline for static-graph inference;
+        here the whole microbatch rotation is ONE compiled forward)."""
+        mesh = self._mesh
+        CM = self._chunk_mb
+        pre_apply = self._pre_apply
+        post_apply = self._post_apply
+        shared_post = self._shared_post
+
+        def infer_fn(pre_p, body_p, post_p, pre_b, post_b, key, x):
+            set_current_mesh(mesh)
+            post_pd = [pre_p[shared_post[j]] if j in shared_post else p
+                       for j, p in enumerate(post_p)]
+            k1, k2, k3 = jax.random.split(key, 3)
+            h, _ = pre_apply(list(pre_p), list(pre_b), k1, x)
+            B = h.shape[0]
+            h_mbs = h.reshape((CM, B // CM) + h.shape[1:])
+            # the SAME rotation forward the train step uses
+            out_mbs = self._rotated_forward(list(body_p), h_mbs, k2,
+                                            remat=False)
+            h2 = out_mbs.reshape((B,) + out_mbs.shape[2:])
+            out, _ = post_apply(post_pd, list(post_b), k3, h2)
+            return out
+
+        return infer_fn
+
+    def predict(self, x):
+        """Compiled forward-only inference over the pp mesh: the batch is
+        split into the same microbatch rotation as training, with no
+        loss/grad/update — one dispatch per batch. Eval-mode semantics
+        (buffers are read, not written)."""
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        if xd.shape[0] % self._chunk_mb:
+            raise ValueError(
+                f"predict batch size {xd.shape[0]} must be a multiple "
+                f"of the microbatch count ({self._chunk_mb})")
+        jmesh = self._mesh.jax_mesh()
+        dp = self._dp_axis if self._dp_axis in self._mesh.dim_names \
+            else None
+        spec = [None] * xd.ndim
+        if dp:
+            spec[0] = dp
+        xsh = NamedSharding(jmesh, PartitionSpec(*spec))
+        xd = jax.device_put(xd, xsh)
+        if getattr(self, "_infer_jitted", None) is None:
+            self._infer_jitted = jax.jit(
+                self._make_infer_fn(),
+                in_shardings=(self._pre_sh, self._body_sh, self._post_sh,
+                              [self._repl] * len(self._pre_buffers),
+                              [self._repl] * len(self._post_buffers),
+                              self._repl, xsh),
+                out_shardings=self._repl)
+        key = gen.default_generator.next_key()
+        set_current_mesh(self._mesh)
+        # eval-mode semantics: .training is read at TRACE time inside the
+        # functionalized applies, so force eval around the call (only the
+        # first call traces; restoring after keeps the train loop intact)
+        was_training = self._pipe.training
+        self._pipe.eval()
+        try:
+            out = self._infer_jitted(
+                [p._data for p in self._pre_params], self._stacked_body,
+                [p._data for p in self._post_params],
+                [b._data for b in self._pre_buffers],
+                [b._data for b in self._post_buffers],
+                jax.device_put(key, self._repl), xd)
+        finally:
+            set_current_mesh(None)
+            if was_training:
+                self._pipe.train()
+        if isinstance(out, tuple):
+            return tuple(Tensor._from_data(o) for o in out)
+        return Tensor._from_data(out)
 
     def sync_params_to_model(self):
         """Write stacked body params back into the Layer objects (for

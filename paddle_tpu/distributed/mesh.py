@@ -221,3 +221,52 @@ def auto_mesh(*dim_names: str) -> ProcessMesh:
     """1-D mesh over every device (ICI-ordered)."""
     name = dim_names[0] if dim_names else "x"
     return init_mesh([len(jax.devices())], [name])
+
+
+def create_hybrid_mesh(ici_shape: Sequence[int],
+                       dcn_shape: Sequence[int],
+                       dim_names: Sequence[str]) -> ProcessMesh:
+    """Multi-slice mesh: ICI axes innermost, DCN (cross-slice) axes
+    outermost — the cross-mesh/DCN story for pods of pods.
+
+    The reference reaches multi-node scale by layering NCCL rings over
+    IB/ethernet (SURVEY.md §5 comm layering); on TPU the equivalent is
+    a device mesh whose per-slice submeshes ride ICI while the
+    outer axes ride the data-center network. Axis i spans
+    ``dcn_shape[i] * ici_shape[i]`` with the DCN factor outermost, so
+    collectives over an axis with dcn_shape[i]==1 NEVER cross slices —
+    the standard layout rule (put dp/pp on DCN axes, tp/sp on ICI).
+
+    Built on jax mesh_utils.create_hybrid_device_mesh when multiple
+    slices are visible; on a single slice (or the CPU test platform) it
+    degrades to the plain ICI-ordered mesh of the same logical shape.
+    """
+    ici_shape = list(ici_shape)
+    dcn_shape = list(dcn_shape)
+    if len(ici_shape) != len(dcn_shape) or \
+            len(ici_shape) != len(dim_names):
+        raise ValueError("ici_shape, dcn_shape and dim_names must have "
+                         "the same length")
+    devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    total = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh wants {total} devices, {len(devices)} visible")
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        dev_arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            allow_split_physical_axes=True)
+    else:
+        shape = [d * i for d, i in zip(dcn_shape, ici_shape)]
+        dev_arr = np.asarray(devices).reshape(shape)
+    ids = np.empty(dev_arr.shape, dtype=np.int64)
+    flat_ids = {id(d): i for i, d in enumerate(devices)}
+    for idx, d in np.ndenumerate(dev_arr):
+        ids[idx] = flat_ids[id(d)]
+    mesh = ProcessMesh(ids, dim_names=list(dim_names))
+    mesh._dcn_shape = dcn_shape
+    mesh._ici_shape = ici_shape
+    return mesh

@@ -474,8 +474,9 @@ class ContinuousBernoulli:
         near = jnp.abs(d - 0.5) < (self._lims[1] - 0.5)
         safe = jnp.where(near, 0.6, d)
         c = 2.0 * jnp.arctanh(1.0 - 2.0 * safe) / (1.0 - 2.0 * safe)
-        # 2nd-order Taylor around 0.5: C ≈ 2 + (4/3)(λ-1/2)^2
-        taylor = 2.0 + (4.0 / 3.0) * jnp.square(d - 0.5) * 4.0
+        # 2nd-order Taylor around 0.5: C(λ) = 2·atanh(u)/u with
+        # u = 1-2λ expands to 2 + (2/3)u² = 2 + (8/3)(λ-1/2)²
+        taylor = 2.0 + (8.0 / 3.0) * jnp.square(d - 0.5)
         return _t(jnp.log(jnp.where(near, taylor, c)))
 
     def log_prob(self, value):

@@ -160,6 +160,14 @@ def block_multihead_attention(
     Divergence (documented): caches are returned, not mutated; the
     reference's int8/cachekv-quant variants ride the quantization
     module instead."""
+    if rope_emb is not None or pre_key_cache is not None or \
+            pre_value_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: rope_emb / pre_key_cache / "
+            "pre_value_cache are not applied in this build — apply "
+            "rotary embeddings to qkv before the call "
+            "(incubate.nn.functional.fused_rotary_position_embedding) "
+            "and fold any prefix cache into key_cache/value_cache")
     qkvd = _data(qkv)
     kc = _data(key_cache)
     vc = _data(value_cache)

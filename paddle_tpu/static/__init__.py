@@ -63,6 +63,7 @@ __all__ = [
     "CompiledProgram", "ExecutionStrategy", "BuildStrategy", "gradients",
     "append_backward", "name_scope", "global_scope", "scope_guard",
     "InputSpec", "save_inference_model", "load_inference_model", "nn",
+    "cond", "while_loop",
 ]
 
 
@@ -112,6 +113,162 @@ class _Node:
         self.multi = multi
         self.n_out = n_out
 
+    def dep_syms(self):
+        return [ref for (_, _, ref) in self.slots
+                if isinstance(ref, tuple) and ref and ref[0] != "lit"]
+
+    def evaluate(self, resolve):
+        call = {}
+        for k, v in self.consts.items():
+            call[k] = list(v) if isinstance(v, list) else v
+        for (an, i, ref) in self.slots:
+            val = ref[1] if ref[0] == "lit" else resolve(ref)
+            if i is None:
+                call[an] = val
+            else:
+                call[an][i] = val
+        out = self.opdef.emitter(**call)
+        return tuple(out) if self.multi else (out,)
+
+
+class _CondNode:
+    """paddle.static.nn.cond lowered to jax.lax.cond: both branch
+    subgraphs are recorded at build time; at run the compiled step
+    evaluates one of them (reference: control-flow ops
+    paddle/fluid/operators/controlflow/conditional_block_op.cc —
+    here the select is inside the XLA program)."""
+
+    __slots__ = ("id", "pred", "true_nodes", "false_nodes", "true_outs",
+                 "false_outs", "n_out", "multi")
+
+    def __init__(self, nid, pred, true_nodes, false_nodes, true_outs,
+                 false_outs):
+        self.id = nid
+        self.pred = pred
+        self.true_nodes = true_nodes
+        self.false_nodes = false_nodes
+        self.true_outs = true_outs
+        self.false_outs = false_outs
+        self.n_out = len(true_outs)
+        self.multi = self.n_out > 1
+
+    def dep_syms(self):
+        deps = [self.pred]
+        internal = {n.id for n in self.true_nodes} | \
+                   {n.id for n in self.false_nodes}
+        for nodes, outs in ((self.true_nodes, self.true_outs),
+                            (self.false_nodes, self.false_outs)):
+            for n in nodes:
+                for s in n.dep_syms():
+                    if not (s[0] == _OP and s[1] in internal):
+                        deps.append(s)
+            for s in outs:
+                if not (s[0] == _OP and s[1] in internal):
+                    deps.append(s)
+        return deps
+
+    def evaluate(self, resolve):
+        pred_val = jnp.reshape(resolve(self.pred), ()).astype(bool)
+
+        def make(nodes, outs):
+            def branch(_):
+                sub = _SubResolver(nodes, resolve)
+                return tuple(sub(s) for s in outs)
+            return branch
+
+        return jax.lax.cond(pred_val,
+                            make(self.true_nodes, self.true_outs),
+                            make(self.false_nodes, self.false_outs),
+                            0)
+
+
+class _WhileNode:
+    """paddle.static.nn.while_loop lowered to jax.lax.while_loop: the
+    condition/body subgraphs are recorded ONCE over symbolic loop vars
+    (reference: operators/controlflow/while_op.cc re-runs the block
+    per iteration on the interpreter; here XLA owns the loop)."""
+
+    __slots__ = ("id", "cond_nodes", "cond_out", "body_nodes",
+                 "body_outs", "init_syms", "n_out", "multi")
+
+    def __init__(self, nid, cond_nodes, cond_out, body_nodes, body_outs,
+                 init_syms):
+        self.id = nid
+        self.cond_nodes = cond_nodes
+        self.cond_out = cond_out
+        self.body_nodes = body_nodes
+        self.body_outs = body_outs
+        self.init_syms = init_syms
+        self.n_out = len(init_syms)
+        self.multi = self.n_out > 1
+
+    def dep_syms(self):
+        deps = list(self.init_syms)
+        internal = {n.id for n in self.cond_nodes} | \
+                   {n.id for n in self.body_nodes}
+        for nodes, outs in ((self.cond_nodes, [self.cond_out]),
+                            (self.body_nodes, list(self.body_outs))):
+            for n in nodes:
+                for s in n.dep_syms():
+                    if s[0] == "loopvar":
+                        continue
+                    if not (s[0] == _OP and s[1] in internal):
+                        deps.append(s)
+            for s in outs:
+                if s[0] == "loopvar":
+                    continue
+                if not (s[0] == _OP and s[1] in internal):
+                    deps.append(s)
+        return deps
+
+    def evaluate(self, resolve):
+        init = tuple(resolve(s) for s in self.init_syms)
+        wid = self.id
+
+        def bind(carry):
+            def inner(sym):
+                if sym[0] == "loopvar" and sym[1] == wid:
+                    return carry[sym[2]]
+                return resolve(sym)
+            return inner
+
+        def cond_fn(carry):
+            sub = _SubResolver(self.cond_nodes, bind(carry))
+            return jnp.reshape(sub(self.cond_out), ()).astype(bool)
+
+        def body_fn(carry):
+            sub = _SubResolver(self.body_nodes, bind(carry))
+            return tuple(sub(s) for s in self.body_outs)
+
+        return jax.lax.while_loop(cond_fn, body_fn, init)
+
+
+class _SubResolver:
+    """Evaluate a subgraph node list lazily against an outer resolver."""
+
+    def __init__(self, nodes, outer):
+        self._by_id = {n.id: n for n in nodes}
+        self._order = nodes
+        self._outer = outer
+        self._env = {}
+        self._done = False
+
+    def _run_all(self):
+        if not self._done:
+            for n in self._order:
+                self._env[n.id] = n.evaluate(self)
+            self._done = True
+
+    def __call__(self, sym):
+        if sym[0] == _OP and sym[1] in self._by_id:
+            if sym[1] not in self._env:
+                # topological record order: during _run_all earlier
+                # nodes are already in _env, so this only triggers on
+                # the first outside touch
+                self._run_all()
+            return self._env[sym[1]][sym[2]]
+        return self._outer(sym)
+
 
 class Program:
     """Recorded op list + captured eager state (reference:
@@ -135,8 +292,30 @@ class Program:
         self._cache: Dict[tuple, Any] = {}
         self.random_seed = None
         self._family = self  # shared identity across clone() programs
+        self._by_id: Dict[int, "_Node"] = {}  # all nodes incl. subgraphs
+        self._node_seq = 0
+        self._sink: Optional[List] = None  # non-None: recording a subgraph
 
     # -- build-time plumbing ----------------------------------------------
+    def _next_nid(self) -> int:
+        self._node_seq += 1
+        return self._node_seq
+
+    def _append(self, node):
+        self._by_id[node.id] = node
+        (self._sink if self._sink is not None else self.nodes).append(node)
+
+    @contextlib.contextmanager
+    def _capture_subgraph(self):
+        """Record subsequent ops into a side list (cond/while branches)
+        instead of the main node list."""
+        prev, sub = self._sink, []
+        self._sink = sub
+        try:
+            yield sub
+        finally:
+            self._sink = prev
+
     def _register_sds(self, sds, sym):
         self._sds_syms[id(sds)] = sym
         self._sds_keep.append(sds)
@@ -212,6 +391,8 @@ class Program:
             p.side_updates = []
             p._train = None
             p._family = self._family
+            p._by_id = dict(self._by_id)
+            p._node_seq = self._node_seq
         return p
 
 
@@ -346,8 +527,9 @@ def _record_hook(opdef, args, kwargs):
 
     multi = isinstance(out_aval, (tuple, list))
     outs_av = list(out_aval) if multi else [out_aval]
-    node = _Node(len(prog.nodes), opdef, slots, consts, multi, len(outs_av))
-    prog.nodes.append(node)
+    node = _Node(prog._next_nid(), opdef, slots, consts, multi,
+                 len(outs_av))
+    prog._append(node)
     prog._bump()
 
     out_vars = [Variable._make(prog, (_OP, node.id, i), av,
@@ -407,11 +589,24 @@ def data(name, shape, dtype="float32", lod_level=0) -> Variable:
 def gradients(targets, inputs, target_gradients=None):
     """Symbolic grads of sum(targets) wrt inputs (reference:
     paddle.static.gradients / append_backward). Returns Variables
-    fetchable through Executor.run."""
+    fetchable through Executor.run.
+
+    Limitation (XLA contract): reverse-mode through
+    ``static.nn.while_loop`` is unsupported (lax.while_loop is not
+    reverse-differentiable); grads through ``static.nn.cond`` work.
+    Rewrite differentiable loops with a static trip count so they
+    unroll, or restructure with cond."""
     prog = default_main_program()
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     t_syms = [prog._sym_of(t) for t in targets]
+    for nid in _needed_nodes(prog, t_syms):
+        if isinstance(prog._by_id[nid], _WhileNode):
+            raise NotImplementedError(
+                "static.gradients through static.nn.while_loop is not "
+                "supported: XLA's while loop has no reverse-mode rule "
+                "(lax.while_loop). Use a static-trip-count Python loop "
+                "(unrolls at build) or static.nn.cond instead.")
     outs = []
     for x in inputs:
         x_sym = prog._sym_of(x)
@@ -458,8 +653,8 @@ def _needed_nodes(prog, syms):
         if nid in needed:
             continue
         needed.add(nid)
-        for (_, _, ref) in prog.nodes[nid].slots:
-            if isinstance(ref, tuple) and ref and ref[0] == _OP:
+        for ref in prog._by_id[nid].dep_syms():
+            if ref[0] == _OP:
                 stack.append(ref)
     return needed
 
@@ -467,7 +662,8 @@ def _needed_nodes(prog, syms):
 def _interpret(prog, targets, feed_env, cap_vals):
     """Evaluate the recorded node list (the PirInterpreter role —
     new_executor/pir_interpreter.cc:1344 — but emitting one traced JAX
-    computation that XLA schedules)."""
+    computation that XLA schedules; cond/while container nodes lower to
+    lax.cond / lax.while_loop)."""
     flat_targets = []
     for s in targets:
         if s[0] == _GRAD:
@@ -476,26 +672,19 @@ def _interpret(prog, targets, feed_env, cap_vals):
             flat_targets.append(s)
     needed = _needed_nodes(prog, flat_targets)
     env = {}
+
+    def resolve(sym):
+        return _resolve(sym, env, feed_env, cap_vals)
+
     for node in prog.nodes:
         if node.id not in needed:
             continue
-        call = {}
-        for k, v in node.consts.items():
-            call[k] = list(v) if isinstance(v, list) else v
-        for (an, i, ref) in node.slots:
-            val = ref[1] if ref[0] == "lit" else \
-                _resolve(ref, env, feed_env, cap_vals)
-            if i is None:
-                call[an] = val
-            else:
-                call[an][i] = val
-        out = node.opdef.emitter(**call)
-        env[node.id] = tuple(out) if node.multi else (out,)
+        env[node.id] = node.evaluate(resolve)
 
     def value_of(sym):
         if sym[0] == _GRAD:
             raise RuntimeError("grad syms resolved by caller")
-        return _resolve(sym, env, feed_env, cap_vals)
+        return resolve(sym)
 
     return value_of
 
@@ -922,5 +1111,84 @@ class _StaticNN:
         layer = nn.Embedding(size[0], size[1])
         return layer(x)
 
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        return cond(pred, true_fn, false_fn, name)
+
+    @staticmethod
+    def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+        return while_loop(cond_fn, body_fn, loop_vars, is_test, name)
+
 
 nn = _StaticNN()
+
+
+def _out_aval(v):
+    d = v._data
+    if isinstance(d, jax.ShapeDtypeStruct):
+        return d
+    return jax.ShapeDtypeStruct(d.shape, d.dtype)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Data-dependent branch in a Program (reference static/nn/
+    control_flow.py cond over conditional_block ops). Both branches are
+    recorded as subgraphs and lowered to ONE ``jax.lax.cond`` — the
+    branch select happens on device inside the compiled step."""
+    prog = default_main_program()
+    pred_sym = prog._sym_of(pred) if isinstance(pred, Tensor) else None
+    if pred_sym is None:
+        return true_fn() if bool(pred) else false_fn()
+    with prog._capture_subgraph() as t_nodes:
+        t_out = true_fn()
+    with prog._capture_subgraph() as f_nodes:
+        f_out = false_fn()
+    single = not isinstance(t_out, (list, tuple))
+    t_list = [t_out] if single else list(t_out)
+    f_list = [f_out] if not isinstance(f_out, (list, tuple)) else \
+        list(f_out)
+    if len(t_list) != len(f_list):
+        raise ValueError("cond branches must return the same structure")
+    t_syms = [prog._sym_of(v) for v in t_list]
+    f_syms = [prog._sym_of(v) for v in f_list]
+    node = _CondNode(prog._next_nid(), pred_sym, t_nodes, f_nodes,
+                     t_syms, f_syms)
+    prog._append(node)
+    prog._bump()
+    outs = [Variable._make(prog, (_OP, node.id, i), _out_aval(v),
+                           stop_gradient=False)
+            for i, v in enumerate(t_list)]
+    return outs[0] if single else outs
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Data-dependent loop in a Program (reference static/nn/
+    control_flow.py while_loop over while_op). The condition/body are
+    recorded ONCE over symbolic loop variables and lowered to
+    ``jax.lax.while_loop`` — loop-carried shapes must be invariant
+    (XLA's loop contract; the reference's interpreter re-runs the block
+    per iteration instead)."""
+    prog = default_main_program()
+    loop_vars = list(loop_vars)
+    init_syms = [prog._sym_of(v) for v in loop_vars]
+    wid = prog._next_nid()
+    lvs = [Variable._make(prog, ("loopvar", wid, i), _out_aval(v),
+                          stop_gradient=False)
+           for i, v in enumerate(loop_vars)]
+    with prog._capture_subgraph() as c_nodes:
+        c_out = cond_fn(*lvs)
+    with prog._capture_subgraph() as b_nodes:
+        b_out = body_fn(*lvs)
+    b_list = [b_out] if not isinstance(b_out, (list, tuple)) else \
+        list(b_out)
+    if len(b_list) != len(loop_vars):
+        raise ValueError(
+            "while_loop body must return one value per loop var")
+    node = _WhileNode(wid, c_nodes, prog._sym_of(c_out), b_nodes,
+                      [prog._sym_of(v) for v in b_list], init_syms)
+    prog._append(node)
+    prog._bump()
+    outs = [Variable._make(prog, (_OP, wid, i), _out_aval(v),
+                           stop_gradient=False)
+            for i, v in enumerate(loop_vars)]
+    return outs

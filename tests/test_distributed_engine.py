@@ -158,3 +158,25 @@ def test_dtensor_from_local_and_to_local():
     assert g.shape == [8, 4]
     back = dist.dtensor_to_local(g)
     assert back.shape == [1, 4]
+
+
+def test_create_hybrid_mesh_layout():
+    """ICI/DCN hybrid mesh: on a single slice it degrades to a plain
+    mesh of the product shape; axis sizes = dcn*ici with DCN outermost
+    (collectives on dcn=1 axes never cross slices)."""
+    import paddle_tpu.distributed as dist
+
+    mesh = dist.create_hybrid_mesh(
+        ici_shape=[1, 4], dcn_shape=[2, 1], dim_names=["dp", "tp"])
+    assert mesh.get_dim_size("dp") == 2
+    assert mesh.get_dim_size("tp") == 4
+    assert mesh._dcn_shape == [2, 1] and mesh._ici_shape == [1, 4]
+    # usable for real sharding: matmul over the tp axis compiles
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    jm = mesh.jax_mesh()
+    x = jax.device_put(jnp.ones((8, 8)),
+                       NamedSharding(jm, PartitionSpec("dp", "tp")))
+    assert float(x.sum()) == 64.0

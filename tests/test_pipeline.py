@@ -295,3 +295,46 @@ def test_pipeline_grad_scaler_inside_step():
     scaled = run(True)
     # scaling cancels in the update; finite-path numerics align
     np.testing.assert_allclose(plain, scaled, rtol=5e-4, atol=1e-6)
+
+
+def test_pipeline_predict_matches_single_device_forward():
+    """Forward-only compiled pipeline (FleetExecutor distributed-
+    inference role, fleet_executor.h:36): predict() over the pp mesh
+    must equal the plain eager forward."""
+    np.random.seed(1)
+    X = np.random.randn(8, 8).astype(np.float32)
+
+    paddle.seed(21)
+    pipe = build_pipe(n_stages=4)
+    pipe.eval()
+    ref = pipe(paddle.to_tensor(X)).numpy()
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=pipe.parameters())
+    step = PipelineTrainStep(pipe, nn.MSELoss(), opt, mesh,
+                             n_microbatches=4)
+    got = step.predict(paddle.to_tensor(X)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=1e-5)
+
+
+def test_pipeline_predict_after_training_steps():
+    """predict() sees the trained weights (shares the live param
+    arrays with the train step)."""
+    np.random.seed(2)
+    X = np.random.randn(8, 8).astype(np.float32)
+    Y = np.zeros((8, 8), np.float32)
+
+    paddle.seed(22)
+    pipe = build_pipe(n_stages=4)
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=pipe.parameters())
+    step = PipelineTrainStep(pipe, nn.MSELoss(), opt, mesh,
+                             n_microbatches=4)
+    before = step.predict(paddle.to_tensor(X)).numpy()
+    for _ in range(5):
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+    after = step.predict(paddle.to_tensor(X)).numpy()
+    # trained toward zero: outputs must shrink
+    assert np.abs(after).mean() < np.abs(before).mean()

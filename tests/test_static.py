@@ -313,3 +313,75 @@ def test_save_inference_model_batch_polymorphic(static_mode, tmp_path):
     for bs in (1, 4, 9):
         got = loaded.run({"x": np.ones((bs, 6), "float32")})
         assert got[0].shape == (bs, 3)
+
+
+def test_cond_branches_on_data(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4], "float32")
+        y = static.nn.cond(paddle.sum(x) > 0.0,
+                           lambda: x * 2.0,
+                           lambda: x - 10.0)
+    exe = static.Executor()
+    pos = exe.run(main, feed={"x": np.ones(4, "float32")},
+                  fetch_list=[y])
+    neg = exe.run(main, feed={"x": -np.ones(4, "float32")},
+                  fetch_list=[y])
+    np.testing.assert_allclose(pos[0], 2.0)
+    np.testing.assert_allclose(neg[0], -11.0)
+
+
+def test_while_loop_runs_to_condition(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4], "float32")
+        i0 = paddle.zeros([1])
+
+        def c(v, i):
+            return paddle.logical_and(paddle.sum(v) < 100.0,
+                                      i[0] < 10.0)
+
+        def b(v, i):
+            return [v * 2.0, i + 1.0]
+
+        z, n = static.nn.while_loop(c, b, [x, i0])
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.ones(4, "float32")},
+                  fetch_list=[z, n])
+    assert out[0].sum() >= 100 and int(out[1][0]) == 5  # 4*2^5=128
+
+
+def test_cond_nested_in_while_body(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        a = static.data("a", [1], "float32")
+
+        def c2(v, i):
+            return i[0] < 5.0
+
+        def b2(v, i):
+            w = static.nn.cond(v[0] > 10.0, lambda: v * 0.5,
+                               lambda: v + 3.0)
+            return [w, i + 1.0]
+
+        z2, _ = static.nn.while_loop(c2, b2, [a, paddle.zeros([1])])
+    exe = static.Executor()
+    (o,) = exe.run(main, feed={"a": np.asarray([1.0], "float32")},
+                   fetch_list=[z2])
+    np.testing.assert_allclose(o, 6.5)  # 1->4->7->10->13->6.5
+
+
+def test_gradients_flow_through_cond(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        y = static.nn.cond(paddle.sum(x) > 0.0,
+                           lambda: paddle.sum(x * x),
+                           lambda: paddle.sum(x * 3.0))
+        (gx,) = static.gradients([y], [x])
+    exe = static.Executor()
+    xs = np.asarray([1.0, 2.0, 3.0], "float32")
+    (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xs)  # true branch: d(sum x^2)=2x
+    (g2,) = exe.run(main, feed={"x": -xs}, fetch_list=[gx])
+    np.testing.assert_allclose(g2, 3.0)    # false branch: constant 3
