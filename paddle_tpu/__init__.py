@@ -140,3 +140,67 @@ from paddle_tpu import onnx  # noqa: F401,E402
 from paddle_tpu import quantization  # noqa: F401,E402
 from paddle_tpu import static  # noqa: F401,E402
 import paddle_tpu.signal  # noqa: F401,E402
+from paddle_tpu import version  # noqa: E402,F401
+from paddle_tpu import utils  # noqa: E402,F401
+from paddle_tpu import linalg  # noqa: E402,F401
+
+__version__ = version.full_version
+
+
+class iinfo:
+    """paddle.iinfo (reference: pybind iinfo over phi dtypes)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.dtype import to_jax
+
+        info = jnp.iinfo(to_jax(dtype))
+        self.max = int(info.max)
+        self.min = int(info.min)
+        self.bits = int(info.bits)
+        self.dtype = str(dtype)
+
+
+class finfo:
+    """paddle.finfo (reference: pybind finfo over phi dtypes)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.dtype import to_jax
+
+        # jnp.finfo handles ml_dtypes (bfloat16) where np.finfo cannot
+        info = jnp.finfo(to_jax(dtype))
+        self.max = float(info.max)
+        self.min = float(info.min)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = str(dtype)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """paddle.bucketize (reference tensor/search.py:1065): searchsorted
+    with 1-D boundaries."""
+    from paddle_tpu.ops.registry import API as _api
+
+    return _api["searchsorted"](sorted_sequence, x, out_int32=out_int32,
+                                right=right)
+
+
+def get_cuda_rng_state():
+    """Device RNG state list (reference get_cuda_rng_state returns one
+    state per GPU; here the threefry generator state — one device RNG
+    stream per process)."""
+    from paddle_tpu.core.generator import default_generator
+
+    return [default_generator.get_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from paddle_tpu.core.generator import default_generator
+
+    default_generator.set_state(state_list[0])

@@ -125,6 +125,27 @@ class Tensor:
         return ops.transpose(self, perm)
 
     # -- conversion ----------------------------------------------------
+    def cuda(self, device_id=None, blocking=True):
+        """Device-move parity (reference Tensor.cuda): arrays already
+        live on the accelerator PJRT picked; returns self."""
+        return self
+
+    def cpu(self):
+        import jax
+
+        try:
+            cpu0 = jax.devices("cpu")[0]
+            return Tensor._from_data(jax.device_put(self._data, cpu0),
+                                     stop_gradient=self.stop_gradient)
+        except RuntimeError:
+            return self
+
+    def tpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
     def numpy(self):
         d = self._data
         if d.dtype == jnp.bfloat16:

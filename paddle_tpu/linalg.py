@@ -1,0 +1,47 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — a
+re-export of tensor/linalg.py ops). The emitters live in
+ops/linalg.py; this module gives them their public namespace."""
+from paddle_tpu.ops.registry import API as _ops
+
+_NAMES = [
+    "cholesky", "cond", "det", "eigh", "eigvalsh", "inverse", "lstsq",
+    "lu", "matrix_power", "matrix_rank", "norm", "pinv", "qr",
+    "slogdet", "solve", "svd", "triangular_solve",
+]
+
+for _n in _NAMES:
+    if _n in _ops:
+        globals()[_n] = _ops[_n]
+
+# aliases matching the reference surface
+inv = _ops["inverse"]
+matmul = _ops["matmul"]
+
+
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition. XLA has no TPU
+    kernel for nonsymmetric eig (CPU-only in XLA, LAPACK geev); the
+    honest answers are eigh for symmetric/Hermitian input or a host
+    round-trip — silently substituting eigh would return wrong
+    eigenvalues."""
+    raise NotImplementedError(
+        "paddle.linalg.eig (nonsymmetric) has no TPU kernel; use "
+        "paddle.linalg.eigh for symmetric/Hermitian matrices, or "
+        "numpy.linalg.eig on x.numpy() for host-side decomposition")
+
+
+def _missing(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle.linalg.{name} is not implemented in the TPU build")
+
+    fn.__name__ = name
+    return fn
+
+
+multi_dot = _ops.get("multi_dot") or _missing("multi_dot")
+cholesky_solve = _ops.get("cholesky_solve") or _missing("cholesky_solve")
+householder_product = _ops.get("householder_product") or \
+    _missing("householder_product")
+
+__all__ = [n for n in _NAMES if n in _ops] + ["inv", "matmul", "eig"]

@@ -39,22 +39,6 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
                                mode=mode, align_corners=align_corners)
 
 
-def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, fixed_seed_offset=None,
-                    training=True, name=None):
-    """Parity with paddle.nn.functional.flash_attention (reference:
-    python/paddle/nn/functional/flash_attention.py). Dispatches to the
-    Pallas flash kernel on TPU when available, else the XLA fused softmax
-    path. Layout: [batch, seqlen, nheads, head_dim]."""
-    from paddle_tpu.ops import pallas_attention
-
-    out = pallas_attention.flash_attention(query, key, value, causal=causal,
-                                           dropout=dropout, training=training)
-    if return_softmax:
-        return out, None
-    return out, None
-
-
 def sequence_mask(lengths, maxlen=None, dtype="int64"):
     import jax.numpy as jnp
     from paddle_tpu.core.dtype import to_jax
@@ -76,3 +60,13 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 
 __all__ = _F_OPS + ["upsample", "flash_attention", "sequence_mask",
                     "label_smooth"]
+
+# module-path parity with the reference: the implementation lives in
+# the flash_attention SUBMODULE; re-importing the names here makes
+# `F.flash_attention` the function (python binds the from-import AFTER
+# importlib sets the submodule attribute on the package)
+from paddle_tpu.nn.functional.flash_attention import (  # noqa: E402
+    flash_attention, flash_attn_unpadded,
+)
+
+__all__ += ["flash_attn_unpadded"]

@@ -1,0 +1,91 @@
+"""paddle.nn.functional.flash_attention module surface (reference:
+python/paddle/nn/functional/flash_attention.py — flash_attention,
+flash_attn_unpadded, scaled_dot_product_attention over the CUDA
+flash-attn kernels; here the Pallas flash kernel / fused attention
+already behind nn.functional).
+
+Import rules match the reference: ``from paddle.nn.functional.
+flash_attention import flash_attention`` works, and the package-level
+``paddle.nn.functional.flash_attention`` callable stays the FUNCTION
+(the package __init__ rebinds it after importing this module)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["flash_attention", "flash_attn_unpadded",
+           "scaled_dot_product_attention"]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """Parity with paddle.nn.functional.flash_attention (reference:
+    python/paddle/nn/functional/flash_attention.py). Dispatches to the
+    Pallas flash kernel on TPU when available, else the XLA fused
+    softmax path. Layout: [batch, seqlen, nheads, head_dim]."""
+    from paddle_tpu.ops import pallas_attention
+
+    out = pallas_attention.flash_attention(
+        query, key, value, causal=causal, dropout=dropout,
+        training=training)
+    return out, None
+
+
+def __getattr__(name):
+    # the package defines this one; importing eagerly here would be
+    # circular (this module loads during the package __init__)
+    if name == "scaled_dot_product_attention":
+        import paddle_tpu.nn.functional as F
+
+        return F.__dict__[name]
+    raise AttributeError(name)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """Varlen (packed ragged) attention (reference
+    flash_attention.py flash_attn_unpadded). The packed (total_tokens,
+    H, D) layout is repacked host-side into a padded batch and handled
+    by the length-masked attention kernel — on TPU ragged layouts are
+    repadded anyway (static shapes), so this is the idiomatic lowering.
+    """
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.nn.functional import (
+        variable_length_memory_efficient_attention,
+    )
+
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    cq = np.asarray(cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
+                    else cu_seqlens_q).astype(np.int64)
+    ck = np.asarray(cu_seqlens_k._data if isinstance(cu_seqlens_k, Tensor)
+                    else cu_seqlens_k).astype(np.int64)
+    b = len(cq) - 1
+    h, d = q.shape[-2], q.shape[-1]
+    sq = int(max_seqlen_q)
+    sk = int(max_seqlen_k)
+    qb = jnp.zeros((b, sq, h, d), q.dtype)
+    kb = jnp.zeros((b, sk, h, d), k.dtype)
+    vb = jnp.zeros((b, sk, h, d), v.dtype)
+    for i in range(b):
+        qb = qb.at[i, : cq[i + 1] - cq[i]].set(q[cq[i]:cq[i + 1]])
+        kb = kb.at[i, : ck[i + 1] - ck[i]].set(k[ck[i]:ck[i + 1]])
+        vb = vb.at[i, : ck[i + 1] - ck[i]].set(v[ck[i]:ck[i + 1]])
+    qlens = jnp.asarray(cq[1:] - cq[:-1])
+    klens = jnp.asarray(ck[1:] - ck[:-1])
+    out = variable_length_memory_efficient_attention(
+        Tensor._from_data(qb.transpose(0, 2, 1, 3)),
+        Tensor._from_data(kb.transpose(0, 2, 1, 3)),
+        Tensor._from_data(vb.transpose(0, 2, 1, 3)),
+        Tensor._from_data(qlens), Tensor._from_data(klens),
+        scale=scale, causal=causal)
+    od = out._data.transpose(0, 2, 1, 3)  # (B, Sq, H, D)
+    parts = [od[i, : cq[i + 1] - cq[i]] for i in range(b)]
+    packed = Tensor._from_data(jnp.concatenate(parts, axis=0))
+    return packed, None

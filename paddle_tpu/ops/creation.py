@@ -133,3 +133,15 @@ def complex(real, imag):
 @op
 def polar(abs, angle):
     return abs * jnp.exp(1j * angle)
+
+
+@op("vander")
+def vander(x, n=None, increasing=False):
+    """Vandermonde matrix (reference: tensor/creation.py vander).
+    Integer inputs keep their dtype with EXACT integer powers (the
+    float path would round 3^2 to 9.000011 via exp/log)."""
+    cols = x.shape[0] if n is None else int(n)
+    p = jnp.arange(cols, dtype=x.dtype)
+    if not increasing:
+        p = p[::-1]
+    return jnp.power(x[:, None], p[None, :])

@@ -600,8 +600,18 @@ def gradients(targets, inputs, target_gradients=None):
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     t_syms = [prog._sym_of(t) for t in targets]
+    def _contains_while(node):
+        if isinstance(node, _WhileNode):
+            return True
+        for attr in ("true_nodes", "false_nodes", "cond_nodes",
+                     "body_nodes"):
+            for sub in getattr(node, attr, ()):
+                if _contains_while(sub):
+                    return True
+        return False
+
     for nid in _needed_nodes(prog, t_syms):
-        if isinstance(prog._by_id[nid], _WhileNode):
+        if _contains_while(prog._by_id[nid]):
             raise NotImplementedError(
                 "static.gradients through static.nn.while_loop is not "
                 "supported: XLA's while loop has no reverse-mode rule "

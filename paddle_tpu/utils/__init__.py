@@ -1,0 +1,117 @@
+"""paddle.utils (reference: python/paddle/utils/ — unique_name over the
+C++ name generator, deprecated decorator, try_import, download helpers).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import importlib
+import os
+import warnings
+
+__all__ = ["unique_name", "deprecated", "try_import", "run_check",
+           "download"]
+
+
+class _UniqueNameGenerator:
+    """reference base/unique_name.py: per-prefix counters with
+    guard/switch scoping."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def __call__(self, key="tmp"):
+        return self.generate(key)
+
+
+class _UniqueNameModule:
+    def __init__(self):
+        self._gen = _UniqueNameGenerator()
+
+    def generate(self, key="tmp"):
+        return self._gen.generate(key)
+
+    @contextlib.contextmanager
+    def guard(self, new_generator=None):
+        old = self._gen
+        self._gen = _UniqueNameGenerator()
+        try:
+            yield
+        finally:
+            self._gen = old
+
+    def switch(self, new_generator=None):
+        old = self._gen
+        self._gen = new_generator or _UniqueNameGenerator()
+        return old
+
+
+unique_name = _UniqueNameModule()
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """reference utils/deprecated.py decorator."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__!r} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to!r} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """reference utils/lazy_import.py: import or raise with guidance."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; "
+            f"pip install {module_name.split('.')[0]}") from e
+
+
+def run_check():
+    """reference utils/install_check.py: verify the runtime works by
+    compiling and running one small program on the active backend."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.jit(lambda x: (x @ x.T).sum())(jnp.ones((64, 64)))
+    backend = jax.default_backend()
+    assert float(out) == 64.0 * 64.0 * 64.0
+    print(f"PaddleTPU works! backend={backend}, "
+          f"devices={len(jax.devices())}")
+
+
+class _DownloadModule:
+    """reference utils/download.py — zero-egress build: resolves only
+    already-cached files, never fetches."""
+
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        cache = os.path.expanduser("~/.cache/paddle/weights")
+        path = os.path.join(cache, os.path.basename(url))
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"weights {os.path.basename(url)!r} are not cached at "
+                f"{cache} and this build has no network egress; place "
+                "the file there or load weights with set_state_dict")
+        return path
+
+
+download = _DownloadModule()

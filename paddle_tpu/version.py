@@ -1,0 +1,32 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py).
+
+The TPU build tracks the reference's API surface as of the 2.6→3.0-dev
+transition snapshot; `full_version` reflects that compatibility level.
+"""
+major = "3"
+minor = "0"
+patch = "0"
+rc = 0
+full_version = f"{major}.{minor}.{patch}"
+commit = "tpu-native"
+istaged = True
+
+cuda_version = "False"   # reference strings: version or 'False'
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,101 @@
+"""API-surface compatibility: paddle.version / iinfo / finfo /
+utils.unique_name / linalg namespace / bucketize / vander /
+Tensor.cuda-cpu / cuda RNG state / nn.functional.flash_attention module
+path (reference: python/paddle/version, pybind iinfo/finfo,
+utils/unique_name, python/paddle/linalg.py, tensor/search.py,
+nn/functional/flash_attention.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_version_surface():
+    assert paddle.__version__ == paddle.version.full_version
+    assert paddle.version.major.isdigit()
+    paddle.version.show()
+
+
+def test_iinfo_finfo():
+    assert paddle.iinfo(paddle.int32).max == 2**31 - 1
+    assert paddle.iinfo(paddle.int8).min == -128
+    fi = paddle.finfo(paddle.float32)
+    assert 1e-8 < fi.eps < 1e-6 and fi.bits == 32
+    bf = paddle.finfo(paddle.bfloat16)
+    assert bf.bits == 16 and bf.eps > fi.eps
+
+
+def test_unique_name_and_guard():
+    a = paddle.utils.unique_name.generate("fc")
+    b = paddle.utils.unique_name.generate("fc")
+    assert a != b
+    with paddle.utils.unique_name.guard():
+        c = paddle.utils.unique_name.generate("fc")
+        assert c == "fc_0"  # fresh scope
+    d = paddle.utils.unique_name.generate("fc")
+    assert d not in (a, b, c) or d != c
+
+
+def test_linalg_namespace():
+    x = paddle.to_tensor(np.asarray([[2.0, 0.0], [0.0, 3.0]], "float32"))
+    u, s, vt = paddle.linalg.svd(x)
+    np.testing.assert_allclose(np.sort(s.numpy()), [2.0, 3.0], rtol=1e-5)
+    inv = paddle.linalg.inv(x).numpy()
+    np.testing.assert_allclose(inv, [[0.5, 0.0], [0.0, 1.0 / 3.0]],
+                               rtol=1e-5)
+
+
+def test_bucketize_and_vander():
+    edges = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0, 4.0], "float32"))
+    got = paddle.bucketize(paddle.to_tensor(
+        np.asarray([0.5, 1.5, 3.7], "float32")), edges).numpy()
+    np.testing.assert_array_equal(got, [0, 1, 3])
+    v = paddle.vander(paddle.to_tensor(np.asarray([1.0, 2.0], "float32")),
+                      3, increasing=True).numpy()
+    np.testing.assert_allclose(v, [[1, 1, 1], [1, 2, 4]])
+
+
+def test_tensor_device_moves_and_rng_state():
+    t = paddle.ones([2, 2])
+    assert t.cuda() is t and t.tpu() is t and t.pin_memory() is t
+    c = t.cpu()
+    np.testing.assert_allclose(c.numpy(), 1.0)
+    st = paddle.get_cuda_rng_state()
+    a = paddle.randn([4]).numpy()
+    paddle.set_cuda_rng_state(st)
+    b = paddle.randn([4]).numpy()
+    np.testing.assert_allclose(a, b)  # state restore reproduces draws
+
+
+def test_flash_attention_module_path():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional.flash_attention import (
+        flash_attention, flash_attn_unpadded,
+    )
+
+    assert callable(F.flash_attention)  # function, not module
+    assert callable(flash_attention) and callable(flash_attn_unpadded)
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.normal(size=(6, 2, 8)).astype("float32"))
+    cu = paddle.to_tensor(np.asarray([0, 2, 6], "int32"))
+    out, _ = flash_attn_unpadded(q, q, q, cu, cu, 4, 4, causal=True)
+    assert tuple(out.numpy().shape) == (6, 2, 8)
+    # each packed sequence attends only within itself: compare seq 0
+    import paddle_tpu.ops.pallas_attention  # noqa: F401
+    qb = q.numpy()[:2][None].transpose(0, 2, 1, 3)
+    from paddle_tpu.incubate.nn.functional import (
+        variable_length_memory_efficient_attention as vlma,
+    )
+
+    ref = vlma(paddle.to_tensor(qb), paddle.to_tensor(qb),
+               paddle.to_tensor(qb),
+               paddle.to_tensor(np.asarray([2], "int32")),
+               paddle.to_tensor(np.asarray([2], "int32")),
+               causal=True).numpy()
+    np.testing.assert_allclose(out.numpy()[:2],
+                               ref[0].transpose(1, 0, 2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_run_check():
+    paddle.utils.run_check()
