@@ -303,8 +303,15 @@ class Tensor:
         return id(self)
 
     def __deepcopy__(self, memo):
-        # jax.Array is immutable; sharing the buffer is a correct deep copy
-        new = Tensor._from_data(self._data, stop_gradient=self.stop_gradient)
+        # Copy the BUFFER, not just the wrapper: value-wise sharing would
+        # be fine (jax.Array is immutable) but buffer identity leaks into
+        # donation — a TrainStep over deepcopy'd layers (TransformerEncoder
+        # clones) would pass the same buffer in two donated slots and XLA
+        # rejects `f(donate(a), donate(a))`.
+        import jax.numpy as jnp
+
+        new = Tensor._from_data(jnp.array(self._data, copy=True),
+                                stop_gradient=self.stop_gradient)
         new.__class__ = type(self)
         new.persistable = self.persistable
         memo[id(self)] = new

@@ -1,0 +1,106 @@
+"""BERT encoder family (models/bert.py) + the Tensor.__deepcopy__
+buffer-copy regression it exposed (TransformerEncoder clones layers via
+deepcopy; shared buffers broke whole-step donation)."""
+import copy
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models.bert import (
+    BertConfig, BertForMaskedLM, BertForSequenceClassification,
+    BertModel, BertPretrainingCriterion,
+)
+
+
+def _cfg():
+    return BertConfig(vocab_size=300, hidden_size=32,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      intermediate_size=64, max_position_embeddings=32)
+
+
+def test_bert_model_shapes_and_padding_mask():
+    paddle.seed(0)
+    m = BertModel(_cfg())
+    m.eval()
+    ids = paddle.to_tensor(np.asarray(
+        [[5, 6, 7, 0, 0], [8, 9, 10, 11, 12]], "int32"))
+    seq, pooled = m(ids)
+    assert tuple(seq.shape) == (2, 5, 32)
+    assert tuple(pooled.shape) == (2, 32)
+    # padding positions must not influence real ones: change a padded id
+    ids2 = paddle.to_tensor(np.asarray(
+        [[5, 6, 7, 99, 99], [8, 9, 10, 11, 12]], "int32"))
+    mask = paddle.to_tensor(np.asarray(
+        [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], "int32"))
+    s1, _ = m(ids, attention_mask=mask)
+    s2, _ = m(ids2, attention_mask=mask)
+    np.testing.assert_allclose(s1.numpy()[0, :3], s2.numpy()[0, :3],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bert_mlm_trains_and_ties_embeddings():
+    paddle.seed(0)
+    cfg = _cfg()
+    m = BertForMaskedLM(cfg)
+    crit = BertPretrainingCriterion(cfg)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(1, 300, (4, 16)).astype("int32"))
+    labels_np = np.full((4, 16), -100, "int32")
+    labels_np[:, 3] = np.asarray(rng.integers(1, 300, 4))
+    labels = paddle.to_tensor(labels_np)
+    opt = optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, crit, opt)
+    losses = [float(step(ids, labels)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    # tied: exactly one vocab x hidden matrix among the parameters
+    big = [p for p in m.parameters()
+           if tuple(p.shape) == (cfg.vocab_size, cfg.hidden_size)]
+    assert len(big) == 1
+
+
+def test_bert_classifier_forward():
+    paddle.seed(0)
+    cls = BertForSequenceClassification(_cfg(), num_classes=5)
+    ids = paddle.to_tensor(
+        np.random.default_rng(1).integers(1, 300, (3, 8)).astype("int32"))
+    out = cls(ids)
+    assert tuple(out.shape) == (3, 5)
+
+
+def test_encoder_layers_have_distinct_buffers():
+    """TransformerEncoder deep-copies its layer; copies must own their
+    buffers (identity sharing breaks XLA donation: donate(a), donate(a))."""
+    layer = nn.TransformerEncoderLayer(16, 2, 32)
+    clone = copy.deepcopy(layer)
+    for a, b in zip(layer.parameters(), clone.parameters()):
+        assert a._data is not b._data
+        np.testing.assert_allclose(np.asarray(a._data),
+                                   np.asarray(b._data))
+
+
+def test_trainstep_over_transformer_encoder():
+    """Regression: whole-step compile + donation over deepcopy-cloned
+    encoder layers (failed with 'donate the same buffer twice')."""
+    paddle.seed(0)
+    enc = nn.Sequential(
+        nn.Embedding(50, 16),
+        nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32), 2),
+        nn.Linear(16, 4))
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.enc = enc
+
+        def forward(self, x):
+            return self.enc(x)[:, 0]
+
+    m = Head()
+    opt = optimizer.Adam(1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.CrossEntropyLoss(), opt)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 50, (4, 8)).astype("int32"))
+    y = paddle.to_tensor(np.asarray([0, 1, 2, 3], "int64"))
+    losses = [float(step(ids, y)) for _ in range(10)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
