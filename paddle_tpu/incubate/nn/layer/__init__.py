@@ -1,0 +1,123 @@
+"""paddle.incubate.nn fused layers (reference:
+incubate/nn/layer/fused_transformer.py — FusedMultiHeadAttention:189,
+FusedFeedForward:483, FusedTransformerEncoderLayer:697 over handwritten
+fused CUDA kernels).
+
+TPU-native: the classes keep the reference's surface (pre/post
+normalization knob, fused residual+dropout semantics) but emit plain
+composed ops — XLA's fusion pass IS the fused kernel (the reference
+needs hand-fused CUDA because its eager executor can't fuse across op
+boundaries; a jitted step here fuses the whole block automatically).
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference fused_transformer.py:189 — attention with fused
+    qkv projection + residual + dropout + layernorm (pre or post)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-05,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.attn = nn.MultiHeadAttention(
+            embed_dim, num_heads, dropout=attn_dropout_rate,
+            kdim=kdim, vdim=vdim, need_weights=need_weights)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if key is not None and key is not query:
+            # the reference fused layer is self-attention only
+            # (fused_transformer.py:189 "only support self attention")
+            raise NotImplementedError(
+                "FusedMultiHeadAttention supports self-attention only "
+                "(matching the reference fused layer); use "
+                "nn.MultiHeadAttention for cross-attention")
+        residual = query
+        if self.normalize_before:
+            query = self.norm(query)
+        key = value = query
+        out = self.attn(query, key, value, attn_mask=attn_mask,
+                        cache=cache)
+        if cache is not None:
+            out, new_cache = out
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class FusedFeedForward(Layer):
+    """reference fused_transformer.py:483 — linear→act→dropout→linear
+    with fused residual + layernorm."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward)
+        self.linear2 = nn.Linear(dim_feedforward, d_model)
+        self.dropout1 = nn.Dropout(act_dropout_rate
+                                   if act_dropout_rate is not None
+                                   else dropout_rate)
+        self.dropout2 = nn.Dropout(dropout_rate)
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+        self._act = getattr(paddle.nn.functional, activation)
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        out = self.linear2(self.dropout1(self._act(self.linear1(src))))
+        out = residual + self.dropout2(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference fused_transformer.py:697 — FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward,
+                 dropout_rate=0.1, activation="relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate
+            if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -63,7 +63,7 @@ __all__ = [
     "CompiledProgram", "ExecutionStrategy", "BuildStrategy", "gradients",
     "append_backward", "name_scope", "global_scope", "scope_guard",
     "InputSpec", "save_inference_model", "load_inference_model", "nn",
-    "cond", "while_loop",
+    "cond", "while_loop", "py_func",
 ]
 
 
@@ -241,6 +241,89 @@ class _WhileNode:
             return tuple(sub(s) for s in self.body_outs)
 
         return jax.lax.while_loop(cond_fn, body_fn, init)
+
+
+class _PyFuncNode:
+    """paddle.static.nn.py_func lowered to jax.pure_callback: the host
+    python function runs INSIDE the compiled program at its graph
+    position (reference static/nn/common.py py_func registers a
+    host-side operator the executor calls back into). backward_func, if
+    given, rides jax.custom_vjp with its own host callback."""
+
+    __slots__ = ("id", "in_syms", "out_avals", "func", "backward_func",
+                 "skip_bwd_inputs", "n_out", "multi")
+
+    def __init__(self, nid, in_syms, out_avals, func, backward_func,
+                 skip_bwd_inputs=((), ())):
+        self.id = nid
+        self.in_syms = in_syms
+        self.out_avals = out_avals
+        self.func = func
+        self.backward_func = backward_func
+        # (skipped input positions, skipped output positions) for the
+        # backward_func argument list
+        self.skip_bwd_inputs = (frozenset(skip_bwd_inputs[0]),
+                                frozenset(skip_bwd_inputs[1]))
+        self.n_out = len(out_avals)
+        self.multi = self.n_out > 1
+
+    def dep_syms(self):
+        return list(self.in_syms)
+
+    def evaluate(self, resolve):
+        ins = [resolve(s) for s in self.in_syms]
+        avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in self.out_avals)
+        func = self.func
+        bwd_func = self.backward_func
+
+        def host_call(*arrs):
+            out = func(*[np.asarray(a) for a in arrs])
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(np.asarray(o, dtype=av.dtype)
+                         for o, av in zip(outs, avals))
+
+        if bwd_func is None:
+            return tuple(jax.pure_callback(host_call, avals, *ins))
+
+        n_in = len(self.in_syms)
+        n_out = len(avals)
+        skip = self.skip_bwd_inputs
+
+        @jax.custom_vjp
+        def call(*xs):
+            return tuple(jax.pure_callback(host_call, avals, *xs))
+
+        def fwd(*xs):
+            ys = call(*xs)
+            return ys, (xs, ys)
+
+        def bwd(res, gs):
+            xs, ys = res
+            in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                             for x in xs)
+
+            def host_bwd(*args):
+                # reference calling convention (static/nn/common.py):
+                # backward_func(inputs, outputs, out_grads) with the
+                # positions named in skip_vars_in_backward_input dropped
+                xs_np = [np.asarray(a) for a in args[:n_in]]
+                ys_np = [np.asarray(a) for a in args[n_in:n_in + n_out]]
+                gs_np = [np.asarray(a) for a in args[n_in + n_out:]]
+                fwd_args = [v for i, v in enumerate(xs_np)
+                            if i not in skip[0]] + \
+                           [v for i, v in enumerate(ys_np)
+                            if i not in skip[1]]
+                out = bwd_func(*(fwd_args + gs_np))
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                return tuple(np.asarray(o, dtype=av.dtype)
+                             for o, av in zip(outs, in_avals))
+
+            return tuple(jax.pure_callback(host_bwd, in_avals,
+                                           *xs, *ys, *gs))
+
+        call.defvjp(fwd, bwd)
+        return tuple(call(*ins))
 
 
 class _SubResolver:
@@ -1122,6 +1205,12 @@ class _StaticNN:
         return layer(x)
 
     @staticmethod
+    def py_func(func, x, out, backward_func=None,
+                skip_vars_in_backward_input=None):
+        return py_func(func, x, out, backward_func,
+                       skip_vars_in_backward_input)
+
+    @staticmethod
     def cond(pred, true_fn=None, false_fn=None, name=None):
         return cond(pred, true_fn, false_fn, name)
 
@@ -1169,6 +1258,62 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
                            stop_gradient=False)
             for i, v in enumerate(t_list)]
     return outs[0] if single else outs
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a host python function as a graph op (reference
+    static/nn/common.py py_func). ``out`` declares the result template:
+    Variables/Tensors, or (shape, dtype) tuples. ``backward_func``
+    receives (inputs, outputs, output_grads) with any variables listed
+    in ``skip_vars_in_backward_input`` dropped — the reference calling
+    convention — and makes the op differentiable (host-computed vjp).
+
+    Divergence (XLA purity contract): the host function is an op whose
+    OUTPUT must flow into a fetched value — a py_func used only for its
+    side effect (printing/logging) is dead code to the compiler and is
+    never called; fetch its output (or use paddle_tpu's profiler/debug
+    hooks) instead."""
+    prog = default_main_program()
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+
+    def _is_template(o):
+        return (isinstance(o, (tuple, list)) and len(o) == 2
+                and isinstance(o[0], (tuple, list))
+                and not isinstance(o[1], (tuple, list, Tensor)))
+
+    if _is_template(out):
+        outs = [tuple(out)]  # a single (shape, dtype) template
+    else:
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    in_syms = [prog._sym_of(v) for v in xs]
+    out_avals = []
+    for o in outs:
+        if isinstance(o, Tensor):
+            out_avals.append(_out_aval(o))
+        else:
+            shape, dt = o
+            out_avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                                  to_jax(dt)))
+    skip_in, skip_out = set(), set()
+    for v in (skip_vars_in_backward_input or []):
+        matched = False
+        for i, xv in enumerate(xs):
+            if v is xv:
+                skip_in.add(i)
+                matched = True
+        if not matched:
+            raise ValueError(
+                "skip_vars_in_backward_input entries must be py_func "
+                "input variables")
+    node = _PyFuncNode(prog._next_nid(), in_syms, out_avals, func,
+                       backward_func, (skip_in, skip_out))
+    prog._append(node)
+    prog._bump()
+    res = [Variable._make(prog, (_OP, node.id, i), av,
+                          stop_gradient=backward_func is None)
+           for i, av in enumerate(out_avals)]
+    return res[0] if len(res) == 1 else res
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):

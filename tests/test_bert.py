@@ -104,3 +104,52 @@ def test_trainstep_over_transformer_encoder():
     y = paddle.to_tensor(np.asarray([0, 1, 2, 3], "int64"))
     losses = [float(step(ids, y)) for _ in range(10)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_incubate_fused_transformer_layers():
+    """Fused-layer surface (reference incubate/nn/layer/
+    fused_transformer.py): pre/post-norm variants run and train."""
+    from paddle_tpu.incubate.nn import (
+        FusedFeedForward, FusedMultiHeadAttention,
+        FusedTransformerEncoderLayer,
+    )
+
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(2, 5, 16))
+        .astype("float32"))
+    for pre in (True, False):
+        attn = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0,
+                                       normalize_before=pre)
+        assert tuple(attn(x).shape) == (2, 5, 16)
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0,
+                               normalize_before=pre)
+        assert tuple(ffn(x).shape) == (2, 5, 16)
+    layer = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+    out = layer(x)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    grads = [p.grad for p in layer.parameters() if p.grad is not None]
+    assert grads, "fused layer must be trainable"
+
+
+def test_fused_attention_cache_and_cross_attention_guard():
+    from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+    paddle.seed(0)
+    attn = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    attn.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(1, 4, 16))
+        .astype("float32"))
+    other = paddle.to_tensor(np.zeros((1, 4, 16), "float32"))
+    try:
+        attn(x, other, other)
+        raised = False
+    except NotImplementedError:
+        raised = True
+    assert raised, "cross-attention must raise (self-attention only)"
+    out = attn(x)
+    assert tuple(out.shape) == (1, 4, 16)

@@ -385,3 +385,67 @@ def test_gradients_flow_through_cond(static_mode):
     np.testing.assert_allclose(g, 2 * xs)  # true branch: d(sum x^2)=2x
     (g2,) = exe.run(main, feed={"x": -xs}, fetch_list=[gx])
     np.testing.assert_allclose(g2, 3.0)    # false branch: constant 3
+
+
+def test_py_func_forward_and_backward(static_mode):
+    """Host python op inside the compiled program (reference
+    static/nn/common.py py_func) with a host-computed vjp."""
+
+    def host_fn(a):
+        return np.tanh(a) * 2.0
+
+    def host_bwd(a, y, g):
+        # reference convention: backward_func(inputs, outputs, grads)
+        return g * 2.0 * (1.0 - np.tanh(a) ** 2)
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        y = static.nn.py_func(host_fn, x, ([3], "float32"),
+                              backward_func=host_bwd)
+        loss = paddle.sum(y * y)
+        (gx,) = static.gradients([loss], [x])
+    exe = static.Executor()
+    xs = np.asarray([0.1, -0.5, 1.2], "float32")
+    out = exe.run(main, feed={"x": xs}, fetch_list=[y, gx])
+    ref_y = np.tanh(xs) * 2
+    np.testing.assert_allclose(out[0], ref_y, rtol=1e-5)
+    np.testing.assert_allclose(out[1],
+                               2 * ref_y * 2 * (1 - np.tanh(xs) ** 2),
+                               rtol=1e-4)
+
+
+def test_py_func_without_backward_stops_gradient(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = static.nn.py_func(lambda a: a * 3.0, x, ([2], "float32"))
+        assert y.stop_gradient
+    exe = static.Executor()
+    (o,) = exe.run(main, feed={"x": np.ones(2, "float32")},
+                   fetch_list=[y])
+    np.testing.assert_allclose(o, 3.0)
+
+
+def test_py_func_skip_vars_in_backward(static_mode):
+    """skip_vars_in_backward_input drops the named inputs from the
+    backward_func argument list (reference convention)."""
+
+    def host_fn(a):
+        return a * 4.0
+
+    def host_bwd(y, g):  # input `x` skipped: gets (outputs, grads)
+        return g * 4.0
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = static.nn.py_func(host_fn, x, ([2], "float32"),
+                              backward_func=host_bwd,
+                              skip_vars_in_backward_input=[x])
+        loss = paddle.sum(y)
+        (gx,) = static.gradients([loss], [x])
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.ones(2, "float32")},
+                  fetch_list=[gx])
+    np.testing.assert_allclose(out[0], 4.0)
