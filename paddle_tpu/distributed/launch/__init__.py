@@ -249,7 +249,12 @@ def main(argv=None):
     ap.add_argument("--elastic_dir", default=None,
                     help="shared dir for the elastic membership store "
                          "(the etcd role); default: a temp dir")
-    ap.add_argument("--hb_timeout", type=float, default=3.0)
+    try:
+        hb_default = float(os.environ.get(
+            "PADDLE_ELASTIC_HB_TIMEOUT") or 3.0)
+    except ValueError:
+        hb_default = 3.0
+    ap.add_argument("--hb_timeout", type=float, default=hb_default)
     ap.add_argument("--master", default=None,
                     help="coordinator endpoint host:port (default: "
                          "localhost with a free port — single node)")

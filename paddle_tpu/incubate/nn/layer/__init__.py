@@ -41,7 +41,9 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        if key is not None and key is not query:
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query
+                 and value is not key):
             # the reference fused layer is self-attention only
             # (fused_transformer.py:189 "only support self attention")
             raise NotImplementedError(
@@ -119,5 +121,9 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache)
+            return self.ffn(out), new_cache
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)

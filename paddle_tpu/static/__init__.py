@@ -300,8 +300,13 @@ class _PyFuncNode:
 
         def bwd(res, gs):
             xs, ys = res
-            in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
-                             for x in xs)
+            # integer/bool primals take float0 cotangents (custom_vjp
+            # contract); only float inputs get host-computed grads
+            diff_pos = [i for i, x in enumerate(xs)
+                        if jnp.issubdtype(x.dtype, jnp.floating)]
+            in_avals = tuple(jax.ShapeDtypeStruct(xs[i].shape,
+                                                  xs[i].dtype)
+                             for i in diff_pos)
 
             def host_bwd(*args):
                 # reference calling convention (static/nn/common.py):
@@ -319,8 +324,17 @@ class _PyFuncNode:
                 return tuple(np.asarray(o, dtype=av.dtype)
                              for o, av in zip(outs, in_avals))
 
-            return tuple(jax.pure_callback(host_bwd, in_avals,
-                                           *xs, *ys, *gs))
+            grads = jax.pure_callback(host_bwd, in_avals, *xs, *ys, *gs)
+            grads = list(grads) if isinstance(grads, (tuple, list)) \
+                else [grads]
+            full = []
+            gi = iter(grads)
+            for i, x in enumerate(xs):
+                if i in diff_pos:
+                    full.append(next(gi))
+                else:
+                    full.append(np.zeros(x.shape, jax.dtypes.float0))
+            return tuple(full)
 
         call.defvjp(fwd, bwd)
         return tuple(call(*ins))
@@ -1296,16 +1310,21 @@ def py_func(func, x, out, backward_func=None,
             out_avals.append(jax.ShapeDtypeStruct(tuple(shape),
                                                   to_jax(dt)))
     skip_in, skip_out = set(), set()
+    tensor_outs = [o for o in outs if isinstance(o, Tensor)]
     for v in (skip_vars_in_backward_input or []):
         matched = False
         for i, xv in enumerate(xs):
             if v is xv:
                 skip_in.add(i)
                 matched = True
+        for i, ov in enumerate(tensor_outs):
+            if v is ov:
+                skip_out.add(i)
+                matched = True
         if not matched:
             raise ValueError(
                 "skip_vars_in_backward_input entries must be py_func "
-                "input variables")
+                "input or output variables")
     node = _PyFuncNode(prog._next_nid(), in_syms, out_avals, func,
                        backward_func, (skip_in, skip_out))
     prog._append(node)

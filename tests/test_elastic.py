@@ -81,6 +81,9 @@ def test_elastic_scale_in_then_out(tmp_path):
                                               "elastic_worker.py"),
                  elastic_dir=elastic_dir, max_restart=5,
                  log_dir=str(tmp_path / "logs"))
+    # a loaded CI host can stall heartbeat threads past the 3 s default,
+    # which reads as a dead node and derails the scripted scale sequence
+    args.hb_timeout = 15.0
     extra = {"ELASTIC_TEST_DIR": out_dir,
              "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")}
 

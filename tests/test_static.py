@@ -449,3 +449,32 @@ def test_py_func_skip_vars_in_backward(static_mode):
     out = exe.run(main, feed={"x": np.ones(2, "float32")},
                   fetch_list=[gx])
     np.testing.assert_allclose(out[0], 4.0)
+
+
+def test_py_func_integer_input_gets_float0_cotangent(static_mode):
+    """Mixed float/int inputs: gradients flow to the float input; the
+    integer input takes a float0 cotangent (custom_vjp contract)."""
+
+    def host_fn(feats, idx):
+        return feats[idx]
+
+    def host_bwd(feats, idx, y, g):
+        out = np.zeros_like(feats)
+        out[np.asarray(idx)] = np.asarray(g)
+        return out
+
+    main = static.Program()
+    with static.program_guard(main):
+        feats = static.data("feats", [4], "float32")
+        idx = static.data("idx", [2], "int32")
+        y = static.nn.py_func(host_fn, [feats, idx], ([2], "float32"),
+                              backward_func=host_bwd)
+        loss = paddle.sum(y)
+        (gf,) = static.gradients([loss], [feats])
+    exe = static.Executor()
+    out = exe.run(main, feed={"feats": np.asarray([1., 2., 3., 4.],
+                                                  "float32"),
+                              "idx": np.asarray([1, 3], "int32")},
+                  fetch_list=[y, gf])
+    np.testing.assert_allclose(out[0], [2.0, 4.0])
+    np.testing.assert_allclose(out[1], [0.0, 1.0, 0.0, 1.0])
