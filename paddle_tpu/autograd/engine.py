@@ -89,7 +89,7 @@ class GradNode:
 
     __slots__ = (
         "name", "vjp_fn", "inputs", "out_avals", "pending", "n_expected",
-        "n_seen", "hooks", "__weakref__",
+        "n_seen", "hooks", "pure_fn", "primal_datas", "__weakref__",
     )
 
     def __init__(
@@ -98,11 +98,20 @@ class GradNode:
         vjp_fn: Callable,
         inputs: Sequence,  # list[Optional[Tensor]] — None for non-diff inputs
         out_avals: Sequence,  # list[jax.ShapeDtypeStruct] for each output
+        pure_fn: Optional[Callable] = None,
+        primal_datas: Optional[Sequence] = None,
     ):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
         self.out_avals = list(out_avals)
+        # create_graph support: the pure primal function and the primal
+        # values it was recorded with. ``jax.vjp(pure_fn, *primal_datas)``
+        # re-derives this node's backward differentiably, which is how
+        # double grad gets real tape nodes (reference: generated
+        # higher-order GradNodes, eager_gen.py; here one recursive rule).
+        self.pure_fn = pure_fn
+        self.primal_datas = list(primal_datas) if primal_datas is not None else None
         # filled during backward:
         self.pending: Optional[list] = None  # per-output accumulated cotangent
         self.n_expected = 0
@@ -130,16 +139,20 @@ class AccumulationNode:
         self.hooks: List[Callable] = []
 
 
-def register_node(outputs, name, vjp_fn, diff_inputs):
+def register_node(outputs, name, vjp_fn, diff_inputs, pure_fn=None,
+                  primal_datas=None):
     """Attach a fresh GradNode to op outputs.
 
     ``outputs``: list of Tensors produced by the op.
     ``diff_inputs``: list of Optional[Tensor] aligned with vjp inputs.
+    ``pure_fn``/``primal_datas``: optional differentiable re-derivation of
+    this node's backward (enables create_graph=True through it).
     """
     out_avals = [
         jax.ShapeDtypeStruct(o._data.shape, o._data.dtype) for o in outputs
     ]
-    node = GradNode(name, vjp_fn, diff_inputs, out_avals)
+    node = GradNode(name, vjp_fn, diff_inputs, out_avals,
+                    pure_fn=pure_fn, primal_datas=primal_datas)
     for i, o in enumerate(outputs):
         if not o.stop_gradient:
             o._grad_node = node
@@ -162,12 +175,26 @@ def _producer(tensor):
     return node
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, grad_targets=None):
     """Run reverse accumulation from ``tensors``.
 
     Mirrors ``egr::RunBackward`` (paddle/fluid/eager/backward.cc:105): build
     the in-degree map over reachable nodes, seed with the output cotangents,
     then ready-queue topological execution.
+
+    With ``create_graph=True`` every cotangent flows as a *Tensor* and each
+    node's backward is executed differentiably (a fresh GradNode is recorded
+    per grad computation), so the produced gradients carry tape nodes and
+    support further differentiation — the reference's double-grad contract
+    (python/paddle/base/dygraph/base.py:600-630, generated higher-order
+    nodes via eager_gen.py).
+
+    ``grad_targets`` (the GeneralGrad role, paddle/fluid/eager/
+    general_grad.h): when given, ``.grad`` is accumulated ONLY into those
+    tensors — leaf or interior — and other leaves are left untouched.
+    ``paddle.grad`` uses this so it never pollutes unrelated ``.grad``
+    accumulators.
     """
     from paddle_tpu.core.tensor import Tensor
 
@@ -177,6 +204,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
+
+    target_ids = (
+        {id(t) for t in grad_targets} if grad_targets is not None else None
+    )
 
     # ---- seed roots -----------------------------------------------------
     roots = {}
@@ -193,12 +224,29 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             gdata = jnp.ones_like(t._data)
         else:
             gdata = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            # keep the seed as a Tensor so downstream accumulation records
+            if isinstance(g, Tensor):
+                gdata = g
+            else:
+                gdata = Tensor._from_data(gdata, stop_gradient=True)
         if node is None:
-            _accumulate_leaf(t, gdata)
+            if target_ids is None or id(t) in target_ids:
+                _accumulate_leaf(t, gdata, create_graph=create_graph)
             continue
         idx = t._output_index
         slots = roots.setdefault(node, {})
-        slots[idx] = slots[idx] + gdata if idx in slots else gdata
+        slots[idx] = _acc_cot(slots.get(idx), gdata)
+
+    # interior targets are captured when their PRODUCER node executes —
+    # after node hooks fire, so the reported grad and the propagated grad
+    # agree (and root seeds are naturally included via the node's slots)
+    node_targets: dict = {}
+    if grad_targets is not None:
+        for t in grad_targets:
+            if t is not None and t._grad_node is not None:
+                node_targets.setdefault(id(t._grad_node), []).append(
+                    (t._output_index, t))
 
     if not roots:
         return
@@ -237,48 +285,63 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         executed.add(id(node))
         slots = pending.pop(id(node), {})
 
-        # build full cotangent tuple (zeros for outputs nobody needs;
-        # int/bool outputs take float0 tangents per JAX's convention)
-        cotangents = tuple(
-            slots.get(i, _zero_cotangent(av)) for i, av in enumerate(node.out_avals)
-        )
-        for hook in node.hooks:
-            cotangents = hook(cotangents)
-
         if node.vjp_fn is None:
             raise RuntimeError(
                 "Trying to run backward through the graph a second time, "
                 "but the saved residuals have already been freed. Pass "
                 "retain_graph=True to the first backward() if you need to "
                 "backward through this graph again.")
-        in_grads = node.vjp_fn(
-            cotangents if len(cotangents) > 1 else cotangents[0]
-        )
-        if not isinstance(in_grads, (tuple, list)):
-            in_grads = (in_grads,)
 
-        if not retain_graph:
+        captures = node_targets.get(id(node), ())
+        if create_graph:
+            in_grads = _run_node_create_graph(node, slots, captures)
+        else:
+            # build full cotangent tuple (zeros for outputs nobody needs;
+            # int/bool outputs take float0 tangents per JAX's convention)
+            cotangents = tuple(
+                slots.get(i, _zero_cotangent(av))
+                for i, av in enumerate(node.out_avals)
+            )
+            for hook in node.hooks:
+                cotangents = hook(cotangents)
+            for oi, t in captures:
+                if _is_float_dtype(node.out_avals[oi].dtype):
+                    _accumulate_leaf(t, cotangents[oi])
+            in_grads = node.vjp_fn(
+                cotangents if len(cotangents) > 1 else cotangents[0]
+            )
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+
+        if not (retain_graph or create_graph):
             node.vjp_fn = None  # free residuals
+            node.pure_fn = None
+            node.primal_datas = None
 
         for inp, g in zip(node.inputs, in_grads):
-            if inp is None or g is None:
+            if inp is None:
                 continue
             prod = _producer(inp)
             if prod is None:
                 continue
             if isinstance(prod, AccumulationNode):
                 t = prod.tensor_ref()
-                if t is not None:
+                if g is not None and t is not None and (
+                    target_ids is None or id(t) in target_ids
+                ):
                     gg = g
                     for hook in prod.hooks:
                         gg = hook(gg)
-                    _accumulate_leaf(t, gg)
+                    _accumulate_leaf(t, gg, create_graph=create_graph)
                 continue
-            # interior node: stash cotangent, decrement in-degree
+            # interior node: stash cotangent, decrement in-degree. The
+            # decrement must happen even for a None grad — otherwise a
+            # sibling edge's cotangent leaves the producer starved forever.
             slots2 = pending.setdefault(id(prod), {})
             node_by_id[id(prod)] = prod
-            oi = inp._output_index
-            slots2[oi] = slots2[oi] + g if oi in slots2 else g
+            if g is not None:
+                oi = inp._output_index
+                slots2[oi] = _acc_cot(slots2.get(oi), g)
             indegree[id(prod)] -= 1
             if indegree[id(prod)] == 0:
                 queue.append(prod)
@@ -287,19 +350,149 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         cb()
 
 
+def _is_float_dtype(d) -> bool:
+    return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(
+        d, jnp.complexfloating)
+
+
+def _acc_cot(existing, g):
+    """Accumulate a cotangent into a slot. Raw jnp arrays add directly;
+    Tensors add via the registry op so create_graph accumulation is itself
+    recorded on the tape (the GradTensorHolder role, grad_tensor_holder.cc)."""
+    if existing is None:
+        return g
+    return existing + g  # Tensor.__add__ records; raw arrays add raw
+
+
+def _run_node_create_graph(node, slots, captures=()):
+    """Execute one node's backward differentiably.
+
+    ``jax.vjp(grad_fn, cotangents, float_primals)`` where ``grad_fn``
+    re-derives this node's vjp from its pure primal function — the produced
+    input-gradients are fresh op outputs with their own GradNode (named
+    ``<op>_grad``), recursively create_graph-capable (third order and up
+    work the same way).
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    if node.pure_fn is None or node.primal_datas is None:
+        raise NotImplementedError(
+            f"create_graph=True through node {node.name!r} is not "
+            "supported: its backward is an opaque closure (PyLayer or "
+            "custom vjp) with no differentiable re-derivation. Express the "
+            "computation with differentiable paddle ops, or use "
+            "create_graph=False.")
+
+    out_avals = node.out_avals
+    multi = len(out_avals) > 1
+    diff_out = [i for i, av in enumerate(out_avals)
+                if _is_float_dtype(av.dtype)]
+    diff_out_set = set(diff_out)
+
+    # cotangent entries in output order, Tensors at float positions
+    entries = []
+    for i, av in enumerate(out_avals):
+        e = slots.get(i)
+        if i in diff_out_set:
+            if e is None:
+                e = Tensor._from_data(jnp.zeros(av.shape, av.dtype),
+                                      stop_gradient=True)
+            elif not isinstance(e, Tensor):
+                e = Tensor._from_data(e, stop_gradient=True)
+        else:
+            e = _zero_cotangent(av)
+        entries.append(e)
+    if node.hooks:
+        cot = tuple(entries)
+        for hook in node.hooks:
+            cot = hook(cot)
+        entries = [
+            e if i not in diff_out_set
+            else (e if isinstance(e, Tensor)
+                  else Tensor._from_data(e, stop_gradient=True))
+            for i, e in enumerate(cot)
+        ]
+
+    for oi, t in captures:
+        if isinstance(entries[oi], Tensor):
+            _accumulate_leaf(t, entries[oi], create_graph=True)
+
+    ct_primals = [entries[i] for i in diff_out]
+    n_ct = len(ct_primals)
+
+    primal_datas = node.primal_datas
+    fl_pos = [j for j, d in enumerate(primal_datas)
+              if hasattr(d, "dtype") and _is_float_dtype(d.dtype)]
+    fl_set = set(fl_pos)
+    pure_fn = node.pure_fn
+
+    def grad_fn(*vals):
+        cts, prs = vals[:n_ct], vals[n_ct:]
+        it = iter(prs)
+        full_prs = [next(it) if j in fl_set else primal_datas[j]
+                    for j in range(len(primal_datas))]
+        _, vfn = jax.vjp(pure_fn, *full_prs)
+        k = 0
+        full_ct = []
+        for i, av in enumerate(out_avals):
+            if i in diff_out_set:
+                full_ct.append(cts[k])
+                k += 1
+            else:
+                full_ct.append(_zero_cotangent(av))
+        res = vfn(tuple(full_ct) if multi else full_ct[0])
+        picked = tuple(res[j] for j in fl_pos)
+        # engine convention: single-output nodes return a bare array
+        return picked if len(picked) != 1 else picked[0]
+
+    vjp_primal_datas = ([t._data for t in ct_primals]
+                        + [primal_datas[j] for j in fl_pos])
+    out_datas, vjp2 = jax.vjp(grad_fn, *vjp_primal_datas)
+    if not isinstance(out_datas, tuple):
+        out_datas = (out_datas,)
+    out_tensors = [Tensor._from_data(d, stop_gradient=False)
+                   for d in out_datas]
+    new_inputs = list(ct_primals) + [node.inputs[j] for j in fl_pos]
+    register_node(out_tensors, node.name + "_grad", vjp2, new_inputs,
+                  pure_fn=grad_fn, primal_datas=vjp_primal_datas)
+
+    in_grads = [None] * len(node.inputs)
+    for t, j in zip(out_tensors, fl_pos):
+        in_grads[j] = t
+    return in_grads
+
+
 def _zero_cotangent(av):
     import numpy as np
 
-    if jnp.issubdtype(av.dtype, jnp.floating) or jnp.issubdtype(
-        av.dtype, jnp.complexfloating
-    ):
+    if _is_float_dtype(av.dtype):
         return jnp.zeros(av.shape, av.dtype)
     return np.zeros(av.shape, dtype=jax.dtypes.float0)
 
 
-def _accumulate_leaf(tensor, gdata):
+def _accumulate_leaf(tensor, gdata, create_graph=False):
     from paddle_tpu.core.tensor import Tensor
 
+    gd = gdata._data if isinstance(gdata, Tensor) else gdata
+    if isinstance(gd, jax.core.Tracer) and not isinstance(
+            tensor._data, jax.core.Tracer):
+        raise RuntimeError(
+            "backward() inside a traced/compiled function would write a "
+            "tracer into the .grad of a tensor that lives outside the "
+            "trace (e.g. a model parameter). Use paddle.jit.TrainStep for "
+            "compiled training steps, or take gradients functionally with "
+            "paddle.grad over tensors created inside the traced function.")
+    if create_graph and isinstance(gdata, Tensor):
+        # keep the tape node on the accumulated grad (recorded cast/add)
+        if gdata._data.dtype != tensor._data.dtype:
+            gdata = gdata.astype(tensor._data.dtype)
+        if tensor.grad is None:
+            tensor.grad = gdata
+        else:
+            tensor.grad = tensor.grad + gdata
+        return
+    if isinstance(gdata, Tensor):
+        gdata = gdata._data
     if gdata.dtype != tensor._data.dtype:
         gdata = gdata.astype(tensor._data.dtype)
     if tensor.grad is None:

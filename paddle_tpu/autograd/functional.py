@@ -27,28 +27,31 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
 
-    stash = [(t.grad, t._acc_node) for t in inputs]
+    # stash .grad only — _acc_node stays so registered leaf hooks fire
+    # during paddle.grad, matching the reference's hook contract
+    stash = [t.grad for t in inputs]
     for t in inputs:
         t.grad = None
-        t._acc_node = None
     try:
         engine.backward(outputs, grad_outputs,
-                        retain_graph=retain_graph or create_graph)
+                        retain_graph=retain_graph or create_graph,
+                        create_graph=create_graph, grad_targets=inputs)
         results = []
-        for t in inputs:
+        for i, t in enumerate(inputs):
             if t.grad is None:
                 if not allow_unused:
-                    results.append(
-                        Tensor._from_data(jax.numpy.zeros_like(t._data)))
-                else:
-                    results.append(None)
+                    raise ValueError(
+                        f"The {i}-th input does not appear in the backward "
+                        "graph of the given outputs. Pass allow_unused=True "
+                        "to get None for unreachable inputs (reference "
+                        "contract: python/paddle/base/dygraph/base.py grad)")
+                results.append(None)
             else:
                 results.append(t.grad)
         return results
     finally:
-        for t, (g, acc) in zip(inputs, stash):
+        for t, g in zip(inputs, stash):
             t.grad = g
-            t._acc_node = acc
 
 
 def _functionalize(func: Callable):

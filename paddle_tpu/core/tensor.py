@@ -211,6 +211,11 @@ class Tensor:
         from paddle_tpu.autograd import engine
 
         def _raw_hook(gdata):
+            if isinstance(gdata, Tensor):
+                # create_graph backward: cotangents flow as Tensors; keep
+                # the hook result on the tape
+                out = hook(gdata)
+                return out if out is not None else gdata
             out = hook(Tensor._from_data(gdata))
             return out._data if out is not None else gdata
 

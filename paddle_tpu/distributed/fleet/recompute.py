@@ -98,7 +98,8 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
     if want_grad:
         diff_inputs = [t if not t.stop_gradient else None
                        for t in tensors] + list(params)
-        engine.register_node(out_tensors, "recompute", vjp_fn, diff_inputs)
+        engine.register_node(out_tensors, "recompute", vjp_fn, diff_inputs,
+                             pure_fn=ckpt, primal_datas=datas)
     return tuple(out_tensors) if multi else out_tensors[0]
 
 

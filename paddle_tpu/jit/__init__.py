@@ -54,11 +54,15 @@ class _FunctionAdapter:
         self._fn = fn
 
         def pure(*datas):
-            from paddle_tpu.autograd import engine
+            # NOTE: tape recording stays ENABLED during the trace so the
+            # traced function can use autograd internally (e.g. a
+            # gradient-penalty step calling paddle.grad(create_graph=True)).
+            # Consequence: semantics match eager exactly — including that
+            # an in-place op on a leaf param requires an explicit
+            # paddle.no_grad() around it, same as eager would.
             from paddle_tpu.core.tensor import Tensor
-            with engine.no_grad():
-                ins = [Tensor._from_data(d) for d in datas]
-                out = fn(*ins)
+            ins = [Tensor._from_data(d) for d in datas]
+            out = fn(*ins)
             from paddle_tpu.core.tensor import Tensor as T
             if isinstance(out, (tuple, list)):
                 return tuple(o._data if isinstance(o, T) else o for o in out)

@@ -254,7 +254,9 @@ def make_api(opdef: OpDef) -> Callable:
             Tensor._from_data(o, stop_gradient=not want_grad) for o in outs
         ]
         if want_grad:
-            engine.register_node(out_tensors, name, vjp_fn, primal_tensors)
+            engine.register_node(
+                out_tensors, name, vjp_fn, primal_tensors,
+                pure_fn=pure, primal_datas=[t._data for t in primal_tensors])
         return tuple(out_tensors) if multi else out_tensors[0]
 
     api.__name__ = name

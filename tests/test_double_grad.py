@@ -1,0 +1,159 @@
+"""Second- and higher-order eager autograd (create_graph=True).
+
+Reference contract: python/paddle/base/dygraph/base.py:600-630 and
+test/legacy_test/test_paddle_imperative_double_grad.py — paddle.grad with
+create_graph=True returns gradients that carry tape nodes and can be
+differentiated again.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_second_derivative_cubic():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+    assert not g.stop_gradient, "create_graph grad must carry the tape"
+    (g2,) = paddle.grad(g, [x])
+    np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+
+
+def test_third_derivative():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    (g3,) = paddle.grad(g2, [x])
+    np.testing.assert_allclose(g1.numpy(), [4 * 27.0], rtol=1e-6)
+    np.testing.assert_allclose(g2.numpy(), [12 * 9.0], rtol=1e-6)
+    np.testing.assert_allclose(g3.numpy(), [24 * 3.0], rtol=1e-6)
+
+
+def test_grad_does_not_pollute_other_leaves():
+    """paddle.grad accumulates ONLY into the requested inputs (the
+    GeneralGrad role) — other leaves' .grad stay untouched."""
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    y = (x * w).sum()
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    assert w.grad is None
+    assert x.grad is None  # paddle.grad leaves .grad untouched too
+
+
+def test_grad_wrt_interior_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * 3.0
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, [h])
+    np.testing.assert_allclose(gh.numpy(), [6.0, 12.0])
+
+
+def test_gradient_penalty_matches_pure_jax():
+    """WGAN-GP-style training step: grads of a gradient-norm penalty wrt
+    weights must match a pure-JAX double-grad reference."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    xw = rng.standard_normal((4, 3)).astype("float32")
+    ww = rng.standard_normal((3, 1)).astype("float32")
+
+    x = paddle.to_tensor(xw, stop_gradient=False)
+    w = paddle.to_tensor(ww, stop_gradient=False)
+    out = paddle.matmul(paddle.nn.functional.relu(paddle.matmul(x, w)),
+                        paddle.ones([1, 1]))
+    s = out.sum()
+    (gx,) = paddle.grad(s, [x], create_graph=True)
+    penalty = ((gx * gx).sum(axis=1).sqrt() - 1.0).pow(2).mean()
+    penalty.backward()
+    got = w.grad.numpy()
+
+    def f(xv, wv):
+        return jnp.sum(jnp.maximum(xv @ wv, 0) @ jnp.ones((1, 1)))
+
+    def pen(wv):
+        g = jax.grad(f, argnums=0)(jnp.asarray(xw), wv)
+        return jnp.mean((jnp.sqrt(jnp.sum(g * g, axis=1)) - 1.0) ** 2)
+
+    want = np.asarray(jax.grad(pen)(jnp.asarray(ww)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_double_grad_multi_input_op():
+    """d/dx of (x*y) wrt y then wrt x — cross second derivatives."""
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([5.0], stop_gradient=False)
+    z = (x * x * y).sum()
+    (gx,) = paddle.grad(z, [x], create_graph=True)  # 2xy = 20
+    np.testing.assert_allclose(gx.numpy(), [20.0])
+    (gxy,) = paddle.grad(gx, [y])  # d(2xy)/dy = 2x = 4
+    np.testing.assert_allclose(gxy.numpy(), [4.0])
+
+
+def test_double_grad_composes_with_jit():
+    @paddle.jit.to_static
+    def step(xv):
+        xv.stop_gradient = False
+        y = (xv ** 3).sum()
+        (g,) = paddle.grad(y, [xv], create_graph=True)
+        return (g * g).sum()
+
+    r = step(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(float(r), 9.0 + 144.0, rtol=1e-5)
+
+
+def test_double_grad_through_recompute():
+    from paddle_tpu.distributed.fleet.recompute import recompute
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = recompute(lambda t: t * t * t, x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g, [x])
+    np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+
+
+def test_pylayer_create_graph_raises():
+    """Opaque user backward cannot be differentiated again — must raise
+    loudly, never return silent zeros."""
+
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2.0 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x).sum()
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, [x], create_graph=True)
+
+
+def test_backward_still_accumulates_all_leaves():
+    """Plain .backward() keeps reference semantics: every leaf gets .grad."""
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+
+def test_hessian_vector_product_pattern():
+    """HVP via grad-of-(grad·v) — the PINN/optimization workhorse."""
+    xw = np.array([1.0, 2.0, 3.0], dtype="float32")
+    v = np.array([1.0, 0.5, -1.0], dtype="float32")
+    x = paddle.to_tensor(xw, stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    gv = (g * paddle.to_tensor(v)).sum()
+    (hvp,) = paddle.grad(gv, [x])
+    want = 6.0 * xw * v  # H = diag(6x)
+    np.testing.assert_allclose(hvp.numpy(), want, rtol=1e-5)
