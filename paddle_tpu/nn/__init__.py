@@ -26,8 +26,8 @@ from paddle_tpu.nn.activation import (  # noqa: F401
 )
 from paddle_tpu.nn.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    HingeLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
-    SmoothL1Loss,
+    CTCLoss, HingeLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+    NLLLoss, SmoothL1Loss,
 )
 from paddle_tpu.nn.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

@@ -58,8 +58,45 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
     return label * (1 - epsilon) + epsilon / n
 
 
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Reference: python/paddle/nn/functional/vision.py:31."""
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    return _API["affine_grid"](theta, out_shape,
+                               align_corners=align_corners)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Reference: python/paddle/nn/functional/vision.py:128."""
+    return _API["grid_sample"](x, grid, mode=mode,
+                               padding_mode=padding_mode,
+                               align_corners=align_corners)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: python/paddle/nn/functional/loss.py:1835 —
+    warp-ctc semantics: ``log_probs`` are UNSCALED logits [T, B, C],
+    softmax applied internally)."""
+    loss = _API["warpctc"](log_probs, labels, input_lengths,
+                           label_lengths, blank=blank,
+                           norm_by_times=norm_by_times)
+    if reduction == "mean":
+        ll = label_lengths
+        from paddle_tpu.core.tensor import Tensor
+        lld = ll if isinstance(ll, Tensor) else Tensor(ll)
+        return (loss / lld.astype(loss.dtype).clip(min=1)).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
 __all__ = _F_OPS + ["upsample", "flash_attention", "sequence_mask",
-                    "label_smooth"]
+                    "label_smooth", "affine_grid", "grid_sample",
+                    "ctc_loss"]
 
 # module-path parity with the reference: the implementation lives in
 # the flash_attention SUBMODULE; re-importing the names here makes

@@ -68,11 +68,15 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                     else cu_seqlens_k).astype(np.int64)
     b = len(cq) - 1
     h, d = q.shape[-2], q.shape[-1]
+    # GQA/MQA varlen: K/V may carry fewer heads than Q (the reference
+    # kernel supports num_heads_k < num_heads_q); the downstream
+    # variable-length attention repeats KH up to H
+    kh = k.shape[-2]
     sq = int(max_seqlen_q)
     sk = int(max_seqlen_k)
     qb = jnp.zeros((b, sq, h, d), q.dtype)
-    kb = jnp.zeros((b, sk, h, d), k.dtype)
-    vb = jnp.zeros((b, sk, h, d), v.dtype)
+    kb = jnp.zeros((b, sk, kh, d), k.dtype)
+    vb = jnp.zeros((b, sk, kh, d), v.dtype)
     for i in range(b):
         qb = qb.at[i, : cq[i + 1] - cq[i]].set(q[cq[i]:cq[i + 1]])
         kb = kb.at[i, : ck[i + 1] - ck[i]].set(k[ck[i]:ck[i + 1]])

@@ -6,7 +6,7 @@ from paddle_tpu.nn.layer import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "HingeLoss",
-           "MarginRankingLoss", "CosineEmbeddingLoss"]
+           "MarginRankingLoss", "CosineEmbeddingLoss", "CTCLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -134,3 +134,21 @@ class CosineEmbeddingLoss(Layer):
         return ops.cosine_embedding_loss(input1, input2, label,
                                          margin=self.margin,
                                          reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    """Reference: python/paddle/nn/layer/loss.py CTCLoss over
+    functional.ctc_loss (loss.py:1835) — warp-ctc semantics."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from paddle_tpu.nn import functional as F
+
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)

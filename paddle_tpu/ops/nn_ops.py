@@ -830,3 +830,98 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         probs = jnp.where(mask, probs / (1.0 - dropout_p), 0.0)
     out = jnp.matmul(probs, v)
     return jnp.swapaxes(out, 1, 2)
+
+
+@op
+def warpctc(logits, labels, input_lengths, label_lengths, blank=0,
+            norm_by_times=False):
+    """CTC loss per batch element (the warp-ctc role — reference
+    python/paddle/nn/functional/loss.py:1835 ctc_loss over the warpctc
+    op, paddle/phi/kernels/impl/warpctc_kernel_impl.h).
+
+    ``logits``: [T, B, C] UNSCALED (softmax applied internally, matching
+    warp-ctc); ``labels``: [B, Lmax] int32; lengths: [B]. Returns [B]
+    losses. Log-domain alpha recursion over ``lax.scan`` — jit-safe
+    static shapes; padding positions are masked, and gradients come from
+    the registry vjp over this emitter (no handwritten grad kernel).
+    """
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    in_len = jnp.asarray(input_lengths).astype(jnp.int32)
+    lab_len = jnp.asarray(label_lengths).astype(jnp.int32)
+    T, B, C = logits.shape
+    Lmax = labels.shape[1]
+    S = 2 * Lmax + 1
+    NEG = jnp.asarray(-1e30, logits.dtype)
+
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [T,B,C]
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    s_idx = jnp.arange(S)
+    is_lab = (s_idx % 2) == 1
+    lab_pos = jnp.clip(s_idx // 2, 0, Lmax - 1)
+    ext = jnp.where(is_lab, labels[:, lab_pos], blank)       # [B, S]
+    # skip transition s-2 -> s allowed when ext[s] is a label differing
+    # from ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    allow_skip = is_lab[None, :] & (ext != ext_m2)           # [B, S]
+    # positions beyond 2*lab_len are invalid
+    valid_s = s_idx[None, :] <= (2 * lab_len)[:, None]       # [B, S]
+
+    def emit(t_lp):
+        # t_lp: [B, C] -> per-extended-position emission [B, S]
+        return jnp.take_along_axis(t_lp, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), NEG, jnp.float32)
+    e0 = emit(lp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    if Lmax > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, e0[:, 1], NEG))
+    alpha0 = jnp.where(valid_s, alpha0, NEG)
+
+    def logaddexp3(a, b, c):
+        # double-where: a masked-out branch must never see -inf/NaN in
+        # its gradient, so the log argument is pinned to 1 when all
+        # inputs are the NEG sentinel
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        all_neg = m <= NEG
+        m_safe = jnp.where(all_neg, 0.0, m)
+        sum_exp = (jnp.exp(a - m_safe) + jnp.exp(b - m_safe)
+                   + jnp.exp(c - m_safe))
+        sum_safe = jnp.where(all_neg, 1.0, sum_exp)
+        return jnp.where(all_neg, NEG, m_safe + jnp.log(sum_safe))
+
+    def tick(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG, jnp.float32), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG, jnp.float32), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(allow_skip, prev2, NEG)
+        new = logaddexp3(alpha, prev1, prev2) + emit(lp[t])
+        new = jnp.where(valid_s, new, NEG)
+        # frames past input_length leave alpha frozen
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(tick, alpha0, jnp.arange(1, T))
+    # P(labels) = alpha[last blank] + alpha[last label]
+    end_b = jnp.take_along_axis(alpha, (2 * lab_len)[:, None],
+                                axis=1)[:, 0]
+    end_l = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(2 * lab_len - 1, 0)[:, None],
+            axis=1)[:, 0],
+        NEG)
+    m = jnp.maximum(end_b, end_l)
+    all_neg = m <= NEG
+    m_safe = jnp.where(all_neg, 0.0, m)
+    sum_exp = jnp.exp(end_b - m_safe) + jnp.exp(end_l - m_safe)
+    sum_safe = jnp.where(all_neg, 1.0, sum_exp)
+    logp = m_safe + jnp.log(sum_safe)
+    loss = -jnp.where(all_neg, NEG, logp)
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    return loss.astype(logits.dtype)

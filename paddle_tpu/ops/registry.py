@@ -185,6 +185,7 @@ def make_api(opdef: OpDef) -> Callable:
         # --- collect tensor inputs (flattened) ---------------------------
         primal_tensors: List[Tensor] = []  # diff Tensors, order of primals
         primal_paths: List = []  # (argname, None | list-index)
+        dist_mesh = None  # first input's ProcessMesh, for dist-attr prop
         for an in opdef.tensor_args:
             v = arguments.get(an)
             if an in opdef.list_args:
@@ -193,24 +194,29 @@ def make_api(opdef: OpDef) -> Callable:
                 for i, item in enumerate(items):
                     d = _as_data(item)
                     datas.append(d)
-                    if (
-                        isinstance(item, Tensor)
-                        and not item.stop_gradient
-                        and _is_diff_dtype(item._data.dtype)
-                    ):
-                        primal_tensors.append(item)
-                        primal_paths.append((an, i))
+                    if isinstance(item, Tensor):
+                        if dist_mesh is None and \
+                                item._process_mesh is not None:
+                            dist_mesh = item._process_mesh
+                        if (
+                            not item.stop_gradient
+                            and _is_diff_dtype(item._data.dtype)
+                        ):
+                            primal_tensors.append(item)
+                            primal_paths.append((an, i))
                 arguments[an] = datas
             else:
                 d = _as_data(v)
                 arguments[an] = d
-                if (
-                    isinstance(v, Tensor)
-                    and not v.stop_gradient
-                    and _is_diff_dtype(v._data.dtype)
-                ):
-                    primal_tensors.append(v)
-                    primal_paths.append((an, None))
+                if isinstance(v, Tensor):
+                    if dist_mesh is None and v._process_mesh is not None:
+                        dist_mesh = v._process_mesh
+                    if (
+                        not v.stop_gradient
+                        and _is_diff_dtype(v._data.dtype)
+                    ):
+                        primal_tensors.append(v)
+                        primal_paths.append((an, None))
         # non-tensor-arg Tensors (e.g. attr passed as Tensor) -> raw data
         for k, v in list(arguments.items()):
             if k not in tset and isinstance(v, Tensor):
@@ -242,7 +248,16 @@ def make_api(opdef: OpDef) -> Callable:
                         call_args[an] = lst
                 return run_emitter(call_args)
 
+            from paddle_tpu.core import generator as _gen
+
+            rng_gen = _gen._active_generator
+            rng_state0 = rng_gen.get_state()
             out, vjp_fn = jax.vjp(pure, *(t._data for t in primal_tensors))
+            if rng_gen.get_state() != rng_state0:
+                # the emitter drew RNG keys (dropout etc.): a create_graph
+                # re-derivation must REPLAY the same keys, not draw fresh
+                # ones — otherwise higher-order grads use a different mask
+                pure = _gen.wrap_replay(pure, rng_gen, rng_state0)
 
         multi = isinstance(out, (tuple, list))
         outs = list(out) if multi else [out]
@@ -257,6 +272,8 @@ def make_api(opdef: OpDef) -> Callable:
             engine.register_node(
                 out_tensors, name, vjp_fn, primal_tensors,
                 pure_fn=pure, primal_datas=[t._data for t in primal_tensors])
+        if dist_mesh is not None:
+            _propagate_dist_attrs(out_tensors, dist_mesh)
         return tuple(out_tensors) if multi else out_tensors[0]
 
     api.__name__ = name
@@ -264,6 +281,28 @@ def make_api(opdef: OpDef) -> Callable:
     api.__doc__ = emitter.__doc__
     api._opdef = opdef
     return api
+
+
+def _propagate_dist_attrs(out_tensors, mesh):
+    """Eager dist-attr propagation (the generated dist branch's "set output
+    dist attrs" step, dist_api_gen.py:46-66): when any input is a
+    DistTensor, recover each output's placements from the jax array's
+    NamedSharding — XLA already ran the propagation, so reading it back is
+    the whole per-op SPMD rulebook. Tracers are skipped (inside jit, GSPMD
+    owns propagation end to end)."""
+    from paddle_tpu.distributed.mesh import placements_from_sharding
+
+    for o in out_tensors:
+        d = o._data
+        if isinstance(d, jax.core.Tracer):
+            continue
+        sh = getattr(d, "sharding", None)
+        if sh is None:
+            continue
+        pl = placements_from_sharding(sh, mesh, d.ndim)
+        if pl is not None:
+            o._process_mesh = mesh
+            o._placements = pl
 
 
 def rebind_inplace(self, out):

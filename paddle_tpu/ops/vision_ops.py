@@ -372,3 +372,187 @@ def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
     return (loss_xy.sum(axis=(1, 2, 3)) + loss_wh.sum(axis=(1, 2, 3))
             + loss_obj.sum(axis=(1, 2, 3))
             + loss_cls.sum(axis=(1, 2, 3, 4)))
+
+
+# ---------------------------------------------------------------------------
+# affine_grid / grid_sample (STN family)
+# ---------------------------------------------------------------------------
+
+@register_emitter
+def affine_grid(theta, out_shape, align_corners=True):
+    """Affine sampling grid from batched 2x3 (4-D) or 3x4 (5-D) theta.
+
+    Reference: python/paddle/nn/functional/vision.py:31 (affine_grid op,
+    phi/kernels/impl/affine_grid_kernel_impl.h). Differentiable wrt theta
+    through the batched matmul.
+    """
+    theta = jnp.asarray(theta)
+    out_shape = [int(s) for s in out_shape]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n, dtype=theta.dtype) \
+                if n > 1 else jnp.zeros((1,), theta.dtype)
+        step = 2.0 / n
+        return (jnp.arange(n, dtype=theta.dtype) + 0.5) * step - 1.0
+
+    if theta.ndim == 3 and theta.shape[1:] == (2, 3):
+        N, _, H, W = out_shape
+        ys = axis_coords(H)
+        xs = axis_coords(W)
+        gx, gy = jnp.meshgrid(xs, ys)          # (H, W) each
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H,W,3)
+        # (N,H,W,2) = base @ theta^T
+        return jnp.einsum("hwk,nik->nhwi", base, theta)
+    if theta.ndim == 3 and theta.shape[1:] == (3, 4):
+        N, _, D, H, W = out_shape
+        zs = axis_coords(D)
+        ys = axis_coords(H)
+        xs = axis_coords(W)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        return jnp.einsum("dhwk,nik->ndhwi", base, theta)
+    raise ValueError(
+        f"affine_grid theta must be [N,2,3] or [N,3,4], got "
+        f"{tuple(theta.shape)}")
+
+
+def _gs_unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _gs_reflect(x, size, align_corners):
+    """Reflection padding on the continuous coordinate (reference
+    grid_sample padding_mode='reflection')."""
+    if align_corners:
+        span = 2.0 * (size - 1)
+        if size <= 1:
+            return jnp.zeros_like(x)
+        x = jnp.abs(x) % span
+        return jnp.where(x > size - 1, span - x, x)
+    span = 2.0 * size
+    x = (x + 0.5) % span
+    x = jnp.abs(x)
+    x = jnp.where(x > size, span - x, x)
+    return jnp.clip(x - 0.5, 0.0, size - 1)
+
+
+def _gs_resolve(coord, size, padding_mode, align_corners):
+    """Unnormalize + apply padding mode; returns (coords, in_bounds)."""
+    c = _gs_unnormalize(coord, size, align_corners)
+    if padding_mode == "border":
+        return jnp.clip(c, 0.0, size - 1), jnp.ones(c.shape, bool)
+    if padding_mode == "reflection":
+        return _gs_reflect(c, size, align_corners), jnp.ones(c.shape, bool)
+    # zeros: keep raw coords; out-of-range samples are masked to 0
+    return c, (c >= -1.0) & (c <= size)
+
+
+def _gather_hw(x, iy, ix, valid):
+    """x: (N,C,H,W); iy/ix: (N,Ho,Wo) int; gather with zero padding."""
+    N, C, H, W = x.shape
+    iy = jnp.clip(iy, 0, H - 1)
+    ix = jnp.clip(ix, 0, W - 1)
+    flat = x.reshape(N, C, H * W)
+    idx = (iy * W + ix).reshape(N, 1, -1)                   # (N,1,Ho*Wo)
+    g = jnp.take_along_axis(flat, jnp.broadcast_to(
+        idx, (N, C, idx.shape[-1])), axis=2)
+    g = g.reshape(N, C, *valid.shape[1:])
+    return jnp.where(valid[:, None], g, 0.0)
+
+
+@register_emitter
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample ``x`` at normalized ``grid`` locations (reference:
+    python/paddle/nn/functional/vision.py:128, grid_sample op). 4-D
+    [N,C,H,W] with grid [N,Ho,Wo,2] or 5-D with grid [...,3]; modes
+    bilinear/nearest; padding zeros/border/reflection. Gather-based,
+    jit-safe, differentiable wrt x and grid via the registry vjp."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear|nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"padding_mode must be zeros|border|reflection, got "
+            f"{padding_mode!r}")
+    if x.ndim == 4:
+        N, C, H, W = x.shape
+        gx, val_x = _gs_resolve(grid[..., 0], W, padding_mode,
+                                align_corners)
+        gy, val_y = _gs_resolve(grid[..., 1], H, padding_mode,
+                                align_corners)
+        valid = val_x & val_y
+        if mode == "nearest":
+            ix = jnp.round(gx).astype(jnp.int32)
+            iy = jnp.round(gy).astype(jnp.int32)
+            inb = valid & (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H) \
+                if padding_mode == "zeros" else valid
+            return _gather_hw(x, iy, ix, inb)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+        out = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                iy = (y0 + dy).astype(jnp.int32)
+                ix = (x0 + dx).astype(jnp.int32)
+                w = (wx if dx else 1.0 - wx) * (wy if dy else 1.0 - wy)
+                inb = valid & (ix >= 0) & (ix < W) & (iy >= 0) & \
+                    (iy < H) if padding_mode == "zeros" else valid
+                out = out + _gather_hw(x, iy, ix, inb) * w[:, None]
+        return out
+    if x.ndim == 5:
+        N, C, D, H, W = x.shape
+        gx, val_x = _gs_resolve(grid[..., 0], W, padding_mode,
+                                align_corners)
+        gy, val_y = _gs_resolve(grid[..., 1], H, padding_mode,
+                                align_corners)
+        gz, val_z = _gs_resolve(grid[..., 2], D, padding_mode,
+                                align_corners)
+        valid = val_x & val_y & val_z
+
+        def gather3(iz, iy, ix, inb):
+            izc = jnp.clip(iz, 0, D - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            flat = x.reshape(N, C, D * H * W)
+            idx = ((izc * H + iyc) * W + ixc).reshape(N, 1, -1)
+            g = jnp.take_along_axis(flat, jnp.broadcast_to(
+                idx, (N, C, idx.shape[-1])), axis=2)
+            g = g.reshape(N, C, *inb.shape[1:])
+            return jnp.where(inb[:, None], g, 0.0)
+
+        if mode == "nearest":
+            ix = jnp.round(gx).astype(jnp.int32)
+            iy = jnp.round(gy).astype(jnp.int32)
+            iz = jnp.round(gz).astype(jnp.int32)
+            inb = valid & (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H) & \
+                (iz >= 0) & (iz < D) if padding_mode == "zeros" else valid
+            return gather3(iz, iy, ix, inb)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        z0 = jnp.floor(gz)
+        wx = gx - x0
+        wy = gy - y0
+        wz = gz - z0
+        out = 0.0
+        for dz in (0, 1):
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    iz = (z0 + dz).astype(jnp.int32)
+                    iy = (y0 + dy).astype(jnp.int32)
+                    ix = (x0 + dx).astype(jnp.int32)
+                    w = ((wx if dx else 1.0 - wx)
+                         * (wy if dy else 1.0 - wy)
+                         * (wz if dz else 1.0 - wz))
+                    inb = valid & (ix >= 0) & (ix < W) & (iy >= 0) & \
+                        (iy < H) & (iz >= 0) & (iz < D) \
+                        if padding_mode == "zeros" else valid
+                    out = out + gather3(iz, iy, ix, inb) * w[:, None]
+        return out
+    raise ValueError(f"grid_sample expects 4-D or 5-D x, got {x.ndim}-D")

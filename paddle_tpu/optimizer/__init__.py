@@ -1,5 +1,5 @@
 from paddle_tpu.optimizer.optimizer import (  # noqa: F401
-    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
-    Optimizer, RAdam, RMSProp, SGD,
+    Adadelta, Adagrad, Adam, Adamax, AdamW, ASGD, Lamb, LBFGS,
+    Momentum, NAdam, Optimizer, RAdam, RMSProp, Rprop, SGD,
 )
 from paddle_tpu.optimizer import lr  # noqa: F401

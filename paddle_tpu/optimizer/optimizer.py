@@ -12,7 +12,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.autograd import engine
 from paddle_tpu.core.tensor import Tensor
@@ -500,3 +502,309 @@ class RAdam(Adam):
         unrectified = p - lr * mhat
         p2 = jnp.where(rho_t > 4.0, rectified, unrectified)
         return p2, {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD over a window of the last ``batch_num`` gradients
+    (reference: python/paddle/optimizer/asgd.py:29 —
+    x ← x − lr·(d/min(t+1, n) + λx) with d the running sum of the last n
+    grads held in a circular buffer). Memory: n copies of each param's
+    grad, as in the reference's ``ys`` accumulator."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, **kw):
+        if batch_num is None or batch_num <= 0:
+            raise ValueError("batch_num should be greater than 0")
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._multi_precision = bool(multi_precision)
+        self._n = int(batch_num)
+
+    def _init_slots(self, p):
+        return {"d": jnp.zeros_like(p),
+                "ys": jnp.zeros((self._n,) + p.shape, p.dtype)}
+
+    def _rule(self, p, g, slots, lr, step):
+        g = self._apply_weight_decay_to_grad(p, g)
+        n = self._n
+        idx = (jnp.asarray(step, jnp.int32) - 1) % n
+        old = jax.lax.dynamic_index_in_dim(slots["ys"], idx, 0,
+                                           keepdims=False)
+        d = slots["d"] - old + g
+        ys = jax.lax.dynamic_update_index_in_dim(slots["ys"], g, idx, 0)
+        m = jnp.minimum(jnp.asarray(step, p.dtype), float(n))
+        p2 = p - lr * d / jnp.maximum(m, 1.0)
+        return p2, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py:28):
+    per-element step sizes grown by ``etas[1]`` on consecutive same-sign
+    grads, shrunk by ``etas[0]`` on sign flips (the flip step is skipped,
+    Rprop⁻), clipped to ``learning_rate_range``. Single-batch regimes
+    only, as the reference documents."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None, **kw):
+        if not (0.0 < learning_rate_range[0] <= learning_rate
+                <= learning_rate_range[1]):
+            raise ValueError(
+                "'0.0 < learning_rate_range[0] <= learning_rate <= "
+                "learning_rate_range[1]' must be true")
+        if not (0.0 < etas[0] < 1.0 <= etas[1]):
+            raise ValueError("'0.0 < etas[0] < 1.0 <= etas[1]' must be true")
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._multi_precision = bool(multi_precision)
+        self._lr0 = float(learning_rate)
+        self._range = (float(learning_rate_range[0]),
+                       float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+
+    def _init_slots(self, p):
+        return {"prev": jnp.zeros_like(p),
+                "lrs": jnp.full(p.shape, self._lr0, p.dtype)}
+
+    def _rule(self, p, g, slots, lr, step):
+        lo, hi = self._range
+        eminus, eplus = self._etas
+        sign = jnp.sign(g * slots["prev"])
+        lrs = jnp.where(sign > 0,
+                        jnp.minimum(slots["lrs"] * eplus, hi),
+                        jnp.where(sign < 0,
+                                  jnp.maximum(slots["lrs"] * eminus, lo),
+                                  slots["lrs"]))
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        p2 = p - jnp.sign(g_eff) * lrs
+        return p2, {"prev": g_eff, "lrs": lrs}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with optional strong-Wolfe line search
+    (reference: python/paddle/optimizer/lbfgs.py:315 — the closure-based
+    ``step(closure)`` API; two-loop recursion over ``history_size``
+    curvature pairs; ``line_search_fn='strong_wolfe'`` runs
+    cubic-interpolation zoom as in ``_strong_wolfe``)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._max_iter = int(max_iter)
+        self._max_eval = int(max_eval) if max_eval is not None \
+            else self._max_iter * 5 // 4
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                "line_search_fn must be None or 'strong_wolfe'")
+        self._line_search = line_search_fn
+        self._state = {"old_dirs": [], "old_stps": [], "ro": [],
+                       "prev_flat_grad": None, "d": None, "t": None,
+                       "H_diag": 1.0, "n_iter": 0, "func_evals": 0}
+
+    # ---- flatten helpers -------------------------------------------------
+    def _params(self):
+        return [p for p in (self._parameter_list or [])
+                if not p.stop_gradient]
+
+    def _gather_flat_grad(self):
+        gs = []
+        for p in self._params():
+            g = p.grad._data if p.grad is not None else \
+                jnp.zeros_like(p._data)
+            # weight decay folds into the objective's gradient so the
+            # line search sees the regularized objective too
+            self._current_decay_enabled = self._decay_enabled(p)
+            g = self._apply_weight_decay_to_grad(p._data, g)
+            self._current_decay_enabled = True
+            gs.append(g)
+        clip_fn = getattr(self._grad_clip, "clip_fn", None)
+        if clip_fn is not None:
+            gs = clip_fn(gs)
+        elif self._grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS supports grad clips with a pure clip_fn "
+                "(ClipGradByGlobalNorm)")
+        return jnp.concatenate(
+            [jnp.ravel(g.astype(jnp.float32)) for g in gs])
+
+    def _add_to_params(self, step_size, update_flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p._data.shape)) if p._data.ndim else 1
+            seg = update_flat[off:off + n].reshape(p._data.shape)
+            p._data = p._data + (step_size * seg).astype(p._data.dtype)
+            off += n
+
+    def _clone_params(self):
+        return [p._data for p in self._params()]
+
+    def _restore_params(self, saved):
+        for p, d in zip(self._params(), saved):
+            p._data = d
+
+    def _call_closure(self, closure):
+        # grad recording must be ON regardless of the caller's context —
+        # the closure's backward() is what feeds the line search
+        with engine.enable_grad():
+            return closure()
+
+    def _eval(self, closure, x0, t, d):
+        self._restore_params(x0)
+        self._add_to_params(t, d)
+        loss = float(self._call_closure(closure))
+        flat_grad = self._gather_flat_grad()
+        self._state["func_evals"] += 1
+        return loss, flat_grad
+
+    def step(self, closure=None):
+        """Reference contract: ``closure`` re-evaluates the model and
+        returns the loss (it must call ``backward()``)."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        state = self._state
+        self._step_count += 1
+        lr = float(self.get_lr())
+
+        orig_loss = self._call_closure(closure)
+        loss = float(orig_loss)
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+            return orig_loss
+
+        n_iter = 0
+        while n_iter < self._max_iter:
+            n_iter += 1
+            state["n_iter"] += 1
+            if state["n_iter"] == 1:
+                d = -flat_grad
+                state["old_dirs"], state["old_stps"], state["ro"] = \
+                    [], [], []
+                H_diag = 1.0
+            else:
+                y = flat_grad - state["prev_flat_grad"]
+                s = state["d"] * state["t"]
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(state["old_dirs"]) >= self._history:
+                        state["old_dirs"].pop(0)
+                        state["old_stps"].pop(0)
+                        state["ro"].pop(0)
+                    state["old_dirs"].append(y)
+                    state["old_stps"].append(s)
+                    state["ro"].append(1.0 / ys)
+                    H_diag = ys / float(jnp.dot(y, y))
+                else:
+                    H_diag = state["H_diag"]
+                # two-loop recursion
+                q = -flat_grad
+                al = []
+                for y_i, s_i, ro_i in zip(reversed(state["old_dirs"]),
+                                          reversed(state["old_stps"]),
+                                          reversed(state["ro"])):
+                    a = ro_i * float(jnp.dot(s_i, q))
+                    al.append(a)
+                    q = q - a * y_i
+                d = q * H_diag
+                for (y_i, s_i, ro_i), a in zip(
+                        zip(state["old_dirs"], state["old_stps"],
+                            state["ro"]), reversed(al)):
+                    b = ro_i * float(jnp.dot(y_i, d))
+                    d = d + s_i * (a - b)
+            state["H_diag"] = H_diag
+            state["prev_flat_grad"] = flat_grad
+
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self._tol_change:
+                break
+            t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr \
+                if state["n_iter"] == 1 else lr
+
+            if self._line_search == "strong_wolfe":
+                x0 = self._clone_params()
+                loss, flat_grad, t = self._strong_wolfe(
+                    closure, x0, t, d, loss, flat_grad, gtd)
+                self._restore_params(x0)
+                self._add_to_params(t, d)
+            else:
+                self._add_to_params(t, d)
+                if n_iter < self._max_iter:
+                    loss = float(self._call_closure(closure))
+                    flat_grad = self._gather_flat_grad()
+            state["d"], state["t"] = d, t
+
+            if state["func_evals"] >= self._max_eval:
+                break
+            if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+                break
+            if float(jnp.abs(d * t).max()) <= self._tol_change:
+                break
+        return orig_loss
+
+    def _strong_wolfe(self, closure, x0, t, d, f0, g0, gtd0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Strong-Wolfe line search with cubic-interpolation zoom
+        (reference lbfgs.py _strong_wolfe)."""
+
+        def cubic_min(x1, f1, g1, x2, f2, g2):
+            d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+            sq = d1 * d1 - g1 * g2
+            if sq < 0:
+                return (x1 + x2) / 2.0
+            d2 = np.sqrt(sq)
+            if x1 <= x2:
+                xm = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+            else:
+                xm = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+            lo, hi = min(x1, x2), max(x1, x2)
+            return float(np.clip(xm, lo + 0.1 * (hi - lo),
+                                 hi - 0.1 * (hi - lo)))
+
+        f_prev, g_prev, t_prev = f0, g0, 0.0
+        gtd_prev = gtd0
+        ls_iter = 0
+        while ls_iter < max_ls:
+            f_new, g_new = self._eval(closure, x0, t, d)
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or \
+                    (ls_iter > 0 and f_new >= f_prev):
+                return self._zoom(closure, x0, d, f0, gtd0, t_prev,
+                                  f_prev, gtd_prev, t, f_new, gtd_new,
+                                  c1, c2, max_ls - ls_iter, cubic_min)
+            if abs(gtd_new) <= -c2 * gtd0:
+                return f_new, g_new, t
+            if gtd_new >= 0:
+                return self._zoom(closure, x0, d, f0, gtd0, t, f_new,
+                                  gtd_new, t_prev, f_prev, gtd_prev,
+                                  c1, c2, max_ls - ls_iter, cubic_min)
+            t_prev, f_prev, gtd_prev = t, f_new, gtd_new
+            t = min(t * 2.0, 10.0)
+            ls_iter += 1
+        return f_new, g_new, t
+
+    def _zoom(self, closure, x0, d, f0, gtd0, t_lo, f_lo, gtd_lo, t_hi,
+              f_hi, gtd_hi, c1, c2, max_ls, cubic_min):
+        f_new, g_new, t = f_lo, None, t_lo
+        for _ in range(max(int(max_ls), 1)):
+            t = cubic_min(t_lo, f_lo, gtd_lo, t_hi, f_hi, gtd_hi)
+            f_new, g_new = self._eval(closure, x0, t, d)
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                t_hi, f_hi, gtd_hi = t, f_new, gtd_new
+            else:
+                if abs(gtd_new) <= -c2 * gtd0:
+                    return f_new, g_new, t
+                if gtd_new * (t_hi - t_lo) >= 0:
+                    t_hi, f_hi, gtd_hi = t_lo, f_lo, gtd_lo
+                t_lo, f_lo, gtd_lo = t, f_new, gtd_new
+            if abs(t_hi - t_lo) < 1e-9:
+                break
+        if g_new is None:
+            f_new, g_new = self._eval(closure, x0, t, d)
+        return f_new, g_new, t

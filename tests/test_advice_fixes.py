@@ -123,3 +123,118 @@ def test_second_backward_raises_clear_error():
     z = x * 1.0  # reuse freed graph? build second backward through y
     with pytest.raises(RuntimeError, match="retain_graph"):
         y.backward()
+
+
+# ---------------------------------------------------------------------------
+# round-4 advisor findings
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_static_gradients_wrt_intermediate_variable(static_mode):
+    """medium: static.gradients() wrt an op-produced Variable must work
+    (reference paddle.static.gradients supports arbitrary Variables)."""
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        h = x * 3.0          # intermediate _OP Variable
+        loss = paddle.sum(h * h)
+        (gh,) = static.gradients([loss], [h])
+        (gx,) = static.gradients([loss], [x])
+    exe = static.Executor()
+    xs = np.asarray([1.0, 2.0, -1.0], "float32")
+    out = exe.run(main, feed={"x": xs}, fetch_list=[gh, gx])
+    np.testing.assert_allclose(out[0], 2 * 3 * xs, rtol=1e-6)   # 2h
+    np.testing.assert_allclose(out[1], 18 * xs, rtol=1e-6)      # 18x
+
+
+def test_py_func_backward_per_input_convention(static_mode):
+    """low: backward_func returning one grad per input (None for the int
+    input) must align even when the int input precedes the float one."""
+    from paddle_tpu import static
+
+    def host_fn(idx, feats):
+        return feats * 2.0
+
+    def host_bwd(idx, feats, y, g):
+        return None, np.asarray(g) * 2.0  # per-input: (d idx, d feats)
+
+    main = static.Program()
+    with static.program_guard(main):
+        idx = static.data("idx", [2], "int32")
+        feats = static.data("feats", [2], "float32")
+        y = static.nn.py_func(host_fn, [idx, feats], ([2], "float32"),
+                              backward_func=host_bwd)
+        loss = paddle.sum(y)
+        (gf,) = static.gradients([loss], [feats])
+    exe = static.Executor()
+    out = exe.run(main, feed={"idx": np.asarray([0, 1], "int32"),
+                              "feats": np.asarray([1.5, -2.0], "float32")},
+                  fetch_list=[y, gf])
+    np.testing.assert_allclose(out[0], [3.0, -4.0])
+    np.testing.assert_allclose(out[1], [2.0, 2.0])
+
+
+def test_program_clone_for_train_is_independent(static_mode):
+    """low: Program.clone(for_test=False) must not share node/capture
+    containers — ops recorded into the clone stay out of the original."""
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    n_nodes = len(main.nodes)
+    clone = main.clone(for_test=False)
+    with static.program_guard(clone):
+        z = y + 1.0  # records into the clone only
+    assert len(main.nodes) == n_nodes, \
+        "op recorded into clone leaked into the original Program"
+    assert len(clone.nodes) == n_nodes + 1
+
+
+def test_write_cache_drops_unallocated_block_writes():
+    """low: a position mapping to a -1 block-table entry must be dropped,
+    not written into physical block 0."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.nn.functional.block_attention import (
+        _write_cache,
+    )
+
+    bs, nb, kh, d = 4, 3, 1, 2
+    cache = jnp.zeros((nb, bs, kh, d), "float32")
+    # batch 0 owns physical block 0 only; logical block 1 is UNALLOCATED
+    block_tables = jnp.asarray([[0, -1]], "int32")
+    # two tokens: position 0 (block 0, ok) and position 4 (block 1 -> -1)
+    positions = jnp.asarray([[0, 4]], "int32")
+    blocks = jnp.ones((1, 2, kh, d), "float32")
+    out = _write_cache(cache, blocks, block_tables, positions)
+    assert float(out[0, 0, 0, 0]) == 1.0          # allocated write landed
+    # the unallocated write must NOT clobber block 0 slot 0 (pos 4 % 4 = 0)
+    assert float(out.sum()) == pytest.approx(d * 1.0), \
+        "write through -1 block-table entry leaked into the cache"
+
+
+def test_flash_attn_unpadded_gqa_heads():
+    """low: varlen flash attention with num_heads_k < num_heads_q (GQA)
+    must not shape-error on the padded K/V buffers."""
+    from paddle_tpu.nn.functional.flash_attention import flash_attn_unpadded
+
+    h, kh, d = 4, 2, 8
+    total_q, total_k = 6, 6
+    q = paddle.randn([total_q, h, d])
+    k = paddle.randn([total_k, kh, d])
+    v = paddle.randn([total_k, kh, d])
+    cu = np.asarray([0, 3, 6], "int32")
+    out, _ = flash_attn_unpadded(q, k, v, cu, cu, 3, 3,
+                                 scale=1.0 / np.sqrt(d))
+    assert tuple(out.shape) == (total_q, h, d)
+    assert np.isfinite(out.numpy()).all()

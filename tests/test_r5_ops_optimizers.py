@@ -1,0 +1,356 @@
+"""Round-5 op/optimizer gap closures (VERDICT r4 missing #3):
+grid_sample + affine_grid (STN), ctc_loss/CTCLoss, LBFGS, ASGD, Rprop.
+
+Numpy/torch-referenced values with finite-difference gradient checks;
+plus the VERDICT "done" criteria: a tiny STN trains and a CTC toy model
+trains.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+
+# ---------------------------------------------------------------------------
+# grid_sample / affine_grid
+# ---------------------------------------------------------------------------
+
+def test_grid_sample_identity_grid():
+    """An identity affine grid must reproduce the input."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 4, 5)).astype("float32")
+    theta = np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], "float32"),
+                    (2, 1, 1))
+    grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                         align_corners=True)
+    out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+
+def test_grid_sample_reference_example():
+    """The documented reference example (nn/functional/vision.py:128)."""
+    x = paddle.to_tensor(np.asarray(
+        [[[[-0.6, 0.8, -0.5], [-0.5, 0.2, 1.2], [1.4, 0.3, -0.2]]]],
+        "float64"))
+    grid = paddle.to_tensor(np.asarray(
+        [[[[0.2, 0.3], [-0.4, -0.3], [-0.9, 0.3], [-0.9, -0.6]],
+          [[0.4, 0.1], [0.9, -0.8], [0.4, 0.5], [0.5, -0.2]],
+          [[0.1, -0.8], [-0.3, -1.0], [0.7, 0.4], [0.2, 0.8]]]],
+        "float64"))
+    y = F.grid_sample(x, grid, mode="bilinear", padding_mode="border",
+                      align_corners=True)
+    want = np.asarray([[[[0.34, 0.016, 0.086, -0.448],
+                         [0.55, -0.076, 0.35, 0.59],
+                         [0.596, 0.38, 0.52, 0.24]]]])
+    np.testing.assert_allclose(y.numpy(), want, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding", ["zeros", "border", "reflection"])
+def test_grid_sample_modes_finite(mode, padding):
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 2, 5, 6)).astype("float32")
+    grid = (rng.random((2, 3, 4, 2)).astype("float32") * 2.6 - 1.3)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=padding)
+    assert tuple(out.shape) == (2, 2, 3, 4)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_grid_sample_grad_finite_difference():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 2, 4, 4)).astype("float32")
+    grid = (rng.random((1, 3, 3, 2)).astype("float32") * 1.6 - 0.8)
+
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    gt = paddle.to_tensor(grid)
+    gt.stop_gradient = False
+    F.grid_sample(xt, gt, padding_mode="border").sum().backward()
+
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0), (0, 2, 1, 1), (0, 1, 2, 0)]:
+        gp, gm = grid.copy(), grid.copy()
+        gp[idx] += eps
+        gm[idx] -= eps
+        fp = float(F.grid_sample(paddle.to_tensor(x),
+                                 paddle.to_tensor(gp),
+                                 padding_mode="border").sum().numpy())
+        fm = float(F.grid_sample(paddle.to_tensor(x),
+                                 paddle.to_tensor(gm),
+                                 padding_mode="border").sum().numpy())
+        np.testing.assert_allclose(gt.grad.numpy()[idx],
+                                   (fp - fm) / (2 * eps), atol=2e-2)
+    # grad wrt x: sum of bilinear weights per output = each weight quad
+    # sums to 1, so total dL/dx sums to number of in-bounds samples
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_affine_grid_5d_shapes():
+    theta = paddle.randn([2, 3, 4])
+    g = F.affine_grid(theta, [2, 1, 3, 4, 5], align_corners=False)
+    assert tuple(g.shape) == (2, 3, 4, 5, 3)
+
+
+def test_tiny_stn_trains():
+    """Spatial-transformer localization net: loss must descend through
+    affine_grid + grid_sample (the VERDICT done criterion)."""
+    paddle.seed(0)
+
+    class STN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.loc = nn.Linear(16, 6)
+
+        def forward(self, x):
+            theta = self.loc(x.reshape([x.shape[0], -1]))
+            theta = theta.reshape([x.shape[0], 2, 3])
+            grid = F.affine_grid(theta, list(x.shape), align_corners=True)
+            return F.grid_sample(x, grid, align_corners=True)
+
+    net = STN()
+    # standard STN init: localization starts at the identity transform
+    with paddle.no_grad():
+        net.loc.weight.set_value(np.zeros((16, 6), "float32"))
+        net.loc.bias.set_value(
+            np.asarray([1, 0, 0, 0, 1, 0], "float32"))
+    opt = optimizer.Adam(learning_rate=0.02,
+                         parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 1, 4, 4))
+                         .astype("float32"))
+    # target = a known small affine warp (shift + slight scale), so the
+    # optimum is a reachable constant theta
+    theta_true = np.tile(np.asarray([[0.9, 0.0, 0.25], [0.0, 1.1, -0.2]],
+                                    "float32"), (8, 1, 1))
+    with paddle.no_grad():
+        target = F.grid_sample(
+            x, F.affine_grid(paddle.to_tensor(theta_true), [8, 1, 4, 4],
+                             align_corners=True), align_corners=True)
+    target = paddle.to_tensor(target.numpy())
+    losses = []
+    for _ in range(60):
+        loss = ((net(x) - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+
+# ---------------------------------------------------------------------------
+# ctc_loss
+# ---------------------------------------------------------------------------
+
+def _np_ctc_loss(logits, labels, in_len, lab_len, blank=0):
+    """Direct log-domain forward algorithm in numpy (reference math)."""
+    T, C = logits.shape
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - \
+        logits.max(-1, keepdims=True)
+    lab = labels[:lab_len]
+    ext = [blank]
+    for v in lab:
+        ext += [int(v), blank]
+    S = len(ext)
+    NEG = -1e30
+    alpha = np.full(S, NEG)
+    alpha[0] = lp[0, blank]
+    if S > 1:
+        alpha[1] = lp[0, ext[1]]
+    for t in range(1, in_len):
+        new = np.full(S, NEG)
+        for s in range(S):
+            cands = [alpha[s]]
+            if s >= 1:
+                cands.append(alpha[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                cands.append(alpha[s - 2])
+            m = max(cands)
+            if m > NEG:
+                new[s] = m + np.log(sum(np.exp(c - m) for c in cands)) \
+                    + lp[t, ext[s]]
+        alpha = new
+    ends = [alpha[S - 1]]
+    if S > 1:
+        ends.append(alpha[S - 2])
+    m = max(ends)
+    return -(m + np.log(sum(np.exp(e - m) for e in ends)))
+
+
+def test_ctc_loss_matches_numpy_forward():
+    rng = np.random.default_rng(0)
+    T, B, C, L = 10, 2, 5, 3
+    logits = rng.standard_normal((T, B, C)).astype("float32")
+    labels = rng.integers(1, C, (B, L)).astype("int32")
+    in_len = np.asarray([10, 7], "int64")
+    lab_len = np.asarray([3, 2], "int64")
+    got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                     reduction="none").numpy()
+    for b in range(B):
+        want = _np_ctc_loss(logits[:, b], labels[b], int(in_len[b]),
+                            int(lab_len[b]))
+        np.testing.assert_allclose(got[b], want, rtol=1e-4)
+
+
+def test_ctc_loss_repeated_labels():
+    """Repeated labels need the skip-transition exclusion."""
+    rng = np.random.default_rng(1)
+    logits = rng.standard_normal((8, 1, 4)).astype("float32")
+    labels = np.asarray([[2, 2, 3]], "int32")
+    got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(np.asarray([8], "int64")),
+                     paddle.to_tensor(np.asarray([3], "int64")),
+                     reduction="none").numpy()
+    want = _np_ctc_loss(logits[:, 0], labels[0], 8, 3)
+    np.testing.assert_allclose(got[0], want, rtol=1e-4)
+
+
+def test_ctc_loss_grad_finite_difference():
+    rng = np.random.default_rng(2)
+    logits = rng.standard_normal((6, 1, 4)).astype("float32")
+    labels = np.asarray([[1, 2]], "int32")
+    il = paddle.to_tensor(np.asarray([6], "int64"))
+    ll = paddle.to_tensor(np.asarray([2], "int64"))
+
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    F.ctc_loss(lt, paddle.to_tensor(labels), il, ll,
+               reduction="sum").backward()
+    eps = 1e-3
+    for idx in [(0, 0, 1), (3, 0, 0), (5, 0, 2)]:
+        lp, lm = logits.copy(), logits.copy()
+        lp[idx] += eps
+        lm[idx] -= eps
+        fp = _np_ctc_loss(lp[:, 0], labels[0], 6, 2)
+        fm = _np_ctc_loss(lm[:, 0], labels[0], 6, 2)
+        np.testing.assert_allclose(lt.grad.numpy()[idx],
+                                   (fp - fm) / (2 * eps), atol=5e-3)
+
+
+def test_ctc_toy_model_trains():
+    """A linear acoustic model must learn a fixed label sequence (the
+    VERDICT done criterion)."""
+    paddle.seed(0)
+    T, B, C = 12, 4, 5
+    feat = nn.Linear(8, C)
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=feat.parameters())
+    crit = nn.CTCLoss(blank=0)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((T, B, 8)).astype("float32"))
+    labels = paddle.to_tensor(
+        rng.integers(1, C, (B, 3)).astype("int32"))
+    il = paddle.to_tensor(np.full(B, T, "int64"))
+    ll = paddle.to_tensor(np.full(B, 3, "int64"))
+    losses = []
+    for _ in range(40):
+        loss = crit(feat(x), labels, il, ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_ctc_loss_reductions():
+    rng = np.random.default_rng(3)
+    logits = rng.standard_normal((6, 2, 4)).astype("float32")
+    labels = np.asarray([[1, 2], [3, 0]], "int32")
+    il = paddle.to_tensor(np.asarray([6, 5], "int64"))
+    ll = paddle.to_tensor(np.asarray([2, 1], "int64"))
+    args = (paddle.to_tensor(logits), paddle.to_tensor(labels), il, ll)
+    none = F.ctc_loss(*args, reduction="none").numpy()
+    s = F.ctc_loss(*args, reduction="sum").numpy()
+    m = F.ctc_loss(*args, reduction="mean").numpy()
+    np.testing.assert_allclose(s, none.sum(), rtol=1e-6)
+    np.testing.assert_allclose(m, (none / np.asarray([2, 1])).mean(),
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+def test_lbfgs_strong_wolfe_quadratic():
+    A = np.asarray([[3.0, 0.5], [0.5, 1.0]], "float32")
+    b = np.asarray([1.0, -2.0], "float32")
+    x = paddle.to_tensor(np.zeros(2, "float32"))
+    x.stop_gradient = False
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+    opt = optimizer.LBFGS(learning_rate=1.0,
+                          line_search_fn="strong_wolfe", parameters=[x])
+
+    def closure():
+        opt.clear_grad()
+        loss = 0.5 * (x @ paddle.matmul(At, x)) - bt @ x
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        opt.step(closure)
+    np.testing.assert_allclose(x.numpy(), np.linalg.solve(A, b),
+                               atol=1e-4)
+
+
+def test_lbfgs_reaches_least_squares_optimum():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    X = paddle.randn([16, 4])
+    Y = paddle.randn([16, 1])
+    Xa = np.concatenate([X.numpy(), np.ones((16, 1), "float32")], 1)
+    w, *_ = np.linalg.lstsq(Xa, Y.numpy(), rcond=None)
+    opt_loss = float(np.mean((Xa @ w - Y.numpy()) ** 2))
+    opt = optimizer.LBFGS(parameters=lin.parameters(),
+                          line_search_fn="strong_wolfe")
+
+    def closure():
+        opt.clear_grad()
+        loss = ((lin(X) - Y) ** 2).mean()
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        opt.step(closure)
+    assert float(closure().numpy()) < opt_loss * 1.02 + 1e-6
+
+
+def test_asgd_window_average():
+    p = paddle.to_tensor(np.zeros(3, "float32"))
+    p.stop_gradient = False
+    opt = optimizer.ASGD(learning_rate=0.1, batch_num=2, parameters=[p])
+    (p * paddle.to_tensor([1.0, 2.0, 3.0])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [-0.1, -0.2, -0.3], rtol=1e-6)
+    opt.clear_grad()
+    (p * paddle.to_tensor([3.0, 2.0, 1.0])).sum().backward()
+    opt.step()  # window avg of the two grads: [2,2,2]
+    np.testing.assert_allclose(p.numpy(), [-0.3, -0.4, -0.5], rtol=1e-5)
+
+
+def test_rprop_sign_adaptation():
+    p = paddle.to_tensor(np.asarray([1.0, 1.0], "float32"))
+    p.stop_gradient = False
+    opt = optimizer.Rprop(learning_rate=0.01, parameters=[p],
+                          etas=(0.5, 1.2),
+                          learning_rate_range=(1e-4, 1.0))
+    (p * paddle.to_tensor([1.0, -1.0])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.99, 1.01], rtol=1e-5)
+    opt.clear_grad()
+    (p * paddle.to_tensor([1.0, 1.0])).sum().backward()
+    opt.step()  # elem0 same sign: lr*1.2; elem1 flipped: skip + shrink
+    np.testing.assert_allclose(p.numpy(), [0.99 - 0.012, 1.01],
+                               rtol=1e-5)
+
+
+def test_rprop_validates_ranges():
+    p = paddle.to_tensor(np.zeros(1, "float32"))
+    with pytest.raises(ValueError):
+        optimizer.Rprop(learning_rate=2.0,
+                        learning_rate_range=(1e-4, 1.0), parameters=[p])
+    with pytest.raises(ValueError):
+        optimizer.Rprop(etas=(1.5, 1.2), parameters=[p])

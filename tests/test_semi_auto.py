@@ -201,3 +201,62 @@ def test_dtensor_from_local_distinct_blocks_multiprocess(tmp_path):
     logs = "".join(f.read_text() for f in sorted(log_dir.glob("workerlog.*")))
     assert r.returncode == 0, logs + r.stdout + r.stderr
     assert logs.count("DTENSOR_OK") == 2, logs
+
+
+class TestEagerDistAttrPropagation:
+    """Dist attrs survive eager ops (the generated dist branch's
+    set-output-dist-attrs step, dist_api_gen.py:46-66): metadata, not just
+    values, is asserted after each op."""
+
+    def test_elementwise_and_chain(self):
+        mesh = _mesh2d()
+        x = dist.shard_tensor(paddle.ones([8, 16]), mesh,
+                              [Shard(0), Shard(1)])
+        y = x + x
+        assert y.is_dist() and y.process_mesh is mesh
+        assert y.placements == [Shard(0), Shard(1)]
+        z = (x * 2.0 + 1.0) / 2.0
+        assert z.is_dist() and z.placements == [Shard(0), Shard(1)]
+
+    def test_matmul_reduction_transpose_reshape(self):
+        mesh = _mesh2d()
+        x = dist.shard_tensor(paddle.ones([8, 16]), mesh,
+                              [Shard(0), Shard(1)])
+        w = dist.shard_tensor(paddle.ones([16, 4]), mesh,
+                              [Replicate(), Shard(0)])
+        z = paddle.matmul(x, w)
+        assert z.is_dist() and z.placements[0] == Shard(0)
+        r = x.sum(axis=1)
+        assert r.is_dist() and r.placements[0] == Shard(0)
+        t = x.transpose([1, 0])
+        assert t.is_dist() and t.placements == [Shard(1), Shard(0)]
+        rs = x.reshape([8, 4, 4])
+        assert rs.is_dist() and rs.placements[0] == Shard(0)
+
+    def test_mixed_dist_dense_operand(self):
+        mesh = _mesh2d()
+        x = dist.shard_tensor(paddle.ones([8, 16]), mesh,
+                              [Shard(0), Replicate()])
+        dense = paddle.ones([8, 16])
+        y = x + dense
+        assert y.is_dist() and y.placements[0] == Shard(0)
+
+    def test_reshard_on_computed_tensor(self):
+        """reshard after a compute chain needs no manual re-annotation."""
+        mesh = _mesh2d()
+        x = dist.shard_tensor(paddle.ones([8, 16]), mesh,
+                              [Shard(0), Shard(1)])
+        c = (x * 2.0 + 1.0).sum(axis=1)
+        assert c.is_dist()
+        out = dist.reshard(c, mesh, [Replicate(), Replicate()])
+        assert out.placements == [Replicate(), Replicate()]
+        np.testing.assert_allclose(out.numpy(), np.full((8,), 48.0))
+
+    def test_grad_flow_keeps_values(self):
+        mesh = _mesh2d()
+        x = dist.shard_tensor(paddle.ones([8, 16]), mesh,
+                              [Shard(0), Replicate()],
+                              stop_gradient=False)
+        loss = (x * x).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((8, 16)))
