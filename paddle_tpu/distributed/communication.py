@@ -201,6 +201,7 @@ def _eager_collective(g: "Group", kind: str, local, **static):
     key = (tuple(g.ranks), kind, local.shape, str(local.dtype),
            tuple(sorted(static.items())))
     fn = _eager_jits.get(key)
+    cold_compile = fn is None
     if fn is None:
         op = static.get("op")
         src = static.get("src", 0)
@@ -257,12 +258,26 @@ def _eager_collective(g: "Group", kind: str, local, **static):
             in_specs=P("w", *([None] * local.ndim)),
             out_specs=out_spec, check_rep=False))
         _eager_jits[key] = fn
-    out = fn(garr)
-    res = out.addressable_data(0)
-    if kind in ("reduce_scatter", "all_to_all", "scatter", "shift"):
-        res = res[0] if kind in ("reduce_scatter", "scatter", "shift") \
-            else res
-    return jnp.asarray(res)
+    # per-collective watchdog probe (the reference records start/end per
+    # collective in comm_task_manager.cc; a hang here reports WHICH
+    # collective on WHICH ranks instead of just "step timed out"). A
+    # first call includes trace+XLA compile: COMPILE_ALLOWANCE deadline.
+    from paddle_tpu.distributed.watchdog import (
+        COMPILE_ALLOWANCE, default_watchdog,
+    )
+
+    wd = default_watchdog()
+    eid = wd.arm(f"{kind}@ranks{list(g.ranks)}",
+                 factor=COMPILE_ALLOWANCE if cold_compile else 1.0)
+    try:
+        out = fn(garr)
+        res = out.addressable_data(0)
+        if kind in ("reduce_scatter", "all_to_all", "scatter", "shift"):
+            res = res[0] if kind in ("reduce_scatter", "scatter",
+                                     "shift") else res
+        return jnp.asarray(res)
+    finally:
+        wd.disarm(eid)
 
 
 def _axis(group: Optional[Group]) -> str:

@@ -88,6 +88,14 @@ def init_parallel_env():
             process_id=int(rank),
         )
     _initialized = True
+    # cross-rank abort watch: an idle rank must still exit promptly when
+    # a peer's watchdog fires (no-op unless PADDLE_STEP_TIMEOUT is set)
+    try:
+        from paddle_tpu.distributed.watchdog import default_watchdog
+
+        default_watchdog().start_abort_watch()
+    except Exception:
+        pass
     return ParallelEnv()
 
 

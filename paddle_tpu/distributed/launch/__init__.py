@@ -81,9 +81,20 @@ def _launch_elastic(args, extra_env, min_n, max_n):
 
     from paddle_tpu.distributed.launch.elastic import ElasticManager
 
-    store_dir = getattr(args, "elastic_dir", None) or \
-        os.path.join(tempfile.gettempdir(),
-                     f"paddle_elastic_{os.getpid()}")
+    store_dir = getattr(args, "elastic_dir", None)
+    if store_dir is None:
+        # default registry: a TCPStore served by THIS launcher process
+        # (the management-job store — reference etcd, manager.py:124);
+        # no shared filesystem needed and it survives gang restarts.
+        # FileStore remains the fallback when --elastic_dir is given or
+        # the server cannot bind.
+        try:
+            from paddle_tpu.distributed.store import TCPStore
+
+            store_dir, _stop = TCPStore.serve("127.0.0.1", 0)
+        except Exception:
+            store_dir = os.path.join(tempfile.gettempdir(),
+                                     f"paddle_elastic_{os.getpid()}")
     mgr = ElasticManager(store_dir, min_n, max_n,
                          hb_timeout=getattr(args, "hb_timeout", 3.0))
     mgr.clear_join_requests()  # stale requests from a previous run

@@ -23,9 +23,21 @@ import threading
 import time
 from typing import Optional, Set
 
-from paddle_tpu.distributed.store import FileStore
+from paddle_tpu.distributed.store import FileStore, TCPStore
 
-__all__ = ["ElasticManager", "Heartbeat", "request_join", "parse_nnodes"]
+__all__ = ["ElasticManager", "Heartbeat", "request_join", "parse_nnodes",
+           "make_elastic_store"]
+
+
+def make_elastic_store(spec: str):
+    """Resolve an elastic registry spec: ``tcp://host:port`` -> TCPStore
+    client (the management-job store — reference: etcd at
+    elastic/manager.py:124; needs no shared filesystem and survives gang
+    restarts), anything else -> FileStore directory (single-host
+    fallback)."""
+    if str(spec).startswith("tcp://"):
+        return TCPStore(spec)
+    return FileStore(spec)
 
 
 def parse_nnodes(spec) -> tuple:
@@ -49,7 +61,7 @@ class Heartbeat:
 
     def __init__(self, store_dir: str, node_id: str, interval: float = 0.5,
                  payload: Optional[dict] = None):
-        self._store = FileStore(store_dir)
+        self._store = make_elastic_store(store_dir)
         self._node_id = node_id
         self._interval = interval
         self._payload = payload or {}
@@ -57,8 +69,21 @@ class Heartbeat:
         self._thread: Optional[threading.Thread] = None
 
     def _beat(self):
-        self._store.set(f"nodes/{self._node_id}", json.dumps(
-            {"ts": time.time(), **self._payload}))
+        # a transient registry error (TCP reset, server busy during gang
+        # churn) must not kill the heartbeat thread — a missed beat is
+        # recoverable, a dead thread reads as a dead NODE
+        try:
+            self._store.set(f"nodes/{self._node_id}", json.dumps(
+                {"ts": time.time(), **self._payload}))
+            self._misses = 0
+        except Exception:
+            self._misses = getattr(self, "_misses", 0) + 1
+            if self._misses == 3:
+                import sys
+
+                print(f"[elastic] heartbeat {self._node_id}: 3 "
+                      "consecutive store failures (still retrying)",
+                      file=sys.stderr, flush=True)
 
     def start(self):
         self._beat()
@@ -74,13 +99,16 @@ class Heartbeat:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
-        self._store.delete(f"nodes/{self._node_id}")
+        try:
+            self._store.delete(f"nodes/{self._node_id}")
+        except Exception:
+            pass  # unreachable registry at teardown must not mask rc
 
 
 def request_join(store_dir: str, node_id: str = "new"):
     """Ask a running elastic job to scale out (reference: a new node
     registering in etcd triggers the manager's watch)."""
-    FileStore(store_dir).set(f"join/{node_id}", json.dumps(
+    make_elastic_store(store_dir).set(f"join/{node_id}", json.dumps(
         {"ts": time.time()}))
 
 
@@ -89,7 +117,7 @@ class ElasticManager:
 
     def __init__(self, store_dir: str, min_nodes: int, max_nodes: int,
                  hb_timeout: float = 3.0):
-        self.store = FileStore(store_dir)
+        self.store = make_elastic_store(store_dir)
         self.dir = store_dir
         self.min = min_nodes
         self.max = max_nodes

@@ -17,7 +17,7 @@ import os
 import time
 from typing import List, Optional
 
-__all__ = ["Store", "FileStore", "current_store"]
+__all__ = ["Store", "FileStore", "TCPStore", "current_store"]
 
 
 class Store:
@@ -202,3 +202,135 @@ def current_store():
                 raise
             _store = FileStore(d)
     return _store
+
+
+class TCPStore:
+    """Real TCP key-value store (reference:
+    paddle/phi/core/distributed/store/tcp_store.h:121 — a socket server
+    on one process, set/get/add/wait clients on every other). Unlike the
+    coordination-service Store it needs NO jax.distributed runtime and
+    survives gang restarts, so it is the elastic manager's registry when
+    workers share no filesystem (the reference uses etcd there).
+
+    ``TCPStore.serve(host, port)`` starts the server (the management-job
+    role, e.g. inside the launcher); ``TCPStore("host:port")`` is a
+    client. Protocol: one JSON line per request over a fresh connection
+    — heartbeat-rate traffic, robustness over throughput.
+    """
+
+    def __init__(self, addr: str):
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        host, port = addr.rsplit(":", 1)
+        self._addr = (host, int(port))
+
+    # -- client ----------------------------------------------------------
+    def _rpc(self, req: dict):
+        import json
+        import socket
+
+        with socket.create_connection(self._addr, timeout=10) as s:
+            s.sendall(json.dumps(req).encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        return json.loads(buf.decode())
+
+    def set(self, key: str, value) -> None:
+        import base64
+
+        if isinstance(value, str):
+            value = value.encode()
+        self._rpc({"op": "set", "k": key,
+                   "v": base64.b64encode(value).decode()})
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        import base64
+
+        r = self._rpc({"op": "get", "k": key})
+        return None if r.get("v") is None else base64.b64decode(r["v"])
+
+    def get(self, key: str, timeout: float = 300.0) -> bytes:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.try_get(key)
+            if v is not None:
+                return v
+            time.sleep(0.05)
+        raise TimeoutError(f"store key {key!r} not set within {timeout}s")
+
+    def delete(self, key: str) -> None:
+        self._rpc({"op": "del", "k": key})
+
+    def list(self, prefix: str = "") -> List[str]:
+        # FileStore parity: '/' in stored keys is flattened to '__' in
+        # listings (elastic parses names with split("__"))
+        return [k.replace("/", "__")
+                for k in self._rpc({"op": "list", "p": prefix})["keys"]]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return int(self._rpc({"op": "add", "k": key,
+                              "n": int(amount)})["v"])
+
+    def wait(self, keys, timeout: float = 300.0) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    # -- server ----------------------------------------------------------
+    @staticmethod
+    def serve(host: str = "127.0.0.1", port: int = 0):
+        """Start the store server on a daemon thread; returns
+        (tcp_spec, shutdown_fn)."""
+        import base64
+        import json
+        import socket
+        import socketserver
+        import threading
+
+        data = {}
+        lock = threading.Lock()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    req = json.loads(self.rfile.readline().decode())
+                except Exception:
+                    return
+                op = req.get("op")
+                with lock:
+                    if op == "set":
+                        data[req["k"]] = base64.b64decode(req["v"])
+                        resp = {"ok": 1}
+                    elif op == "get":
+                        v = data.get(req["k"])
+                        resp = {"v": None if v is None
+                                else base64.b64encode(v).decode()}
+                    elif op == "del":
+                        data.pop(req["k"], None)
+                        resp = {"ok": 1}
+                    elif op == "list":
+                        p = req.get("p", "")
+                        resp = {"keys": [k for k in data if
+                                         k.startswith(p)]}
+                    elif op == "add":
+                        cur = int(data.get(req["k"], b"0")) + req["n"]
+                        data[req["k"]] = str(cur).encode()
+                        resp = {"v": cur}
+                    else:
+                        resp = {"err": f"bad op {op!r}"}
+                self.wfile.write(json.dumps(resp).encode() + b"\n")
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        srv = Server((host, port), Handler)
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        spec = f"tcp://{host}:{srv.server_address[1]}"
+        return spec, srv.shutdown

@@ -39,11 +39,17 @@ _flags.define_flag("step_timeout_s", float(os.environ.get(
     "            restart loop then re-forms the gang")
 
 
+ABORT_KEY = "watchdog_abort"
+ABORT_POLL_S = float(os.environ.get("PADDLE_ABORT_POLL", "1.0"))
+
+
 class StepWatchdog:
     def __init__(self, timeout: Optional[float] = None,
-                 on_timeout: Optional[Callable] = None):
+                 on_timeout: Optional[Callable] = None,
+                 on_remote_abort: Optional[Callable] = None):
         self._timeout = timeout
         self._on_timeout = on_timeout
+        self._on_remote_abort = on_remote_abort
         self._entries: Dict[int, tuple] = {}  # id -> (tag, deadline)
         self._lock = threading.Lock()
         self._seq = 0
@@ -51,6 +57,16 @@ class StepWatchdog:
         self._prober: Optional[threading.Thread] = None
         self._probe_q = None
         self.fired = False
+        self._store = None  # resolved lazily (False = last attempt failed)
+        self._store_retry_at = 0.0
+        self._abort_polled = 0.0
+        # generation baseline: the abort record present when THIS process
+        # first looked (a leftover from a previous gang incarnation) —
+        # only a CHANGED record triggers the gang exit. Wall-clock-free,
+        # so cross-host clock skew cannot drop fresh aborts or replay
+        # stale ones.
+        self._abort_baseline = None
+        self._baseline_read = False
 
     @property
     def timeout(self) -> float:
@@ -152,19 +168,121 @@ class StepWatchdog:
                 # default path aborts the process; a custom on_timeout
                 # handler keeps the monitor alive for later steps
                 self._fire(really_expired)
+            if time.monotonic() - self._abort_polled >= ABORT_POLL_S:
+                self._abort_polled = time.monotonic()
+                self._check_remote_abort()
+
+    # -- cross-rank abort (the comm_task_manager gang-abort role:
+    # paddle/phi/core/distributed/comm_task_manager.cc aborts the whole
+    # process group, not just the hung rank) -----------------------------
+    def _get_store(self):
+        if self._store not in (None, False):
+            return self._store
+        # a failed attempt is retried after a backoff — the distributed
+        # runtime often comes up AFTER the first step is armed, and a
+        # permanently cached failure would silently disable the abort
+        # broadcast for the life of the process
+        now = time.monotonic()
+        if self._store is False and now - self._store_retry_at < 10.0:
+            return None
+        self._store_retry_at = now
+        try:
+            from paddle_tpu.distributed.store import current_store
+
+            self._store = current_store() or False
+        except Exception:
+            self._store = False
+        return self._store or None
+
+    def _post_abort(self, tags: str):
+        """Broadcast 'rank R hung on tag T' so surviving ranks exit
+        immediately instead of waiting out their own timeouts."""
+        store = self._get_store()
+        if store is None:
+            return
+        try:
+            import json
+            import uuid
+
+            from paddle_tpu.distributed import env
+
+            store.set(ABORT_KEY, json.dumps(
+                {"rank": env.get_rank(), "tags": tags,
+                 "timeout_s": self.timeout, "ts": time.time(),
+                 "gen": uuid.uuid4().hex}))
+        except Exception:
+            pass
+
+    def _check_remote_abort(self):
+        if self.fired:
+            return
+        store = self._get_store()
+        if store is None:
+            return
+        try:
+            v = store.try_get(ABORT_KEY)
+        except Exception:
+            return
+        if not self._baseline_read:
+            # first look: whatever is already there predates this
+            # process (a previous gang incarnation's record)
+            self._abort_baseline = v
+            self._baseline_read = True
+            return
+        if not v or v == self._abort_baseline:
+            return
+        import json
+
+        try:
+            info = json.loads(v.decode())
+        except Exception:
+            info = {"rank": "?", "tags": v.decode(errors="replace")}
+        from paddle_tpu.distributed import env
+
+        if info.get("rank") == env.get_rank():
+            return  # our own post
+        self.fired = True
+        sys.stderr.write(
+            f"\n[watchdog] rank {info.get('rank')} aborted on "
+            f"[{info.get('tags')}] — exiting with the gang so the "
+            f"launcher can restart all ranks together\n")
+        sys.stderr.flush()
+        if self._on_remote_abort is not None:
+            self._on_remote_abort(info)
+        else:
+            os._exit(7)
+
+    def start_abort_watch(self):
+        """Start the monitor even before any step is armed, so an idle
+        rank still reacts to a peer's abort broadcast."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._monitor is None:
+                self._monitor = threading.Thread(target=self._watch,
+                                                 daemon=True)
+                self._monitor.start()
 
     def _fire(self, expired):
         self.fired = True
         tags = ", ".join(ent[0] for ent in expired)
+        try:
+            from paddle_tpu.distributed import env
+
+            rank = env.get_rank()
+        except Exception:
+            rank = "?"
         sys.stderr.write(
-            f"\n[watchdog] step(s) [{tags}] exceeded {self.timeout}s "
-            f"deadline — device appears hung; dumping host stacks and "
-            f"aborting so the launcher can restart the gang\n")
+            f"\n[watchdog] rank {rank}: step(s) [{tags}] exceeded "
+            f"{self.timeout}s deadline — device appears hung; dumping "
+            f"host stacks, broadcasting abort, and exiting so the "
+            f"launcher can restart the gang\n")
         sys.stderr.flush()
         try:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         except Exception:
             pass
+        self._post_abort(tags)
         if self._on_timeout is not None:
             self._on_timeout(expired)
         else:

@@ -27,7 +27,7 @@ from paddle_tpu.nn.activation import (  # noqa: F401
 from paddle_tpu.nn.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
     CTCLoss, HingeLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
-    NLLLoss, SmoothL1Loss,
+    NLLLoss, RNNTLoss, SmoothL1Loss,
 )
 from paddle_tpu.nn.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

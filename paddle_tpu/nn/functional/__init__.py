@@ -94,9 +94,23 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return loss
 
 
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T / transducer loss (reference:
+    python/paddle/nn/functional/loss.py:1983 over warp-transducer).
+    ``input``: [B, Tmax, Umax+1, D] unscaled joint-network outputs."""
+    loss = _API["rnnt"](input, label, input_lengths, label_lengths,
+                        blank=blank, fastemit_lambda=fastemit_lambda)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
 __all__ = _F_OPS + ["upsample", "flash_attention", "sequence_mask",
                     "label_smooth", "affine_grid", "grid_sample",
-                    "ctc_loss"]
+                    "ctc_loss", "rnnt_loss"]
 
 # module-path parity with the reference: the implementation lives in
 # the flash_attention SUBMODULE; re-importing the names here makes

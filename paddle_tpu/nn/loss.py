@@ -6,7 +6,7 @@ from paddle_tpu.nn.layer import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "HingeLoss",
-           "MarginRankingLoss", "CosineEmbeddingLoss", "CTCLoss"]
+           "MarginRankingLoss", "CosineEmbeddingLoss", "CTCLoss", "RNNTLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -152,3 +152,22 @@ class CTCLoss(Layer):
         return F.ctc_loss(logits, labels, input_lengths, label_lengths,
                           blank=self.blank, reduction=self.reduction,
                           norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """Reference: python/paddle/nn/layer/loss.py RNNTLoss over
+    functional.rnnt_loss (loss.py:1983) — warp-transducer semantics."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from paddle_tpu.nn import functional as F
+
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -925,3 +925,91 @@ def warpctc(logits, labels, input_lengths, label_lengths, blank=0,
     if norm_by_times:
         loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
     return loss.astype(logits.dtype)
+
+
+@op
+def rnnt(logits, labels, input_lengths, label_lengths, blank=0,
+         fastemit_lambda=0.0):
+    """RNN-T (transducer) loss per batch element (the warp-transducer
+    role — reference python/paddle/nn/functional/loss.py:1983 rnnt_loss).
+
+    ``logits``: [B, T, U+1, D] UNSCALED joint-network outputs (softmax
+    applied internally, warp-transducer convention); ``labels``:
+    [B, U] int32. Log-domain forward DP over a ``lax.scan`` per time
+    frame with an inner scan along the label axis. FastEmit
+    regularization scales the gradient of label-emission log-probs by
+    (1 + lambda) via the value-preserving ``e + lam*(e - stop_grad(e))``
+    identity (arxiv 2010.11148 — gradient-level definition)."""
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    in_len = jnp.asarray(input_lengths).astype(jnp.int32)
+    lab_len = jnp.asarray(label_lengths).astype(jnp.int32)
+    B, T, U1, D = logits.shape
+    U = U1 - 1
+    NEG = jnp.asarray(-1e30, jnp.float32)
+
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    blank_lp = lp[..., blank]                          # [B, T, U+1]
+    # emission log-prob of label u at each (t, u): lp[b,t,u,labels[b,u]]
+    lab_idx = jnp.broadcast_to(labels[:, None, :], (B, T, U))
+    emit_lp = jnp.take_along_axis(lp[:, :, :U, :], lab_idx[..., None],
+                                  axis=3)[..., 0]      # [B, T, U]
+    if fastemit_lambda:
+        emit_lp = emit_lp + fastemit_lambda * (
+            emit_lp - lax.stop_gradient(emit_lp))
+
+    u_range = jnp.arange(U1)
+    valid_u = u_range[None, :] <= lab_len[:, None]     # [B, U+1]
+
+    def emit_row(alpha_row, e_row):
+        """alpha[t, u] = logaddexp(base[u], alpha[t, u-1] + e[u-1]) —
+        sequential in u: inner scan along the label axis."""
+
+        def step(carry, x):
+            base_u, e_prev = x
+            m = jnp.maximum(base_u, carry + e_prev)
+            m_safe = jnp.where(m <= NEG, 0.0, m)
+            s = jnp.exp(base_u - m_safe) + jnp.exp(carry + e_prev
+                                                   - m_safe)
+            out = jnp.where(m <= NEG, NEG,
+                            m_safe + jnp.log(jnp.where(m <= NEG, 1.0,
+                                                       s)))
+            return out, out
+
+        # u = 0 has no horizontal predecessor
+        first = alpha_row[:, 0]
+        _, rest = lax.scan(
+            step, first,
+            (alpha_row[:, 1:].swapaxes(0, 1),
+             e_row[:, :U1 - 1].swapaxes(0, 1)))
+        return jnp.concatenate([first[:, None],
+                                rest.swapaxes(0, 1)], axis=1)
+
+    # t = 0 row: alpha[0, u] = sum of emissions along u
+    base0 = jnp.full((B, U1), NEG).at[:, 0].set(0.0)
+    alpha = emit_row(base0, emit_lp[:, 0] if U > 0
+                     else jnp.zeros((B, 0), jnp.float32))
+    alpha = jnp.where(valid_u, alpha, NEG)
+
+    def frame(alpha_prev, t):
+        # vertical (blank) transition from frame t-1, then horizontal
+        # (emit) closure within frame t
+        base = alpha_prev + blank_lp[:, t - 1]
+        e = emit_lp[:, t] if U > 0 else jnp.zeros((B, 0), jnp.float32)
+        row = emit_row(base, e)
+        row = jnp.where(valid_u, row, NEG)
+        # frames past input_length leave alpha frozen
+        row = jnp.where((t < in_len)[:, None], row, alpha_prev)
+        return row, None
+
+    if T > 1:
+        alpha, _ = lax.scan(frame, alpha, jnp.arange(1, T))
+
+    # terminate: blank at (T_b - 1, U_b)
+    bidx = jnp.arange(B)
+    final_blank = blank_lp[bidx, jnp.maximum(in_len - 1, 0), :]
+    final_blank = jnp.take_along_axis(final_blank, lab_len[:, None],
+                                      axis=1)[:, 0]
+    alpha_end = jnp.take_along_axis(alpha, lab_len[:, None], axis=1)[:, 0]
+    loss = -(alpha_end + final_blank)
+    return loss.astype(logits.dtype)

@@ -23,7 +23,12 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
            "add", "subtract", "multiply", "divide", "matmul", "relu",
            "tanh", "sqrt", "sin", "abs", "pow", "neg", "cast",
-           "transpose", "softmax", "masked_matmul"]
+           "transpose", "softmax", "masked_matmul",
+           # round-5 depth (reference unary/binary/multiary parity)
+           "tan", "asin", "atan", "sinh", "asinh", "atanh", "square",
+           "log1p", "expm1", "rad2deg", "deg2rad", "isnan", "coalesce",
+           "sum", "reshape", "slice", "mv", "is_same_shape", "addmm",
+           "pca_lowrank", "nn"]
 
 
 class _SparseBase:
@@ -278,3 +283,106 @@ def _tensor_to_sparse_csr(self):
 
 Tensor.to_sparse_coo = _tensor_to_sparse_coo
 Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+# ---------------------------------------------------------------------------
+# round-5 depth: the rest of the reference unary/binary/multiary surface
+# (python/paddle/sparse/unary.py, binary.py, multiary.py). Zero-preserving
+# unaries act on stored values only; structure-changing ops (reshape,
+# slice, reductions) run DENSE on the MXU and re-sparsify — on TPU,
+# sparsity is a memory format, not a compute strategy (the ASP 2:4 story),
+# so format round-trips beat scalar scatter loops.
+# ---------------------------------------------------------------------------
+
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+
+
+def isnan(x):
+    m = _coo(x)
+    return _wrap_like(x, jsparse.BCOO((jnp.isnan(m.data), m.indices),
+                                      shape=m.shape))
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference sparse.coalesce)."""
+    m = _coo(x)
+    return _wrap_like(x, m.sum_duplicates(nse=m.nse))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """Reference sparse.sum — result stays sparse (values computed via a
+    dense reduction: reductions produce near-dense results anyway)."""
+    dense = _coo(x).todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from paddle_tpu.core.dtype import to_jax
+
+        out = out.astype(to_jax(dtype))
+    if out.ndim == 0:
+        return Tensor._from_data(out)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def reshape(x, shape):
+    dense = _coo(x).todense().reshape([int(s) for s in shape])
+    return _wrap_like(x, jsparse.BCOO.fromdense(dense))
+
+
+_py_slice = slice  # captured before ``def slice`` shadows the builtin
+
+
+def slice(x, axes, starts, ends):
+    """Reference sparse.slice: slice along ``axes``."""
+    dense = _coo(x).todense()
+    idx = [_py_slice(None)] * dense.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[int(a)] = _py_slice(int(s), int(e))
+    return _wrap_like(x, jsparse.BCOO.fromdense(dense[tuple(idx)]))
+
+
+def mv(x, vec) -> Tensor:
+    """sparse matrix @ dense vector (reference sparse.mv)."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor._from_data(_coo(x) @ v)
+
+
+def is_same_shape(x, y) -> bool:
+    sx = x.shape if not isinstance(x, _SparseBase) else x._mat.shape
+    sy = y.shape if not isinstance(y, _SparseBase) else y._mat.shape
+    return tuple(sx) == tuple(sy)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0) -> Tensor:
+    """beta*input + alpha*(x @ y) (reference sparse.addmm; x sparse)."""
+    iv = input._data if isinstance(input, Tensor) else \
+        _coo(input).todense()
+    yv = y._data if isinstance(y, Tensor) else _coo(y).todense()
+    return Tensor._from_data(beta * iv + alpha * (_coo(x) @ yv))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    """Reference sparse.pca_lowrank — rank-q PCA of a sparse matrix.
+    Computed via dense SVD (TPU MXU path; the randomized iteration of
+    the reference is a CPU/GPU memory optimization)."""
+    dense = _coo(x).todense()
+    m, n = dense.shape
+    k = int(q) if q is not None else min(6, m, n)
+    if center:
+        dense = dense - dense.mean(axis=0, keepdims=True)
+    u, s, vt = jnp.linalg.svd(dense, full_matrices=False)
+    return (Tensor._from_data(u[:, :k]), Tensor._from_data(s[:k]),
+            Tensor._from_data(vt[:k].T))
+
+
+# sparse.nn subpackage (imported last: it reuses this module's helpers)
+from paddle_tpu.sparse import nn  # noqa: E402,F401

@@ -1,0 +1,287 @@
+"""paddle.sparse.nn — layers over sparse COO activations.
+
+Reference: python/paddle/sparse/nn/ (functional/conv.py conv2d/conv3d +
+submanifold variants over phi/kernels/sparse/gpu/conv_kernel.cu,
+functional/pooling.py max_pool3d, layer/norm.py BatchNorm,
+layer/activation.py) — CUDA gather-GEMM-scatter kernels over active
+sites.
+
+TPU-native design: on TPU the MXU wants dense tiles, so sparse conv
+runs DENSE (densify -> lax.conv -> re-sparsify), and the SUBMANIFOLD
+variants additionally mask the output to the input's active sites —
+bit-identical semantics to the reference's site-gather kernels for the
+point-cloud use case, with the sparse COO format preserved end to end.
+This is the same design stance as ASP 2:4 (sparsity as a memory/
+selection format; compute stays dense where the hardware wants it).
+Layout follows the reference's sparse conv convention: channels-last
+(NDHWC / NHWC), dense channel dim.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.sparse import (
+    SparseCooTensor, _coo, _wrap_like,
+)
+
+__all__ = ["functional", "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "BatchNorm",
+           "MaxPool3D"]
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(a) for a in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
+             subm):
+    """Shared dense-compute sparse conv. x: SparseCoo [N, *spatial, C]
+    (channels last, reference sparse conv layout); weight:
+    [*k, C_in/groups, C_out] (reference sparse conv kernel layout)."""
+    m = _coo(x)
+    dense = m.todense()
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding, nd)
+        pad = [(int(a), int(a)) for a in p]
+    # NHWC/NDHWC x HWIO/DHWIO -> NHWC/NDHWC
+    spec = ("NHWC", "HWIO", "NHWC") if nd == 2 else \
+        ("NDHWC", "DHWIO", "NDHWC")
+    dn = lax.conv_dimension_numbers(dense.shape, w.shape, spec)
+    out = lax.conv_general_dilated(
+        dense, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=int(groups))
+    if bias is not None:
+        b = bias._data if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + b
+    if subm:
+        # submanifold: outputs exist ONLY at the input's active sites
+        # (reference subm_conv kernels). Active = the COO INDEX SET, not
+        # value!=0 — an explicitly-stored zero (e.g. a relu'd-to-zero
+        # site) is still an active site and must keep its output.
+        active = jnp.zeros(dense.shape[:-1], bool)
+        active = active.at[tuple(m.indices[:, i]
+                                 for i in range(m.indices.shape[1]))
+                           ].set(True)
+        out = jnp.where(active[..., None], out, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
+
+
+class functional:
+    """paddle.sparse.nn.functional."""
+
+    @staticmethod
+    def relu(x):
+        from paddle_tpu import sparse as sp
+
+        return sp.relu(x)
+
+    @staticmethod
+    def relu6(x):
+        m = _coo(x)
+        return _wrap_like(x, jsparse.BCOO(
+            (jnp.clip(m.data, 0.0, 6.0), m.indices), shape=m.shape))
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01):
+        m = _coo(x)
+        return _wrap_like(x, jsparse.BCOO(
+            (jnp.where(m.data > 0, m.data, negative_slope * m.data),
+             m.indices), shape=m.shape))
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        from paddle_tpu import sparse as sp
+
+        return sp.softmax(x, axis=axis)
+
+    @staticmethod
+    def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NHWC"):
+        return _conv_nd(x, weight, bias, stride, padding, dilation,
+                        groups, 2, subm=False)
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NDHWC"):
+        return _conv_nd(x, weight, bias, stride, padding, dilation,
+                        groups, 3, subm=False)
+
+    @staticmethod
+    def subm_conv2d(x, weight, bias=None, stride=1, padding=0,
+                    dilation=1, groups=1, data_format="NHWC", key=None):
+        return _conv_nd(x, weight, bias, stride, padding, dilation,
+                        groups, 2, subm=True)
+
+    @staticmethod
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0,
+                    dilation=1, groups=1, data_format="NDHWC", key=None):
+        return _conv_nd(x, weight, bias, stride, padding, dilation,
+                        groups, 3, subm=True)
+
+    @staticmethod
+    def max_pool3d(x, kernel_size, stride=None, padding=0,
+                   data_format="NDHWC"):
+        dense = _coo(x).todense()
+        k = _pair(kernel_size, 3)
+        s = _pair(stride if stride is not None else kernel_size, 3)
+        p = _pair(padding, 3)
+        out = lax.reduce_window(
+            dense, -jnp.inf, lax.max,
+            window_dimensions=(1,) + k + (1,),
+            window_strides=(1,) + s + (1,),
+            padding=((0, 0),) + tuple((a, a) for a in p) + ((0, 0),))
+        out = jnp.where(jnp.isneginf(out), 0.0, out)
+        return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
+
+
+class _SparseConvBase(Layer):
+    _nd = 2
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 key=None):
+        super().__init__()
+        import numpy as np
+
+        from paddle_tpu.core import generator as gen
+
+        nd = self._nd
+        k = _pair(kernel_size, nd)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        from paddle_tpu.nn.layer import Parameter
+
+        fan_in = in_channels * int(np.prod(k))
+        bound = 1.0 / max(fan_in, 1) ** 0.5
+        w = jax.random.uniform(
+            gen.active_key(), k + (in_channels // groups, out_channels),
+            minval=-bound, maxval=bound)
+        self.weight = Parameter(w)  # __setattr__ registers it
+        if bias_attr is not False:
+            b = jax.random.uniform(gen.active_key(), (out_channels,),
+                                   minval=-bound, maxval=bound)
+            self.bias = Parameter(b)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return _conv_nd(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._nd, subm=self._subm)
+
+
+class Conv2D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.Conv2D (functional/conv.py:693)."""
+    _nd = 2
+
+
+class Conv3D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.Conv3D (functional/conv.py:363)."""
+    _nd = 3
+
+
+class SubmConv2D(_SparseConvBase):
+    """Reference: subm_conv2d (functional/conv.py:797) — output sparsity
+    pinned to the input's active sites."""
+    _nd = 2
+    _subm = True
+
+
+class SubmConv3D(_SparseConvBase):
+    """Reference: subm_conv3d (functional/conv.py:469)."""
+    _nd = 3
+    _subm = True
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self._k, self._s, self._p)
+
+
+class BatchNorm(Layer):
+    """Reference: paddle.sparse.nn.BatchNorm (layer/norm.py) — batch
+    norm over the dense channel dim of the STORED values (statistics
+    over active sites only, matching the reference's semantics)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn.layer import Parameter
+
+        self._momentum = momentum
+        self._eps = epsilon
+        self.weight = Parameter(jnp.ones((num_features,)))
+        self.bias = Parameter(jnp.zeros((num_features,)))
+        self.register_buffer(
+            "_mean", Tensor._from_data(jnp.zeros((num_features,))))
+        self.register_buffer(
+            "_variance", Tensor._from_data(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        m = _coo(x)
+        vals = m.data  # [nnz, C]
+        if self.training:
+            mu = vals.mean(axis=0)
+            var = vals.var(axis=0)
+            mom = self._momentum
+            self._mean._data = mom * self._mean._data + (1 - mom) * mu
+            self._variance._data = (mom * self._variance._data
+                                    + (1 - mom) * var)
+        else:
+            mu, var = self._mean._data, self._variance._data
+        wd = self.weight._data
+        bd = self.bias._data
+        out = (vals - mu) / jnp.sqrt(var + self._eps) * wd + bd
+        return _wrap_like(x, jsparse.BCOO((out, m.indices),
+                                          shape=m.shape))

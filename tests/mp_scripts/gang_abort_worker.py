@@ -1,0 +1,34 @@
+"""Cross-rank abort worker (driven by test_elastic.py).
+
+Rank 1 arms a tagged collective probe that never completes — its
+watchdog must fire with the tag, broadcast the abort through the store,
+and exit 6. Rank 0 is healthy (no hung work) and must learn of rank 1's
+abort via the store watch and exit 7 well before its own (absent)
+timeout would ever fire. Reference contract: comm_task_manager.cc abort
+propagates to the whole process group."""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed.watchdog import default_watchdog
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+wd = default_watchdog()
+print(f"rank {rank} up, watchdog enabled={wd.enabled}", flush=True)
+
+if rank == 1:
+    # a collective that never completes: arm with the collective tag and
+    # never attach/disarm (the _eager_collective probe shape)
+    wd.arm("all_reduce@ranks[0, 1]")
+    time.sleep(60)
+    print("RANK1_SHOULD_NOT_REACH_HERE", flush=True)
+else:
+    wd.start_abort_watch()
+    # healthy training loop stand-in
+    for _ in range(600):
+        time.sleep(0.1)
+    print("RANK0_SHOULD_NOT_REACH_HERE", flush=True)

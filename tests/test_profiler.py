@@ -112,3 +112,51 @@ def test_device_summary_reports_xla_ops(tmp_path):
     if stats:  # device plane present (CPU backend still records XLA ops)
         row = next(iter(stats.values()))
         assert {"calls", "total_ms", "avg_ms"} <= set(row)
+
+
+def test_phase_classifier():
+    """XLA op name -> phase bucket (the profiler_statistic.py
+    kernel/communication/memcpy categories, VERDICT r4 #9)."""
+    from paddle_tpu.profiler import Profiler
+
+    assert Profiler.classify_phase("fusion.123") == "compute"
+    assert Profiler.classify_phase("dot_general.7") == "compute"
+    assert Profiler.classify_phase("all-reduce.1") == "collective"
+    assert Profiler.classify_phase("all-gather-start") == "collective"
+    assert Profiler.classify_phase("reduce-scatter.2") == "collective"
+    assert Profiler.classify_phase("collective-permute.5") == "collective"
+    assert Profiler.classify_phase("copy.4") == "copy"
+    assert Profiler.classify_phase("copy-start.1") == "copy"
+    assert Profiler.classify_phase("infeed") == "copy"
+
+
+def test_phase_summary_graceful_without_device_trace(tmp_path):
+    """On backends without a device plane (CPU tests), phase_summary
+    returns {} and summary() stays usable."""
+    from paddle_tpu import profiler
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                             trace_dir=str(tmp_path))
+    prof.start()
+    import paddle_tpu as paddle
+    (paddle.ones([8]) * 2).sum()
+    prof.stop()
+    assert prof.phase_summary(print_table=False) == {}
+    s = prof.summary(print_table=False)
+    assert "_device_phases" not in s
+
+
+def test_summary_reports_pipeline_schedule():
+    from paddle_tpu import profiler
+
+    class FakeStep:
+        schedule = "interleave"
+        bubble_fraction = 0.1579
+        S, V, M = 4, 2, 8
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    prof.stop()
+    s = prof.summary(print_table=False, pipeline_step=FakeStep())
+    assert s["_pipeline_schedule"]["schedule"] == "interleave"
+    assert s["_pipeline_schedule"]["bubble_fraction"] == 0.1579

@@ -354,3 +354,117 @@ def test_rprop_validates_ranges():
                         learning_rate_range=(1e-4, 1.0), parameters=[p])
     with pytest.raises(ValueError):
         optimizer.Rprop(etas=(1.5, 1.2), parameters=[p])
+
+
+# ---------------------------------------------------------------------------
+# rnnt_loss
+# ---------------------------------------------------------------------------
+
+def _np_rnnt_loss(logits, labels, T, U, blank=0):
+    """Direct log-domain transducer forward DP in numpy."""
+    mx = logits.max(-1, keepdims=True)
+    lp = logits - np.log(np.exp(logits - mx).sum(-1, keepdims=True)) - mx
+    NEG = -1e30
+    alpha = np.full((T, U + 1), NEG)
+
+    def la(a, b):
+        m = max(a, b)
+        return NEG if m <= NEG else \
+            m + np.log(np.exp(a - m) + np.exp(b - m))
+
+    alpha[0, 0] = 0.0
+    for u in range(1, U + 1):
+        alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[u - 1]]
+    for t in range(1, T):
+        alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+        for u in range(1, U + 1):
+            alpha[t, u] = la(
+                alpha[t - 1, u] + lp[t - 1, u, blank],
+                alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def test_rnnt_loss_matches_numpy_dp():
+    rng = np.random.default_rng(0)
+    B, T, U, D = 3, 6, 3, 5
+    logits = rng.standard_normal((B, T, U + 1, D)).astype("float32")
+    labels = rng.integers(1, D, (B, U)).astype("int32")
+    in_len = np.asarray([6, 5, 4], "int64")
+    lab_len = np.asarray([3, 2, 1], "int64")
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len),
+                      paddle.to_tensor(lab_len), fastemit_lambda=0.0,
+                      reduction="none").numpy()
+    for b in range(B):
+        want = _np_rnnt_loss(logits[b], labels[b], int(in_len[b]),
+                             int(lab_len[b]))
+        np.testing.assert_allclose(got[b], want, rtol=1e-4)
+
+
+def test_rnnt_loss_grad_finite_difference():
+    rng = np.random.default_rng(1)
+    B, T, U, D = 1, 4, 2, 4
+    logits = rng.standard_normal((B, T, U + 1, D)).astype("float32")
+    labels = np.asarray([[1, 2]], "int32")
+    il = paddle.to_tensor(np.asarray([4], "int64"))
+    ll = paddle.to_tensor(np.asarray([2], "int64"))
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    F.rnnt_loss(lt, paddle.to_tensor(labels), il, ll,
+                fastemit_lambda=0.0, reduction="sum").backward()
+    eps = 1e-3
+    for idx in [(0, 0, 0, 1), (0, 2, 1, 0), (0, 3, 2, 3)]:
+        p1, p2 = logits.copy(), logits.copy()
+        p1[idx] += eps
+        p2[idx] -= eps
+        fd = (_np_rnnt_loss(p1[0], labels[0], 4, 2)
+              - _np_rnnt_loss(p2[0], labels[0], 4, 2)) / (2 * eps)
+        np.testing.assert_allclose(lt.grad.numpy()[idx], fd, atol=5e-3)
+
+
+def test_rnnt_fastemit_preserves_value_changes_grad():
+    """FastEmit (arxiv 2010.11148) is gradient-level regularization: the
+    loss VALUE is unchanged, label-emission gradients are scaled."""
+    rng = np.random.default_rng(2)
+    B, T, U, D = 2, 5, 2, 4
+    logits = rng.standard_normal((B, T, U + 1, D)).astype("float32")
+    labels = rng.integers(1, D, (B, U)).astype("int32")
+    il = paddle.to_tensor(np.asarray([5, 4], "int64"))
+    ll = paddle.to_tensor(np.asarray([2, 1], "int64"))
+    args = (paddle.to_tensor(labels), il, ll)
+    l0 = F.rnnt_loss(paddle.to_tensor(logits), *args,
+                     fastemit_lambda=0.0, reduction="none").numpy()
+    l1 = F.rnnt_loss(paddle.to_tensor(logits), *args,
+                     fastemit_lambda=0.5, reduction="none").numpy()
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    g = []
+    for lam in (0.0, 0.5):
+        lt = paddle.to_tensor(logits)
+        lt.stop_gradient = False
+        F.rnnt_loss(lt, *args, fastemit_lambda=lam,
+                    reduction="sum").backward()
+        g.append(lt.grad.numpy())
+    assert not np.allclose(g[0], g[1])
+
+
+def test_rnnt_toy_model_trains():
+    paddle.seed(0)
+    B, T, U, D = 4, 8, 3, 5
+    joint = nn.Linear(8, D)
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=joint.parameters())
+    crit = nn.RNNTLoss(blank=0, fastemit_lambda=0.0)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((B, T, U + 1, 8)).astype("float32"))
+    labels = paddle.to_tensor(rng.integers(1, D, (B, U)).astype("int32"))
+    il = paddle.to_tensor(np.full(B, T, "int64"))
+    ll = paddle.to_tensor(np.full(B, U, "int64"))
+    losses = []
+    for _ in range(40):
+        loss = crit(joint(x), labels, il, ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.6, losses[::10]

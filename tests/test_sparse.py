@@ -93,3 +93,164 @@ def test_sparse_transpose_and_cast():
     s = paddle.to_tensor(d).to_sparse_coo()
     t = sparse.transpose(s, [1, 0])
     np.testing.assert_array_equal(t.to_dense().numpy(), d.T)
+
+
+# ---------------------------------------------------------------------------
+# round-5 depth: reference unary/binary/multiary parity + sparse.nn
+# (python/paddle/sparse/unary.py, binary.py, multiary.py, nn/)
+# ---------------------------------------------------------------------------
+
+def _rand_sparse(shape=(4, 6), density=0.4, seed=0):
+    rng = np.random.default_rng(seed)
+    d = (rng.standard_normal(shape).astype("float32")
+         * (rng.random(shape) < density))
+    return d, paddle.to_tensor(d).to_sparse_coo()
+
+
+def test_sparse_unary_depth():
+    d, x = _rand_sparse()
+    for name, ref in [("square", np.square), ("log1p", np.log1p),
+                      ("expm1", np.expm1), ("tan", np.tan),
+                      ("atan", np.arctan), ("sinh", np.sinh),
+                      ("asinh", np.arcsinh),
+                      ("rad2deg", np.rad2deg), ("deg2rad", np.deg2rad)]:
+        got = getattr(sparse, name)(x).to_dense().numpy()
+        np.testing.assert_allclose(got, ref(d) * (d != 0), rtol=1e-4,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_sparse_sum_reshape_slice():
+    d, x = _rand_sparse()
+    np.testing.assert_allclose(sparse.sum(x).numpy(), d.sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        sparse.sum(x, axis=1).to_dense().numpy(), d.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        sparse.reshape(x, [6, 4]).to_dense().numpy(),
+        d.reshape(6, 4), rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.slice(x, [0, 1], [1, 2], [3, 5]).to_dense().numpy(),
+        d[1:3, 2:5], rtol=1e-6)
+
+
+def test_sparse_mv_addmm_is_same_shape():
+    d, x = _rand_sparse()
+    rng = np.random.default_rng(1)
+    v = rng.standard_normal(6).astype("float32")
+    np.testing.assert_allclose(sparse.mv(x, paddle.to_tensor(v)).numpy(),
+                               d @ v, rtol=1e-4)
+    inp = rng.standard_normal((4, 3)).astype("float32")
+    y = rng.standard_normal((6, 3)).astype("float32")
+    np.testing.assert_allclose(
+        sparse.addmm(paddle.to_tensor(inp), x, paddle.to_tensor(y),
+                     beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp + 2.0 * (d @ y), rtol=1e-4)
+    _, x2 = _rand_sparse(seed=2)
+    assert sparse.is_same_shape(x, x2)
+    _, x3 = _rand_sparse(shape=(3, 6), seed=2)
+    assert not sparse.is_same_shape(x, x3)
+
+
+def test_sparse_pca_lowrank():
+    d, x = _rand_sparse(shape=(8, 5))
+    U, S, V = sparse.pca_lowrank(x, q=3)
+    assert tuple(U.shape) == (8, 3)
+    assert tuple(S.shape) == (3,)
+    assert tuple(V.shape) == (5, 3)
+    # principal directions reconstruct the centered matrix's energy
+    c = d - d.mean(0, keepdims=True)
+    recon = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+    full = np.linalg.svd(c, compute_uv=False)
+    assert np.abs(recon).sum() > 0
+    np.testing.assert_allclose(S.numpy(), full[:3], rtol=1e-4)
+
+
+def test_sparse_subm_conv_preserves_pattern():
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((1, 6, 6, 3)).astype("float32")
+    xs = xs * (rng.random((1, 6, 6, 1)) > 0.5)
+    x = sparse.SparseCooTensor(
+        jsparse.BCOO.fromdense(jnp.asarray(xs), n_dense=1))
+    conv = sparse.nn.SubmConv2D(3, 5, 3, padding=1)
+    out = conv(x).to_dense().numpy()
+    assert out.shape == (1, 6, 6, 5)
+    out_active = np.any(out != 0, axis=-1)
+    in_active = np.any(xs != 0, axis=-1)
+    assert (out_active <= in_active).all()
+
+
+def test_sparse_conv3d_matches_dense():
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((1, 4, 4, 4, 2)).astype("float32")
+    xs = xs * (rng.random((1, 4, 4, 4, 1)) > 0.4)
+    x = sparse.SparseCooTensor(
+        jsparse.BCOO.fromdense(jnp.asarray(xs), n_dense=1))
+    conv = sparse.nn.Conv3D(2, 3, 2)
+    out = conv(x).to_dense().numpy()
+    assert out.shape == (1, 3, 3, 3, 3)
+    # numerics: equal to the dense conv on the densified input
+    import jax
+    from jax import lax
+
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    dn = lax.conv_dimension_numbers(xs.shape, w.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    want = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(xs), jnp.asarray(w), (1, 1, 1), [(0, 0)] * 3,
+        dimension_numbers=dn)) + b
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_batchnorm_and_pool():
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    rng = np.random.default_rng(2)
+    xs = rng.standard_normal((1, 4, 4, 4, 2)).astype("float32")
+    x = sparse.SparseCooTensor(
+        jsparse.BCOO.fromdense(jnp.asarray(xs), n_dense=1))
+    bn = sparse.nn.BatchNorm(2)
+    bn.train()
+    out = bn(x)
+    vals = out.to_dense().numpy().reshape(-1, 2)
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+    p = sparse.nn.functional.max_pool3d(x, 2)
+    want = np.asarray(xs).reshape(1, 2, 2, 2, 2, 2, 2, 2).max(
+        axis=(2, 4, 6))
+    assert p.to_dense().numpy().shape == (1, 2, 2, 2, 2)
+
+
+def test_sparse_activations_nn():
+    d, x = _rand_sparse()
+    np.testing.assert_allclose(
+        sparse.nn.functional.relu6(x).to_dense().numpy(),
+        np.clip(d, 0, 6) * (d != 0), rtol=1e-6)
+    got = sparse.nn.functional.leaky_relu(x, 0.1).to_dense().numpy()
+    np.testing.assert_allclose(got, np.where(d > 0, d, 0.1 * d) * (d != 0),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_subm_conv_keeps_stored_zero_sites():
+    """relu can clamp an active site's values to stored 0.0; subm conv
+    must STILL treat it as active (index-set semantics, not value!=0)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    xs = np.zeros((1, 3, 3, 1), "float32")
+    xs[0, 1, 1, 0] = -2.0      # one active site, negative value
+    x = sparse.SparseCooTensor(
+        jsparse.BCOO.fromdense(jnp.asarray(xs), n_dense=1))
+    r = sparse.nn.functional.relu(x)   # value -> 0.0, index kept
+    conv = sparse.nn.SubmConv2D(1, 1, 1, bias_attr=True)
+    out = conv(r).to_dense().numpy()
+    # 1x1 conv of value 0 + bias b must appear AT the active site
+    b = float(conv.bias.numpy()[0])
+    np.testing.assert_allclose(out[0, 1, 1, 0], b, rtol=1e-6)
+    assert np.count_nonzero(out) <= 1 or b == 0.0
