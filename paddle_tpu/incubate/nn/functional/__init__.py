@@ -86,3 +86,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     q2, k2 = _registry.API["rope_apply"](q, k, cos2, sin2)
     v2 = v
     return q2, k2, v2
+
+
+from paddle_tpu.incubate.nn.functional.fused_ops import (  # noqa: E402,F401
+    fused_bias_dropout_residual_layer_norm, fused_dot_product_attention,
+    fused_dropout_add, fused_ec_moe, fused_feedforward, fused_gate_attention,
+    fused_layer_norm, fused_linear, fused_linear_activation,
+    fused_matmul_bias, fused_multi_head_attention, fused_multi_transformer,
+    masked_multihead_attention,
+)

@@ -1,0 +1,207 @@
+"""Incubate fused-op functional surface (reference:
+python/paddle/incubate/nn/functional/ — each is the reference kernel's
+documented pseudo-code composed over registry ops; XLA fuses the
+composition, so numerics are checked against direct numpy math).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_fused_linear_family(rng):
+    x = rng.standard_normal((4, 8)).astype("float32")
+    w = rng.standard_normal((8, 6)).astype("float32")
+    b = rng.standard_normal(6).astype("float32")
+    np.testing.assert_allclose(
+        IF.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(b)).numpy(),
+        x @ w + b, rtol=1e-4)
+    np.testing.assert_allclose(
+        IF.fused_linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b)).numpy(),
+        x @ w + b, rtol=1e-4)
+    np.testing.assert_allclose(
+        IF.fused_linear_activation(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            paddle.to_tensor(b), activation="relu").numpy(),
+        np.maximum(x @ w + b, 0), rtol=1e-4)
+
+
+def test_fused_layer_norm_bias_residual(rng):
+    xn = rng.standard_normal((2, 3, 8)).astype("float32")
+    res = rng.standard_normal((2, 3, 8)).astype("float32")
+    bb = rng.standard_normal(8).astype("float32")
+    gw = rng.standard_normal(8).astype("float32")
+    gb = rng.standard_normal(8).astype("float32")
+    got = IF.fused_layer_norm(
+        paddle.to_tensor(xn), paddle.to_tensor(gw), paddle.to_tensor(gb),
+        1e-5, residual_alpha=0.5, begin_norm_axis=2,
+        bias=paddle.to_tensor(bb), residual=paddle.to_tensor(res)).numpy()
+    y = xn + bb + 0.5 * res
+    want = ((y - y.mean(-1, keepdims=True))
+            / np.sqrt(y.var(-1, keepdims=True) + 1e-5) * gw + gb)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # norm_weight=None -> just the fused add (reference contract)
+    np.testing.assert_allclose(
+        IF.fused_layer_norm(paddle.to_tensor(xn), None, None, 1e-5,
+                            bias=paddle.to_tensor(bb)).numpy(),
+        xn + bb, rtol=1e-6)
+
+
+def test_fused_dropout_add(rng):
+    xn = rng.standard_normal((2, 3, 8)).astype("float32")
+    res = rng.standard_normal((2, 3, 8)).astype("float32")
+    np.testing.assert_allclose(
+        IF.fused_dropout_add(paddle.to_tensor(xn), paddle.to_tensor(res),
+                             p=0.7, training=False).numpy(),
+        xn + res, rtol=1e-6)
+    # training: kept positions upscaled, zeros elsewhere; sum of output
+    # minus res equals upscaled surviving x entries
+    out = IF.fused_dropout_add(paddle.to_tensor(np.ones_like(xn)),
+                               paddle.to_tensor(res), p=0.5,
+                               training=True).numpy() - res
+    assert set(np.round(np.unique(out), 4)).issubset({0.0, 2.0})
+
+
+def test_fused_ec_moe_matches_loop(rng):
+    B, S, Dm, E, Ff = 2, 3, 4, 3, 5
+    xm = rng.standard_normal((B, S, Dm)).astype("float32")
+    gate = rng.standard_normal((B, S, E)).astype("float32")
+    w0 = rng.standard_normal((E, Dm, Ff)).astype("float32")
+    b0 = rng.standard_normal((E, 1, Ff)).astype("float32")
+    w1 = rng.standard_normal((E, Ff, Dm)).astype("float32")
+    b1 = rng.standard_normal((E, 1, Dm)).astype("float32")
+    got = IF.fused_ec_moe(
+        paddle.to_tensor(xm), paddle.to_tensor(gate),
+        paddle.to_tensor(w0), paddle.to_tensor(b0),
+        paddle.to_tensor(w1), paddle.to_tensor(b1), "relu").numpy()
+    probs = np.exp(gate - gate.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros((B, S, Dm), "float32")
+    for e in range(E):
+        want += probs[..., e:e + 1] * (
+            np.maximum(xm @ w0[e] + b0[e, 0], 0) @ w1[e] + b1[e, 0])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_multihead_attention_decode(rng):
+    B, H, Dh, Smax = 2, 2, 4, 6
+    cache = np.zeros((2, B, H, Smax, Dh), "float32")
+    cache[:, :, :, :3] = rng.standard_normal((2, B, H, 3, Dh))
+    xq = rng.standard_normal((B, 3 * H * Dh)).astype("float32")
+    lens = np.array([3, 2], "int32")
+    out, newc = IF.masked_multihead_attention(
+        paddle.to_tensor(xq), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+    qkv = xq.reshape(B, 3, H, Dh)
+    for b in range(B):
+        L = lens[b]
+        kc = cache[0, b].copy()
+        vc = cache[1, b].copy()
+        kc[:, L] = qkv[b, 1]
+        vc[:, L] = qkv[b, 2]
+        for h in range(H):
+            lg = (kc[h, :L + 1] @ qkv[b, 0, h]) / np.sqrt(Dh)
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            np.testing.assert_allclose(
+                out.numpy()[b, h * Dh:(h + 1) * Dh],
+                p @ vc[h, :L + 1], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(newc.numpy()[0, b], kc, rtol=1e-6)
+
+
+def test_fused_feedforward_pre_ln(rng):
+    E2 = 8
+    xf = rng.standard_normal((2, 3, E2)).astype("float32")
+    w1 = rng.standard_normal((E2, 16)).astype("float32")
+    w2 = rng.standard_normal((16, E2)).astype("float32")
+    got = IF.fused_feedforward(
+        paddle.to_tensor(xf), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        dropout1_rate=0.0, dropout2_rate=0.0, training=False,
+        pre_layer_norm=True).numpy()
+    ln = ((xf - xf.mean(-1, keepdims=True))
+          / np.sqrt(xf.var(-1, keepdims=True) + 1e-5))
+    np.testing.assert_allclose(got, xf + np.maximum(ln @ w1, 0) @ w2,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_multi_head_attention_shapes(rng):
+    E2 = 8
+    xf = rng.standard_normal((2, 3, E2)).astype("float32")
+    qkvw = rng.standard_normal((3, 2, 4, E2)).astype("float32")
+    lw = rng.standard_normal((E2, E2)).astype("float32")
+    got = IF.fused_multi_head_attention(
+        paddle.to_tensor(xf), paddle.to_tensor(qkvw),
+        paddle.to_tensor(lw), pre_layer_norm=True, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False).numpy()
+    assert got.shape == (2, 3, E2)
+    assert np.isfinite(got).all()
+
+
+def test_fused_bias_dropout_residual_layer_norm(rng):
+    xf = rng.standard_normal((2, 3, 8)).astype("float32")
+    res = rng.standard_normal((2, 3, 8)).astype("float32")
+    bb = rng.standard_normal(8).astype("float32")
+    got = IF.fused_bias_dropout_residual_layer_norm(
+        paddle.to_tensor(xf), paddle.to_tensor(res),
+        bias=paddle.to_tensor(bb), dropout_rate=0.0,
+        training=False).numpy()
+    y = res + xf + bb
+    want = ((y - y.mean(-1, keepdims=True))
+            / np.sqrt(y.var(-1, keepdims=True) + 1e-5))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_serving_megakernels_raise_with_pointer():
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_transformer()
+    with pytest.raises(NotImplementedError):
+        IF.fused_gate_attention()
+
+
+def test_fused_sdpa_scaling_factor(rng):
+    q = rng.standard_normal((1, 3, 2, 4)).astype("float32")
+    k = rng.standard_normal((1, 3, 2, 4)).astype("float32")
+    v = rng.standard_normal((1, 3, 2, 4)).astype("float32")
+    got = IF.fused_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        scaling_factor=0.5, is_training=False).numpy()
+    qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    lg = np.einsum("bhsd,bhtd->bhst", qh, kh) * 0.5
+    p = np.exp(lg - lg.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bhtd->bhsd", p, vh).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_ops_loud_errors(rng):
+    q = paddle.ones([1, 3, 2, 4])
+    with pytest.raises(NotImplementedError):  # causal + explicit mask
+        IF.fused_dot_product_attention(q, q, q,
+                                       mask=paddle.ones([1, 1, 3, 3]),
+                                       is_causal_masking=True)
+    with pytest.raises(ValueError):           # unsupported activation
+        IF.fused_linear_activation(paddle.ones([2, 2]),
+                                   paddle.ones([2, 2]),
+                                   activation="geglu")
+    # KV-cache overflow must raise in eager, not silently drop the token
+    cache = paddle.to_tensor(np.zeros((2, 1, 1, 4, 4), "float32"))
+    xq = paddle.to_tensor(
+        rng.standard_normal((1, 12)).astype("float32"))
+    with pytest.raises(ValueError):
+        IF.masked_multihead_attention(
+            xq, cache,
+            sequence_lengths=paddle.to_tensor(np.array([4], "int32")))
+    # ec_moe rejects ambiguous bmm1 layout instead of sniffing
+    with pytest.raises(ValueError):
+        IF.fused_ec_moe(paddle.ones([1, 2, 4]), paddle.ones([1, 2, 2]),
+                        paddle.ones([2, 4, 4]), paddle.ones([2, 1, 4]),
+                        paddle.ones([2, 5, 4]), paddle.ones([2, 1, 4]),
+                        "relu")
