@@ -1,5 +1,6 @@
 from paddle_tpu.incubate.nn import functional  # noqa: F401
 from paddle_tpu.incubate.nn.layer import (  # noqa: F401
-    FusedFeedForward, FusedMultiHeadAttention,
-    FusedTransformerEncoderLayer,
+    FusedBiasDropoutResidualLayerNorm, FusedDropout, FusedDropoutAdd,
+    FusedEcMoe, FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+    FusedMultiTransformer, FusedTransformerEncoderLayer,
 )

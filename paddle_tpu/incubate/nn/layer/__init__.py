@@ -16,7 +16,9 @@ from paddle_tpu import nn
 from paddle_tpu.nn.layer import Layer
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "FusedLinear",
+           "FusedDropoutAdd", "FusedDropout", "FusedEcMoe",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiTransformer"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -127,3 +129,143 @@ class FusedTransformerEncoderLayer(Layer):
             return self.ffn(out), new_cache
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedLinear(Layer):
+    """reference incubate/nn/layer/fused_linear.py — Linear whose bias
+    add is a cuBLASLt epilogue there, an XLA fusion here."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        import jax
+
+        from paddle_tpu.core import generator as gen
+        from paddle_tpu.nn.layer import Parameter
+
+        shape = (out_features, in_features) if transpose_weight \
+            else (in_features, out_features)
+        bound = 1.0 / max(in_features, 1) ** 0.5
+        self.weight = Parameter(jax.random.uniform(
+            gen.active_key(), shape, minval=-bound, maxval=bound))
+        self.bias = None if bias_attr is False else Parameter(
+            jax.random.uniform(gen.active_key(), (out_features,),
+                               minval=-bound, maxval=bound))
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        return IF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """reference incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        return IF.fused_dropout_add(x, y, p=self.p,
+                                    training=self.training,
+                                    mode=self.mode)
+
+
+class FusedDropout(Layer):
+    """reference incubate/nn/layer/fused_dropout_nd.py — dropout with an
+    optional axis (broadcast mask along the other dims)."""
+
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+
+        return F.dropout(x, p=self.p, axis=self.axis,
+                         training=self.training, mode=self.mode)
+
+
+class FusedEcMoe(Layer):
+    """reference incubate/nn/layer/fused_ec_moe.py — dense
+    expert-computation MoE over batched einsum (see functional)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        import jax
+
+        from paddle_tpu.core import generator as gen
+        from paddle_tpu.nn.layer import Parameter
+
+        bound = 1.0 / max(hidden_size, 1) ** 0.5
+        k = gen.active_key
+        self.bmm0_weight = Parameter(jax.random.uniform(
+            k(), (num_experts, hidden_size, inter_size),
+            minval=-bound, maxval=bound))
+        self.bmm0_bias = Parameter(jax.random.uniform(
+            k(), (num_experts, 1, inter_size), minval=-bound,
+            maxval=bound))
+        self.bmm1_weight = Parameter(jax.random.uniform(
+            k(), (num_experts, inter_size, hidden_size),
+            minval=-bound, maxval=bound))
+        self.bmm1_bias = Parameter(jax.random.uniform(
+            k(), (num_experts, 1, hidden_size), minval=-bound,
+            maxval=bound))
+        self.act_type = act_type
+
+    def forward(self, x, gate):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        return IF.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                               self.bmm1_weight, self.bmm1_bias,
+                               self.act_type)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.layer import Parameter
+
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,)))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,)))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,)))
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """reference incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer — a GPU serving mega-kernel stack; the
+    TPU-native serving path is block_multihead_attention /
+    masked_multihead_attention with XLA-fused layers."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        raise NotImplementedError(
+            "FusedMultiTransformer is a GPU serving mega-kernel; "
+            "compose FusedTransformerEncoderLayer (training) or the "
+            "serving attention ops (block/masked multihead attention) "
+            "— XLA fuses the stack")

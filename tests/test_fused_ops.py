@@ -205,3 +205,53 @@ def test_fused_ops_loud_errors(rng):
                         paddle.ones([2, 4, 4]), paddle.ones([2, 1, 4]),
                         paddle.ones([2, 5, 4]), paddle.ones([2, 1, 4]),
                         "relu")
+
+
+def test_fused_layer_classes(rng):
+    """incubate.nn layer classes (reference incubate/nn/__init__.py
+    export set) wrap the functional surface."""
+    from paddle_tpu.incubate import nn as inn
+
+    lin = inn.FusedLinear(8, 4)
+    assert tuple(lin(paddle.ones([2, 8])).shape) == (2, 4)
+    assert len(list(lin.parameters())) == 2
+
+    moe = inn.FusedEcMoe(8, 16, 3, act_type="relu")
+    y = moe(paddle.randn([2, 5, 8]), paddle.randn([2, 5, 3]))
+    assert tuple(y.shape) == (2, 5, 8)
+
+    bdr = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    z = bdr(paddle.randn([2, 3, 8]), paddle.randn([2, 3, 8]))
+    assert tuple(z.shape) == (2, 3, 8)
+
+    da = inn.FusedDropoutAdd(p=0.3)
+    da.eval()
+    np.testing.assert_allclose(
+        da(paddle.ones([2, 2]), paddle.ones([2, 2])).numpy(), 2.0)
+
+    dr = inn.FusedDropout(p=0.5)
+    dr.eval()
+    np.testing.assert_allclose(dr(paddle.ones([3])).numpy(), 1.0)
+
+    with pytest.raises(NotImplementedError):
+        inn.FusedMultiTransformer()
+
+
+def test_fused_linear_layer_trains(rng):
+    from paddle_tpu import optimizer
+    from paddle_tpu.incubate import nn as inn
+
+    paddle.seed(0)
+    lin = inn.FusedLinear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    X = paddle.to_tensor(rng.standard_normal((16, 4)).astype("float32"))
+    Y = paddle.to_tensor(rng.standard_normal((16, 1)).astype("float32"))
+    l0 = None
+    for _ in range(20):
+        loss = ((lin(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if l0 is None:
+            l0 = float(loss.numpy())
+    assert float(loss.numpy()) < l0
