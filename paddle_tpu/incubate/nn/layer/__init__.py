@@ -133,24 +133,21 @@ class FusedTransformerEncoderLayer(Layer):
 
 class FusedLinear(Layer):
     """reference incubate/nn/layer/fused_linear.py — Linear whose bias
-    add is a cuBLASLt epilogue there, an XLA fusion here."""
+    add is a cuBLASLt epilogue there, an XLA fusion here. Init/attr
+    handling mirrors nn.Linear (create_parameter honors
+    weight_attr/bias_attr, bias_attr=False disables the bias)."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, transpose_weight=False, name=None):
         super().__init__()
-        import jax
-
-        from paddle_tpu.core import generator as gen
-        from paddle_tpu.nn.layer import Parameter
-
-        shape = (out_features, in_features) if transpose_weight \
-            else (in_features, out_features)
-        bound = 1.0 / max(in_features, 1) ** 0.5
-        self.weight = Parameter(jax.random.uniform(
-            gen.active_key(), shape, minval=-bound, maxval=bound))
-        self.bias = None if bias_attr is False else Parameter(
-            jax.random.uniform(gen.active_key(), (out_features,),
-                               minval=-bound, maxval=bound))
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_features], attr=bias_attr, is_bias=True)
         self._transpose = transpose_weight
 
     def forward(self, x):
@@ -176,22 +173,10 @@ class FusedDropoutAdd(Layer):
                                     mode=self.mode)
 
 
-class FusedDropout(Layer):
-    """reference incubate/nn/layer/fused_dropout_nd.py — dropout with an
-    optional axis (broadcast mask along the other dims)."""
-
-    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
-                 name=None):
-        super().__init__()
-        self.p = p
-        self.axis = axis
-        self.mode = mode
-
-    def forward(self, x):
-        from paddle_tpu.nn import functional as F
-
-        return F.dropout(x, p=self.p, axis=self.axis,
-                         training=self.training, mode=self.mode)
+class FusedDropout(nn.Dropout):
+    """reference incubate/nn/layer/fused_dropout_nd.py — identical
+    semantics to nn.Dropout (axis-broadcast mask); alias kept for the
+    reference's export set."""
 
 
 class FusedEcMoe(Layer):
@@ -201,25 +186,28 @@ class FusedEcMoe(Layer):
     def __init__(self, hidden_size, inter_size, num_experts,
                  act_type="gelu", weight_attr=None, bias_attr=None):
         super().__init__()
-        import jax
+        import jax.numpy as jnp
 
-        from paddle_tpu.core import generator as gen
-        from paddle_tpu.nn.layer import Parameter
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr)
+        if bias_attr is False:
+            # no bias parameters (reference contract); the functional
+            # needs arrays, so constants of zeros stand in
+            from paddle_tpu.core.tensor import Tensor
 
-        bound = 1.0 / max(hidden_size, 1) ** 0.5
-        k = gen.active_key
-        self.bmm0_weight = Parameter(jax.random.uniform(
-            k(), (num_experts, hidden_size, inter_size),
-            minval=-bound, maxval=bound))
-        self.bmm0_bias = Parameter(jax.random.uniform(
-            k(), (num_experts, 1, inter_size), minval=-bound,
-            maxval=bound))
-        self.bmm1_weight = Parameter(jax.random.uniform(
-            k(), (num_experts, inter_size, hidden_size),
-            minval=-bound, maxval=bound))
-        self.bmm1_bias = Parameter(jax.random.uniform(
-            k(), (num_experts, 1, hidden_size), minval=-bound,
-            maxval=bound))
+            self.bmm0_bias = Tensor._from_data(
+                jnp.zeros((num_experts, 1, inter_size)))
+            self.bmm1_bias = Tensor._from_data(
+                jnp.zeros((num_experts, 1, hidden_size)))
+        else:
+            self.bmm0_bias = self.create_parameter(
+                [num_experts, 1, inter_size], attr=bias_attr,
+                is_bias=True)
+            self.bmm1_bias = self.create_parameter(
+                [num_experts, 1, hidden_size], attr=bias_attr,
+                is_bias=True)
         self.act_type = act_type
 
     def forward(self, x, gate):
@@ -237,13 +225,22 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
     def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
                  bias_attr=None, epsilon=1e-5, name=None):
         super().__init__()
-        import jax.numpy as jnp
+        from paddle_tpu.nn import initializer as init
 
-        from paddle_tpu.nn.layer import Parameter
-
-        self.linear_bias = Parameter(jnp.zeros((embed_dim,)))
-        self.ln_scale = Parameter(jnp.ones((embed_dim,)))
-        self.ln_bias = Parameter(jnp.zeros((embed_dim,)))
+        if weight_attr is False:
+            self.ln_scale = None
+        else:
+            self.ln_scale = self.create_parameter(
+                [embed_dim], attr=weight_attr,
+                default_initializer=init.Constant(1.0))
+        if bias_attr is False:
+            self.linear_bias = None
+            self.ln_bias = None
+        else:
+            self.linear_bias = self.create_parameter(
+                [embed_dim], attr=bias_attr, is_bias=True)
+            self.ln_bias = self.create_parameter(
+                [embed_dim], attr=bias_attr, is_bias=True)
         self.dropout_rate = dropout_rate
         self.epsilon = epsilon
 

@@ -255,3 +255,35 @@ def test_fused_linear_layer_trains(rng):
         if l0 is None:
             l0 = float(loss.numpy())
     assert float(loss.numpy()) < l0
+
+
+def test_fused_layers_honor_param_attrs(rng):
+    """weight_attr/bias_attr contracts: custom initializers are applied
+    and bias_attr=False removes the bias parameters (reference API)."""
+    from paddle_tpu.incubate import nn as inn
+    from paddle_tpu import ParamAttr
+    from paddle_tpu.nn import initializer
+
+    lin = inn.FusedLinear(4, 3, weight_attr=ParamAttr(
+        initializer=initializer.Constant(0.5)), bias_attr=False)
+    assert lin.bias is None
+    assert len(list(lin.parameters())) == 1
+    np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+    np.testing.assert_allclose(
+        lin(paddle.ones([2, 4])).numpy(), 2.0, rtol=1e-6)
+
+    moe = inn.FusedEcMoe(4, 8, 2, act_type="relu", bias_attr=False)
+    assert len(list(moe.parameters())) == 2  # only the two weights
+    y = moe(paddle.randn([1, 3, 4]), paddle.randn([1, 3, 2]))
+    assert tuple(y.shape) == (1, 3, 4)
+
+    bdr = inn.FusedBiasDropoutResidualLayerNorm(4, dropout_rate=0.0,
+                                                bias_attr=False)
+    assert bdr.linear_bias is None and bdr.ln_bias is None
+    out = bdr(paddle.randn([2, 4]), paddle.randn([2, 4]))
+    assert tuple(out.shape) == (2, 4)
+
+    # FusedDropout IS nn.Dropout (one implementation to maintain)
+    from paddle_tpu import nn as base_nn
+
+    assert issubclass(inn.FusedDropout, base_nn.Dropout)
