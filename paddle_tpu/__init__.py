@@ -204,3 +204,19 @@ def set_cuda_rng_state(state_list):
     from paddle_tpu.core.generator import default_generator
 
     default_generator.set_state(state_list[0])
+
+# top-level namespace completion: in-place variants, aliases, dtype
+# predicates, utilities (reference python/paddle/__init__.py __all__)
+from paddle_tpu import compat_extra as _compat_extra  # noqa: E402
+
+globals().update(_compat_extra.EXPORTS)
+
+# accelerator-place compat aliases: code written against the reference's
+# GPU surface keeps working — CUDAPlace maps to this build's accelerator
+from paddle_tpu.core.place import TPUPlace as CUDAPlace  # noqa: E402,F401
+from paddle_tpu.core.place import CPUPlace as CUDAPinnedPlace  # noqa: E402,F401
+from paddle_tpu.distributed.parallel_wrapper import DataParallel  # noqa: E402,F401
+
+# dtype name parity: paddle.bool is the boolean dtype (shadows the
+# builtin only as a module attribute, same as the reference)
+bool = bool8  # noqa: A001

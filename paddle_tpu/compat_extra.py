@@ -1,0 +1,352 @@
+"""Top-level namespace completion (reference: python/paddle/__init__.py
+__all__): module-level in-place variants, aliases, dtype predicates,
+random in-place fills, and small utilities. Imported last by
+paddle_tpu/__init__, which star-merges EXPORTS into the package
+namespace.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _API, rebind_inplace
+
+EXPORTS = {}
+
+
+def _export(fn, name=None):
+    EXPORTS[name or fn.__name__] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# module-level in-place variants: paddle.<op>_(x, ...) rebinds x to the
+# out-of-place result (the registry's in-place semantics — under XLA
+# "in-place" is buffer rebinding; compiled steps get true in-place via
+# donation). The reference exports these for ~70 ops.
+# ---------------------------------------------------------------------------
+_INPLACE_BASES = [
+    "abs", "acos", "asin", "atan", "atanh", "asinh", "acosh", "cast",
+    "ceil", "clip", "cos", "cosh", "cumprod", "cumsum", "digamma",
+    "divide", "equal", "erf", "erfinv", "exp", "expm1", "flatten",
+    "floor", "floor_divide", "frac", "gcd", "greater_equal",
+    "greater_than", "hypot", "i0", "index_add", "index_fill",
+    "index_put", "lcm", "less_equal", "less_than", "lgamma", "log",
+    "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "masked_fill",
+    "masked_scatter", "multiply", "multigammaln", "nan_to_num", "neg",
+    "not_equal", "polygamma", "pow", "put_along_axis", "reciprocal",
+    "remainder", "renorm", "reshape", "round", "rsqrt", "scale",
+    "scatter", "scatter_nd_add", "sign", "sin", "sinh", "sqrt",
+    "square", "squeeze", "subtract", "tan", "tanh", "tril", "triu",
+    "trunc", "unsqueeze", "add", "copysign", "gammainc",
+    "gammaincc", "gammaln", "ldexp", "bitwise_and", "bitwise_not",
+    "bitwise_or", "bitwise_xor", "lerp", "kron", "maximum", "minimum",
+    "transpose", "addmm", "rad2deg", "deg2rad",
+]
+
+
+def _make_inplace(base):
+    api = _API[base]
+
+    def fn(x, *args, **kwargs):
+        return rebind_inplace(x, api(x, *args, **kwargs))
+
+    fn.__name__ = base + "_"
+    fn.__doc__ = f"In-place variant of paddle.{base} (buffer rebinding)."
+    return fn
+
+
+for _b in _INPLACE_BASES:
+    if _b in _API:
+        f = _make_inplace(_b)
+        EXPORTS[_b + "_"] = f
+        if not hasattr(Tensor, _b + "_"):
+            setattr(Tensor, _b + "_", f)
+
+# paddle spells some in-place names differently from the base op
+for _alias, _base in (("t_", "t"), ("mod_", "remainder"),
+                      ("floor_mod_", "remainder"),
+                      ("divide_", "divide")):
+    if _base in _API:
+        f = _make_inplace(_base)
+        f.__name__ = _alias
+        EXPORTS[_alias] = f
+        if not hasattr(Tensor, _alias):
+            setattr(Tensor, _alias, f)
+
+
+# ---------------------------------------------------------------------------
+# aliases
+# ---------------------------------------------------------------------------
+for _alias, _base in (("mm", "matmul"), ("mod", "remainder"),
+                      ("floor_mod", "remainder"), ("view", "reshape")):
+    if _base in _API:
+        EXPORTS[_alias] = _API[_base]
+
+
+@_export
+def where_(condition, x, y, name=None):
+    """In-place where: rebinds X (the reference's in-place target), not
+    the condition mask."""
+    return rebind_inplace(x, _API["where"](condition, x, y))
+
+
+@_export
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """N-D histogram (reference histogramdd): returns (hist,
+    list-of-edge-tensors) — the reference's pair contract."""
+    xd = _dd(x)
+    wd = None if weights is None else _dd(weights)
+    h, edges = jnp.histogramdd(xd, bins=bins, range=ranges,
+                               density=density, weights=wd)
+    return Tensor._from_data(h), [Tensor._from_data(e) for e in edges]
+
+
+@_export
+def view_as(x, other):
+    return _API["reshape"](x, list(other.shape))
+
+
+@_export
+def clone(x):
+    return x.clone()
+
+
+@_export
+def rank(x):
+    """0-D int32 tensor holding x's ndim (reference paddle.rank)."""
+    return Tensor._from_data(jnp.asarray(x._data.ndim, jnp.int32))
+
+
+@_export
+def shape(x):
+    """int32 tensor of x's dims (reference paddle.shape op)."""
+    return Tensor._from_data(jnp.asarray(x._data.shape, jnp.int32))
+
+
+@_export
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@_export
+def increment(x, value=1.0):
+    """x += value, rebinding the buffer (reference increment op)."""
+    return rebind_inplace(x, x + value)
+
+
+@_export
+def reduce_as(x, target):
+    """Sum x down to target's shape (reference reduce_as)."""
+    xd = x._data
+    td = target._data if isinstance(target, Tensor) else jnp.asarray(target)
+    lead = xd.ndim - td.ndim
+    axes = list(range(lead))
+    for i, (a, b) in enumerate(zip(xd.shape[lead:], td.shape)):
+        if b == 1 and a != 1:
+            axes.append(lead + i)
+    out = xd.sum(axis=tuple(axes), keepdims=False) if axes else xd
+    return Tensor._from_data(out.reshape(td.shape))
+
+
+# ---------------------------------------------------------------------------
+# dtype predicates (host bools, reference tensor/attribute.py)
+# ---------------------------------------------------------------------------
+@_export
+def is_complex(x):
+    return jnp.issubdtype(x._data.dtype, jnp.complexfloating)
+
+
+@_export
+def is_floating_point(x):
+    return jnp.issubdtype(x._data.dtype, jnp.floating)
+
+
+@_export
+def is_integer(x):
+    return jnp.issubdtype(x._data.dtype, jnp.integer)
+
+
+for _p in ("is_complex", "is_floating_point", "is_integer"):
+    if not hasattr(Tensor, _p):
+        setattr(Tensor, _p, EXPORTS[_p])
+
+
+# ---------------------------------------------------------------------------
+# random in-place fills (reference tensor/random.py)
+# ---------------------------------------------------------------------------
+def _fill(x, sample):
+    x._data = sample.astype(x._data.dtype)
+    return x
+
+
+@_export
+def normal_(x, mean=0.0, std=1.0):
+    from paddle_tpu.core import generator as gen
+
+    return _fill(x, mean + std * jax.random.normal(
+        gen.active_key(), x._data.shape))
+
+
+@_export
+def cauchy_(x, loc=0, scale=1):
+    from paddle_tpu.core import generator as gen
+
+    return _fill(x, loc + scale * jax.random.cauchy(
+        gen.active_key(), x._data.shape))
+
+
+@_export
+def geometric_(x, probs):
+    from paddle_tpu.core import generator as gen
+
+    u = jax.random.uniform(gen.active_key(), x._data.shape,
+                           minval=1e-12, maxval=1.0)
+    return _fill(x, jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.asarray(probs))))
+
+
+for _r in ("normal_", "cauchy_", "geometric_"):
+    if not hasattr(Tensor, _r):
+        setattr(Tensor, _r, EXPORTS[_r])
+
+
+@_export
+def randint_like(x, low=0, high=None, dtype=None):
+    from paddle_tpu.core import generator as gen
+    from paddle_tpu.core.dtype import to_jax
+
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(gen.active_key(), x._data.shape,
+                             int(low), int(high))
+    return Tensor._from_data(out.astype(
+        to_jax(dtype) if dtype else x._data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# small utilities
+# ---------------------------------------------------------------------------
+@_export
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader batcher (reference paddle.batch)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+@_export
+def check_shape(x, expected_shape):
+    """Assert a tensor's shape (reference static check utility)."""
+    got = tuple(x.shape)
+    exp = tuple(expected_shape)
+    if len(got) != len(exp) or any(
+            e not in (-1, None) and g != e for g, e in zip(got, exp)):
+        raise ValueError(f"shape mismatch: got {got}, expected {exp}")
+    return True
+
+
+@_export
+def disable_signal_handler():
+    """No-op (the reference disables its C++ signal handlers; there are
+    none here — faulthandler is only armed by the watchdog)."""
+
+
+@_export
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Forwarded to numpy's printoptions (Tensor repr renders via
+    numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """No-op context manager (reference LazyGuard defers parameter
+    initialization; XLA arrays are cheap to allocate, so eager init is
+    the TPU-native behavior)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+EXPORTS["LazyGuard"] = LazyGuard
+
+
+# ---------------------------------------------------------------------------
+# bit shifts (reference tensor/math.py bitwise_left_shift/right_shift)
+# ---------------------------------------------------------------------------
+def _dd(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+@_export
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return Tensor._from_data(jnp.left_shift(_dd(x), _dd(y)))
+
+
+@_export
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    """Arithmetic (sign-propagating) shift by default; logical shift
+    reinterprets as unsigned (reference contract)."""
+    xd, yd = _dd(x), _dd(y)
+    if is_arithmetic:
+        return Tensor._from_data(jnp.right_shift(xd, yd))
+    ux = xd.view(jnp.dtype(f"uint{xd.dtype.itemsize * 8}"))
+    return Tensor._from_data(
+        jnp.right_shift(ux, yd.astype(ux.dtype)).view(xd.dtype))
+
+
+for _nm in ("bitwise_left_shift", "bitwise_right_shift"):
+    _f = EXPORTS[_nm]
+
+    def _mk(fname, base):
+        def fn(x, *a, **k):
+            return rebind_inplace(x, base(x, *a, **k))
+
+        fn.__name__ = fname
+        return fn
+
+    EXPORTS[_nm + "_"] = _mk(_nm + "_", _f)
+    if not hasattr(Tensor, _nm):
+        setattr(Tensor, _nm, _f)
+        setattr(Tensor, _nm + "_", EXPORTS[_nm + "_"])
+
+
+@_export
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference paddle.create_parameter)."""
+    from paddle_tpu.core.dtype import convert_dtype, get_default_dtype
+    from paddle_tpu.nn import initializer as init
+    from paddle_tpu.nn.layer import Parameter
+
+    dt = convert_dtype(dtype) if dtype else get_default_dtype()
+    ini = default_initializer or getattr(attr, "initializer", None) or (
+        init.Constant(0.0) if is_bias else init.XavierUniform())
+    return Parameter(ini([int(s) for s in shape], dt))

@@ -1,0 +1,217 @@
+"""Long-tail op surface (ops/extras.py): stack/split family, special
+math, indexed scatter, predicates — numpy/scipy-referenced numerics
+plus gradient-flow checks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, dt="float32"):
+    return paddle.to_tensor(np.asarray(a, dt))
+
+
+def test_stack_split_family():
+    np.testing.assert_allclose(
+        paddle.hstack([t([1, 2]), t([3])]).numpy(), [1, 2, 3])
+    np.testing.assert_allclose(
+        paddle.vstack([t([[1, 2]]), t([[3, 4]])]).numpy(),
+        [[1, 2], [3, 4]])
+    np.testing.assert_allclose(
+        paddle.column_stack([t([1, 2]), t([3, 4])]).numpy(),
+        [[1, 3], [2, 4]])
+    parts = paddle.tensor_split(t(np.arange(7)), 3)
+    assert [tuple(p.shape) for p in parts] == [(3,), (2,), (2,)]
+    hs = paddle.hsplit(t(np.arange(12).reshape(3, 4)), 2)
+    assert [tuple(p.shape) for p in hs] == [(3, 2), (3, 2)]
+    us = paddle.unstack(t(np.arange(6).reshape(2, 3)))
+    assert len(us) == 2 and tuple(us[0].shape) == (3,)
+    uf = paddle.unflatten(t(np.arange(6)), 0, [2, 3])
+    assert tuple(uf.shape) == (2, 3)
+
+
+def test_math_long_tail():
+    np.testing.assert_allclose(
+        paddle.addmm(t(np.ones((2, 2))), t(np.eye(2)), t(2 * np.eye(2)),
+                     beta=0.5, alpha=2.0).numpy(),
+        0.5 + 4.0 * np.eye(2))
+    np.testing.assert_allclose(
+        paddle.copysign(t([1.0, -2.0]), t([-1.0, 1.0])).numpy(),
+        [-1.0, 2.0])
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(t([0.0, 0.0])).numpy(),
+        [0.0, np.log(2)], rtol=1e-6)
+    np.testing.assert_allclose(paddle.sgn(t([-3.0, 0.0, 5.0])).numpy(),
+                               [-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(paddle.gammaln(t([4.0])).numpy(),
+                               [np.log(6.0)], rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.stanh(t([0.0])).numpy(), [0.0], atol=1e-7)
+    m, e = paddle.frexp(t([8.0]))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0])
+    np.testing.assert_allclose(
+        paddle.trapezoid(t([1.0, 1.0, 1.0])).numpy(), 2.0)
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(t([0.0, 1.0, 2.0])).numpy(),
+        [0.5, 2.0])
+    np.testing.assert_allclose(paddle.rad2deg(t([np.pi])).numpy(),
+                               [180.0], rtol=1e-6)
+    np.testing.assert_allclose(paddle.i0(t([0.0])).numpy(), [1.0],
+                               rtol=1e-6)
+
+
+def test_distance_ops():
+    import scipy.spatial.distance as ssd
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 3)).astype("float32")
+    y = rng.standard_normal((5, 3)).astype("float32")
+    np.testing.assert_allclose(paddle.cdist(t(x), t(y)).numpy(),
+                               ssd.cdist(x, y), rtol=1e-4)
+    np.testing.assert_allclose(paddle.pdist(t(x)).numpy(),
+                               ssd.pdist(x), rtol=1e-4)
+    # p=1 and p=inf variants
+    np.testing.assert_allclose(
+        paddle.cdist(t(x), t(y), p=1.0).numpy(),
+        ssd.cdist(x, y, "minkowski", p=1), rtol=1e-4)
+    # gradient flows
+    xt = t(x)
+    xt.stop_gradient = False
+    paddle.cdist(xt, t(y)).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_kthvalue_mode():
+    v, i = paddle.kthvalue(t([3.0, 1.0, 2.0]), 2)
+    assert float(v.numpy()) == 2.0 and int(i.numpy()) == 2
+    mv, mi = paddle.mode(t([1.0, 2.0, 2.0, 3.0]))
+    assert float(mv.numpy()) == 2.0 and int(mi.numpy()) == 2
+
+
+def test_scatter_family():
+    np.testing.assert_allclose(
+        paddle.diag_embed(t([1.0, 2.0])).numpy(), np.diag([1.0, 2.0]))
+    d = paddle.diagonal_scatter(t(np.zeros((3, 3))), t([5.0, 6.0, 7.0]))
+    np.testing.assert_allclose(np.diag(d.numpy()), [5.0, 6.0, 7.0])
+    s = paddle.select_scatter(t(np.zeros((2, 3))), t([1.0, 2.0, 3.0]),
+                              axis=0, index=1)
+    np.testing.assert_allclose(s.numpy()[1], [1.0, 2.0, 3.0])
+    sl = paddle.slice_scatter(t(np.zeros(5)), t([9.0, 9.0]),
+                              axes=[0], starts=[1], ends=[3],
+                              strides=[1])
+    np.testing.assert_allclose(sl.numpy(), [0, 9, 9, 0, 0])
+    fi = paddle.index_fill(t(np.zeros(4)),
+                           paddle.to_tensor(np.asarray([1, 3])), 0, 7.0)
+    np.testing.assert_allclose(fi.numpy(), [0, 7, 0, 7])
+    sn = paddle.scatter_nd(paddle.to_tensor(np.asarray([[1], [3]])),
+                           t([10.0, 20.0]), [5])
+    np.testing.assert_allclose(sn.numpy(), [0, 10, 0, 20, 0])
+
+
+def test_take_slice_reverse_crop():
+    np.testing.assert_allclose(
+        paddle.take(t([[1.0, 2.0], [3.0, 4.0]]),
+                    paddle.to_tensor(np.asarray([0, 3]))).numpy(),
+        [1.0, 4.0])
+    np.testing.assert_allclose(
+        paddle.slice(t(np.arange(10)), [0], [2], [5]).numpy(),
+        [2, 3, 4])
+    np.testing.assert_allclose(
+        paddle.strided_slice(t(np.arange(10)), [0], [0], [8],
+                             [2]).numpy(), [0, 2, 4, 6])
+    np.testing.assert_allclose(
+        paddle.reverse(t([1.0, 2.0, 3.0]), 0).numpy(), [3.0, 2.0, 1.0])
+    np.testing.assert_allclose(
+        paddle.crop(t(np.arange(9).reshape(3, 3)), shape=[2, 2],
+                    offsets=[1, 0]).numpy(), [[3, 4], [6, 7]])
+
+
+def test_complex_views():
+    c = paddle.as_complex(t([[1.0, 2.0]]))
+    assert paddle.is_complex(c)
+    np.testing.assert_allclose(paddle.as_real(c).numpy(), [[1.0, 2.0]])
+
+
+def test_predicates_and_misc():
+    assert bool(paddle.isposinf(t([np.inf])).numpy()[0])
+    assert bool(paddle.isneginf(t([-np.inf])).numpy()[0])
+    assert not bool(paddle.is_empty(t([1.0])).numpy())
+    un = paddle.unique_consecutive(
+        paddle.to_tensor(np.asarray([1, 1, 2, 2, 3, 1])))
+    np.testing.assert_allclose(un.numpy(), [1, 2, 3, 1])
+    out, inv, cnt = paddle.unique_consecutive(
+        paddle.to_tensor(np.asarray([1, 1, 2])), return_inverse=True,
+        return_counts=True)
+    np.testing.assert_allclose(cnt.numpy(), [2, 1])
+    mp = paddle.multiplex([t([[1.0], [2.0]]), t([[10.0], [20.0]])],
+                          paddle.to_tensor(np.asarray([[0], [1]])))
+    np.testing.assert_allclose(mp.numpy(), [[1.0], [20.0]])
+    comb = paddle.combinations(t([1.0, 2.0, 3.0]), 2)
+    assert tuple(comb.shape) == (3, 2)
+    np.testing.assert_allclose(
+        paddle.renorm(t(np.asarray([[3.0, 4.0], [0.3, 0.4]]).T), p=2.0,
+                      axis=1, max_norm=1.0).numpy().T[0],
+        [0.6, 0.8], rtol=1e-5)
+
+
+def test_random_long_tail():
+    paddle.seed(0)
+    b = paddle.binomial(t(np.full(200, 10.0)), t(np.full(200, 0.5)))
+    assert 3.0 < float(b.numpy().mean()) < 7.0
+    g = paddle.standard_gamma(t(np.full(200, 2.0)))
+    assert 1.0 < float(g.numpy().mean()) < 3.0
+    r = paddle.randint_like(t(np.zeros(50)), 0, 5)
+    assert set(np.unique(r.numpy().astype(int))) <= {0, 1, 2, 3, 4}
+
+
+def test_bit_shifts():
+    x = paddle.to_tensor(np.asarray([8, -8], "int32"))
+    np.testing.assert_allclose(
+        paddle.bitwise_left_shift(
+            x, paddle.to_tensor(np.asarray([1, 1], "int32"))).numpy(),
+        [16, -16])
+    np.testing.assert_allclose(
+        paddle.bitwise_right_shift(
+            x, paddle.to_tensor(np.asarray([2, 2], "int32"))).numpy(),
+        [2, -2])
+
+
+def test_where_inplace_targets_x_not_condition():
+    cond = paddle.to_tensor(np.array([True, False]))
+    x = t([1.0, 2.0])
+    y = t([10.0, 20.0])
+    r = paddle.where_(cond, x, y)
+    assert r is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 20.0])
+    assert cond.numpy().dtype == np.bool_  # mask untouched
+
+
+def test_take_raise_mode_raises():
+    with pytest.raises(IndexError):
+        paddle.take(t(np.arange(5.0)),
+                    paddle.to_tensor(np.asarray([10])))
+
+
+def test_histogramdd_pair_contract():
+    h, edges = paddle.histogramdd(
+        t(np.random.default_rng(0).random((10, 2))), bins=4)
+    assert tuple(h.shape) == (4, 4)
+    assert isinstance(edges, list) and len(edges) == 2
+
+
+def test_diag_embed_nondefault_dims():
+    d = paddle.diag_embed(t(np.ones((2, 3))), dim1=0, dim2=1)
+    assert tuple(d.shape) == (3, 3, 2)
+    np.testing.assert_allclose(d.numpy()[0, 0], np.ones(2))
+
+
+def test_cdist_matmul_path_matches_diff_path():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((6, 4)).astype("float32")
+    b = rng.standard_normal((7, 4)).astype("float32")
+    fast = paddle.cdist(t(a), t(b)).numpy()
+    slow = paddle.cdist(t(a), t(b),
+                        compute_mode="donot_use_mm_for_euclid_dist"
+                        ).numpy()
+    np.testing.assert_allclose(fast, slow, rtol=1e-3, atol=1e-4)
