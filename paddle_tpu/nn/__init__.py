@@ -42,3 +42,14 @@ from paddle_tpu.nn.clip import (  # noqa: F401
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401
 from paddle_tpu.nn import utils  # noqa: F401
+from paddle_tpu.nn.layers_extra import (  # noqa: F401,E402
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool3D, AvgPool3D, BeamSearchDecoder, BiRNN,
+    ChannelShuffle, Conv1DTranspose, Conv3DTranspose, Fold,
+    FractionalMaxPool2D, FractionalMaxPool3D, GaussianNLLLoss,
+    HingeEmbeddingLoss, HSigmoidLoss, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    PixelUnshuffle, PoissonNLLLoss, RNNCellBase, RReLU, SoftMarginLoss,
+    Softmax2D, TripletMarginLoss, TripletMarginWithDistanceLoss,
+    Unflatten, ZeroPad2D, dynamic_decode,
+)

@@ -1,0 +1,535 @@
+"""nn long-tail layer classes completing the reference export set
+(python/paddle/nn/__init__.py __all__): pooling/unpooling, shuffles,
+pads, conv transposes, the remaining losses, BiRNN, and seq2seq
+decoding (BeamSearchDecoder + dynamic_decode).
+
+Each layer wraps the matching registry functional (ops/nn_extras.py);
+reference layer homes: python/paddle/nn/layer/{pooling,loss,common,
+conv,rnn}.py.
+"""
+from __future__ import annotations
+
+from paddle_tpu import ops as _ops
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import API as _API
+
+__all__ = [
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "AvgPool3D", "MaxPool3D", "MaxUnPool1D",
+    "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "ChannelShuffle", "PixelUnshuffle",
+    "ZeroPad2D", "Unflatten", "Fold", "Softmax2D", "RReLU",
+    "Conv1DTranspose", "Conv3DTranspose", "GaussianNLLLoss",
+    "HingeEmbeddingLoss", "HSigmoidLoss", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "PoissonNLLLoss", "SoftMarginLoss",
+    "TripletMarginLoss", "TripletMarginWithDistanceLoss", "BiRNN",
+    "RNNCellBase", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+class _Pool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCDHW", **kw):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil, self._df = ceil_mode, data_format
+        self._kw = kw
+
+    def forward(self, x):
+        return _API[self._fn](x, self._k, stride=self._s,
+                              padding=self._p, ceil_mode=self._ceil,
+                              data_format=self._df, **self._kw)
+
+
+class MaxPool3D(_Pool):
+    _fn = "max_pool3d"
+
+
+class AvgPool3D(_Pool):
+    _fn = "avg_pool3d"
+
+
+class _AdaptivePool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, **kw):
+        super().__init__()
+        self._o = output_size
+
+    def forward(self, x):
+        return _API[self._fn](x, self._o)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _fn = "adaptive_avg_pool1d"
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    _fn = "adaptive_max_pool1d"
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _fn = "adaptive_avg_pool3d"
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _fn = "adaptive_max_pool3d"
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._o, self._u = output_size, random_u
+
+    def forward(self, x):
+        return _API["fractional_max_pool2d"](x, self._o,
+                                             random_u=self._u)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._o, self._u = output_size, random_u
+
+    def forward(self, x):
+        return _API["fractional_max_pool3d"](x, self._o,
+                                             random_u=self._u)
+
+
+class _Unpool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._os = output_size
+
+    def forward(self, x, indices):
+        return _API[self._fn](x, indices, self._k, stride=self._s,
+                              padding=self._p, output_size=self._os)
+
+
+class MaxUnPool1D(_Unpool):
+    _fn = "max_unpool1d"
+
+
+class MaxUnPool2D(_Unpool):
+    _fn = "max_unpool2d"
+
+
+class MaxUnPool3D(_Unpool):
+    _fn = "max_unpool3d"
+
+
+# ---------------------------------------------------------------------------
+# shuffles / pads / shapes / activations
+# ---------------------------------------------------------------------------
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g = groups
+
+    def forward(self, x):
+        return _API["channel_shuffle"](x, self._g)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = downscale_factor
+
+    def forward(self, x):
+        return _API["pixel_unshuffle"](x, self._r)
+
+
+class ZeroPad2D(Layer):
+    """Reference layer/common.py ZeroPad2D: padding [l, r, t, b]."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self._p = [int(v) for v in p]
+
+    def forward(self, x):
+        l, r, t, b = self._p
+        import jax.numpy as jnp
+
+        return Tensor._from_data(jnp.pad(
+            x._data, ((0, 0), (0, 0), (t, b), (l, r))))
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        return _API["unflatten"](x, self._axis, self._shape)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1,
+                 paddings=0, dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        return _API["fold"](x, *self._args)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference
+    layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        return _API["softmax"](x, axis=-3)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lo, self._hi = lower, upper
+
+    def forward(self, x):
+        return _API["rrelu"](x, self._lo, self._hi,
+                             training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# conv transposes
+# ---------------------------------------------------------------------------
+class _ConvTranspose(Layer):
+    _fn = None
+    _nd = 1
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        import math
+
+        from paddle_tpu.nn import initializer as init
+
+        nd = self._nd
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * nd
+        k = tuple(int(v) for v in k)
+        fan = in_channels * math.prod(k)
+        bound = 1.0 / max(fan, 1) ** 0.5
+        u = init.Uniform(-bound, bound)
+        # paddle transpose-conv weight layout: [C_in, C_out/groups, *K]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *k], attr=weight_attr,
+            default_initializer=u)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True)
+        self._cfg = (stride, padding, output_padding, dilation, groups)
+
+    def forward(self, x):
+        s, p, op_, d, g = self._cfg
+        return _API[self._fn](x, self.weight, self.bias, stride=s,
+                              padding=p, output_padding=op_,
+                              dilation=d, groups=g)
+
+
+class Conv1DTranspose(_ConvTranspose):
+    _fn = "conv1d_transpose"
+    _nd = 1
+
+
+class Conv3DTranspose(_ConvTranspose):
+    _fn = "conv3d_transpose"
+    _nd = 3
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+class _Loss(Layer):
+    _fn = None
+
+    def __init__(self, reduction="mean", **kw):
+        super().__init__()
+        self.reduction = reduction
+        self._kw = kw
+
+    def forward(self, *args):
+        return _API[self._fn](*args, reduction=self.reduction,
+                              **self._kw)
+
+
+class GaussianNLLLoss(_Loss):
+    _fn = "gaussian_nll_loss"
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(reduction=reduction, full=full,
+                         epsilon=epsilon)
+
+
+class HingeEmbeddingLoss(_Loss):
+    _fn = "hinge_embedding_loss"
+
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__(reduction=reduction, margin=margin)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return _API["multi_label_soft_margin_loss"](
+            input, label, self._w, reduction=self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._p, self._m, self._w = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return _API["multi_margin_loss"](input, label, weight=self._w,
+                                         p=self._p, margin=self._m,
+                                         reduction=self.reduction)
+
+
+class PoissonNLLLoss(_Loss):
+    _fn = "poisson_nll_loss"
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(reduction=reduction, log_input=log_input,
+                         full=full, epsilon=epsilon)
+
+
+class SoftMarginLoss(_Loss):
+    _fn = "soft_margin_loss"
+
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(reduction=reduction)
+
+
+class TripletMarginLoss(_Loss):
+    _fn = "triplet_margin_loss"
+
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(reduction=reduction, margin=margin, p=p,
+                         epsilon=epsilon, swap=swap)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Reference layer/loss.py — triplet loss with a user distance fn."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._dist = distance_function
+        self._margin, self._swap = margin, swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        if self._dist is None:
+            return _API["triplet_margin_loss"](
+                input, positive, negative, margin=self._margin,
+                swap=self._swap, reduction=self.reduction)
+        dp = self._dist(input, positive)
+        dn = self._dist(input, negative)
+        if self._swap:
+            dpn = self._dist(positive, negative)
+            dn = _ops.minimum(dn, dpn)
+        loss = _ops.clip(dp - dn + self._margin, min=0.0)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            [max(n_nodes, 1), feature_size], attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([max(n_nodes, 1), 1],
+                                              attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return _API["hsigmoid_loss"](input, label, self._num_classes,
+                                     self.weight, self.bias,
+                                     path_table, path_code)
+
+
+# ---------------------------------------------------------------------------
+# RNN: base cell, bidirectional wrapper, seq2seq decoding
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    """Base for user-defined cells (reference layer/rnn.py RNNCellBase):
+    subclasses implement forward(inputs, states) -> (outputs, states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        h = shape[-1] if shape is not None else self.hidden_size
+        return _ops.full([b, h], init_value)
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (reference layer/rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from paddle_tpu.nn.rnn import RNN
+
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None):
+        sf, sb = (initial_states if initial_states is not None
+                  else (None, None))
+        of, fw_state = self._fw(inputs, sf)
+        ob, bw_state = self._bw(inputs, sb)
+        return _ops.concat([of, ob], axis=-1), (fw_state, bw_state)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search step decoder over a cell (reference layer/rnn.py
+    BeamSearchDecoder; the step contract of dynamic_decode).
+
+    MVP of the reference surface: embedding_fn maps token ids to cell
+    inputs; output_fn maps cell outputs to vocab logits. States are kept
+    per beam as [batch*beam, ...]."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, batch_size, initial_state=None):
+        import jax.numpy as jnp
+
+        k = self.beam_size
+        tokens = _ops.full([batch_size * k], self.start_token,
+                           dtype="int32")
+        # beam 0 live, others -inf so step 1 expands one beam per batch
+        lp = jnp.tile(jnp.asarray([0.0] + [-1e9] * (k - 1)),
+                      (batch_size,))
+        log_probs = Tensor._from_data(lp.astype(jnp.float32))
+        finished = Tensor._from_data(
+            jnp.zeros((batch_size * k,), bool))
+        return tokens, initial_state, log_probs, finished
+
+    def step(self, tokens, state, log_probs, finished):
+        import jax.numpy as jnp
+
+        k = self.beam_size
+        inp = self.embedding_fn(tokens) if self.embedding_fn else tokens
+        out, new_state = self.cell(inp, state)
+        logits = self.output_fn(out) if self.output_fn else out
+        v = logits.shape[-1]
+        step_lp = Tensor._from_data(
+            jax.nn.log_softmax(logits._data.astype(jnp.float32), -1))
+        # finished beams only extend with end_token at 0 cost
+        mask = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        slp = jnp.where(finished._data[:, None], mask[None, :],
+                        step_lp._data)
+        total = log_probs._data[:, None] + slp        # [b*k, v]
+        b = total.shape[0] // k
+        flat = total.reshape(b, k * v)
+        top_lp, top_idx = jax.lax.top_k(flat, k)      # [b, k]
+        beam_src = top_idx // v                        # [b, k]
+        new_tok = (top_idx % v).astype(jnp.int32)
+        # reindex states/finished by the chosen source beams
+        gather = (jnp.arange(b)[:, None] * k + beam_src).reshape(-1)
+
+        def regather(t):
+            if t is None:
+                return None
+            if isinstance(t, (tuple, list)):
+                return type(t)(regather(s) for s in t)
+            d = t._data if isinstance(t, Tensor) else t
+            return Tensor._from_data(d[gather])
+
+        new_state = regather(new_state)
+        new_fin = Tensor._from_data(
+            finished._data[gather]
+            | (new_tok.reshape(-1) == self.end_token))
+        # parents: which beam slot each new beam descends from — the
+        # caller needs this to backtrack valid sequences (gather_tree)
+        return (Tensor._from_data(new_tok.reshape(-1)), new_state,
+                Tensor._from_data(top_lp.reshape(-1)), new_fin,
+                Tensor._from_data(beam_src))
+
+
+import jax  # noqa: E402  (BeamSearchDecoder.step uses jax.lax.top_k)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32,
+                   batch_size=None, **kwargs):
+    """Run a decoder until every beam finishes or max_step_num
+    (reference layer/rnn.py dynamic_decode). Sequences are recovered by
+    BACKTRACKING the per-step parent beams (the reference's gather_tree
+    step) — slot-position histories alone are invalid whenever beams
+    reorder. Returns (token ids [batch, beam, steps], final log probs
+    [batch, beam])."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if batch_size is None:
+        batch_size = 1
+    tokens, state, log_probs, finished = decoder.initialize(
+        batch_size, inits)
+    k = decoder.beam_size
+    toks, parents = [], []
+    for _ in range(int(max_step_num)):
+        tokens, state, log_probs, finished, src = decoder.step(
+            tokens, state, log_probs, finished)
+        toks.append(np.asarray(tokens._data).reshape(batch_size, k))
+        parents.append(np.asarray(src._data).reshape(batch_size, k))
+        if bool(np.asarray(finished._data).all()):
+            break
+    steps = len(toks)
+    ids = np.zeros((batch_size, k, steps), np.int32)
+    # gather_tree: walk each final beam back through its ancestry
+    cur = np.tile(np.arange(k), (batch_size, 1))     # [b, k] slot ptr
+    rows = np.arange(batch_size)[:, None]
+    for ti in range(steps - 1, -1, -1):
+        ids[:, :, ti] = toks[ti][rows, cur]
+        cur = parents[ti][rows, cur]
+    return (Tensor._from_data(jnp.asarray(ids)),
+            Tensor._from_data(log_probs._data.reshape(batch_size, k)))

@@ -8,7 +8,7 @@ import os
 # emitter modules must be imported before building the registry
 from paddle_tpu.ops import (  # noqa: F401
     creation, extras, graph_ops, linalg, logic, manipulation, math,
-    nn_ops, random_ops, spectral, vision_ops,
+    nn_extras, nn_ops, random_ops, spectral, vision_ops,
 )
 from paddle_tpu.ops import registry as _registry
 from paddle_tpu.ops.registry import OPS, get_op

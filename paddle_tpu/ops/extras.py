@@ -9,8 +9,6 @@ already has the semantics.
 """
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -413,10 +411,10 @@ def take(x, index, mode="raise"):
 @op
 def kthvalue(x, k, axis=-1, keepdim=False):
     axis = axis % x.ndim
-    vals = jnp.sort(x, axis=axis)
     args = jnp.argsort(x, axis=axis)
-    v = jnp.take(vals, k - 1, axis=axis)
     i = jnp.take(args, k - 1, axis=axis).astype(jnp.int32)
+    v = jnp.take_along_axis(
+        x, jnp.expand_dims(i, axis), axis=axis).squeeze(axis)
     if keepdim:
         v = jnp.expand_dims(v, axis)
         i = jnp.expand_dims(i, axis)

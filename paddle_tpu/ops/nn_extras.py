@@ -1,0 +1,469 @@
+"""nn long-tail emitters: 1-D/3-D pooling, unpooling, fractional
+pooling, channel/pixel shuffles, fold (col2im), rrelu, conv transposes,
+and the remaining loss functionals.
+
+Reference kernels: paddle/phi/kernels/{pool_kernel,unpool_kernel,
+fold_kernel,pixel_unshuffle_kernel,channel_shuffle_kernel,rrelu_kernel}
+and python/paddle/nn/functional/{pooling,loss,common}.py. Each lowers
+to reduce_window / reshape-transpose / scatter compositions that XLA
+tiles natively; autograd via the registry's jax.vjp.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.nn_ops import _pair as _tup, _reduce
+from paddle_tpu.ops.registry import register_emitter as op
+
+
+def _pool_nd(x, k, s, pad, nd, kind, exclusive=True, ceil_mode=False):
+    """x: [N, C, *spatial]; pooling over the trailing nd dims.
+    ceil_mode pads the high end so partial windows are kept (reference
+    pooling contract); padded positions never count toward averages."""
+    extra = [0] * nd
+    if ceil_mode:
+        for i in range(nd):
+            L = x.shape[2 + i]
+            span = L + 2 * pad[i] - k[i]
+            rem = span % s[i]
+            if rem:
+                extra[i] = s[i] - rem
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    padding = ((0, 0), (0, 0)) + tuple(
+        (pad[i], pad[i] + extra[i]) for i in range(nd))
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                 padding)
+    sums = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+    if (exclusive and any(pad)) or any(extra):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   padding)
+        return sums / counts
+    return sums / float(math.prod(k))
+
+
+def _to_nc_first(x, data_format, nd):
+    """Channels-last input -> NC-first for pooling, with the inverse
+    permutation to restore the caller's layout."""
+    if data_format in (None, "NCDHW", "NCHW", "NCL"):
+        return x, None
+    perm = (0, nd + 1) + tuple(range(1, nd + 1))
+    inv = (0,) + tuple(range(2, nd + 2)) + (1,)
+    return jnp.transpose(x, perm), inv
+
+
+@op
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    k = _tup(kernel_size, 3)
+    s = _tup(stride, 3) if stride is not None else k
+    x, inv = _to_nc_first(x, data_format, 3)
+    out = _pool_nd(x, k, s, _tup(padding, 3), 3, "max",
+                   ceil_mode=ceil_mode)
+    return jnp.transpose(out, inv) if inv else out
+
+
+@op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW"):
+    k = _tup(kernel_size, 3)
+    s = _tup(stride, 3) if stride is not None else k
+    x, inv = _to_nc_first(x, data_format, 3)
+    out = _pool_nd(x, k, s, _tup(padding, 3), 3, "avg",
+                   exclusive=exclusive, ceil_mode=ceil_mode)
+    return jnp.transpose(out, inv) if inv else out
+
+
+def _adaptive_bins(length, out):
+    """Reference adaptive pooling bins: [floor(i*L/out), ceil((i+1)*L/out))."""
+    return [(int(math.floor(i * length / out)),
+             int(math.ceil((i + 1) * length / out)))
+            for i in range(out)]
+
+
+def _adaptive_pool(x, out_sizes, kind):
+    """Pool trailing len(out_sizes) dims to the given sizes."""
+    nd = len(out_sizes)
+    red = jnp.max if kind == "max" else jnp.mean
+    for d, o in enumerate(out_sizes):
+        axis = x.ndim - nd + d
+        L = x.shape[axis]
+        if L % o == 0:
+            shape = (x.shape[:axis] + (o, L // o) + x.shape[axis + 1:])
+            x = red(x.reshape(shape), axis=axis + 1)
+        else:
+            slabs = [red(lax.slice_in_dim(x, a, b, axis=axis),
+                         axis=axis, keepdims=True)
+                     for a, b in _adaptive_bins(L, o)]
+            x = jnp.concatenate(slabs, axis=axis)
+    return x
+
+
+@op
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool(x, (int(output_size),), "avg")
+
+
+@op
+def adaptive_max_pool1d(x, output_size):
+    return _adaptive_pool(x, (int(output_size),), "max")
+
+
+@op
+def adaptive_avg_pool3d(x, output_size):
+    return _adaptive_pool(x, _tup(output_size, 3), "avg")
+
+
+@op
+def adaptive_max_pool3d(x, output_size):
+    return _adaptive_pool(x, _tup(output_size, 3), "max")
+
+
+@op
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False):
+    """Fractional max pooling (reference functional/pooling.py —
+    Graham'14 pseudo-random pooling regions). The region sequence is
+    derived from one uniform draw ``u`` (paddle's random_u contract);
+    rows i cover [floor((i+u)*L/out) - floor(u*L/out), ...)."""
+    oh, ow = _tup(output_size, 2)
+    from paddle_tpu.core import generator as gen
+
+    if random_u is None:
+        u = jax.random.uniform(gen.active_key(), ())
+    else:
+        u = jnp.asarray(random_u)
+    n, c, h, w = x.shape
+
+    def starts(L, o):
+        i = jnp.arange(o + 1, dtype=jnp.float32)
+        raw = jnp.floor((i + u) * L / o) - jnp.floor(u * L / o)
+        return jnp.clip(raw, 0, L).astype(jnp.int32)
+
+    hs = starts(h, oh)
+    ws = starts(w, ow)
+    # gather-max per output cell using a window bounded by the max bin
+    # width (static); out-of-bin positions masked to -inf
+    bh = int(math.ceil(h / oh)) + 1
+    bw = int(math.ceil(w / ow)) + 1
+    rows = hs[:-1][:, None] + jnp.arange(bh)[None, :]      # [oh, bh]
+    cols = ws[:-1][:, None] + jnp.arange(bw)[None, :]      # [ow, bw]
+    row_ok = rows < hs[1:][:, None]
+    col_ok = cols < ws[1:][:, None]
+    rcl = jnp.clip(rows, 0, h - 1)
+    ccl = jnp.clip(cols, 0, w - 1)
+    g = x[:, :, rcl][:, :, :, :, ccl]       # [n, c, oh, bh, ow, bw]
+    mask = (row_ok[:, :, None, None] & col_ok[None, None, :, :])
+    g = jnp.where(mask[None, None], g, -jnp.inf)
+    out = jnp.max(g, axis=(3, 5))
+    if not return_mask:
+        return out
+    # argmax flat spatial index per output cell (the unpool contract)
+    gf = g.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, oh, ow, bh * bw)
+    am = jnp.argmax(gf, axis=-1)            # [n, c, oh, ow]
+    ar = am // bw
+    ac = am % bw
+    oh_i = jnp.arange(oh)[None, None, :, None]
+    ow_i = jnp.arange(ow)[None, None, None, :]
+    r_idx = rcl[oh_i, ar]
+    c_idx = ccl[ow_i, ac]
+    return out, (r_idx * w + c_idx).astype(jnp.int32)
+
+
+@op
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False):
+    od, oh, ow = _tup(output_size, 3)
+    n, c, d, h, w = x.shape
+    # depth bins via adaptive split, then the 2-D fractional pool per slab
+    out = []
+    for a, b in _adaptive_bins(d, od):
+        slab = jnp.max(x[:, :, a:b], axis=2)
+        out.append(fractional_max_pool2d(slab, (oh, ow),
+                                         random_u=random_u))
+    return jnp.stack(out, axis=2)
+
+
+def _unpool_nd(x, indices, spatial_out):
+    """Scatter pooled values back to their argmax positions (paddle
+    unpool contract: indices are flat positions in the INPUT's spatial
+    plane, per [N, C])."""
+    n, c = x.shape[:2]
+    plane = int(math.prod(spatial_out))
+    flatv = x.reshape(n, c, -1)
+    flati = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, plane), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, flati, flatv)
+    return out.reshape((n, c) + tuple(spatial_out))
+
+
+@op
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    k = _tup(kernel_size, 1)[0]
+    s = _tup(stride, 1)[0] if stride is not None else k
+    L = output_size[-1] if output_size is not None else \
+        (x.shape[-1] - 1) * s + k - 2 * _tup(padding, 1)[0]
+    return _unpool_nd(x, indices, (int(L),))
+
+
+@op
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    k = _tup(kernel_size, 2)
+    s = _tup(stride, 2) if stride is not None else k
+    p = _tup(padding, 2)
+    if output_size is not None:
+        hw = tuple(int(v) for v in output_size[-2:])
+    else:
+        hw = tuple((x.shape[2 + i] - 1) * s[i] + k[i] - 2 * p[i]
+                   for i in range(2))
+    return _unpool_nd(x, indices, hw)
+
+
+@op
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    k = _tup(kernel_size, 3)
+    s = _tup(stride, 3) if stride is not None else k
+    p = _tup(padding, 3)
+    if output_size is not None:
+        dhw = tuple(int(v) for v in output_size[-3:])
+    else:
+        dhw = tuple((x.shape[2 + i] - 1) * s[i] + k[i] - 2 * p[i]
+                    for i in range(3))
+    return _unpool_nd(x, indices, dhw)
+
+
+@op
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    g = int(groups)
+    return x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(
+        n, c, h, w)
+
+
+@op
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    n, c, h, w = x.shape
+    r = int(downscale_factor)
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+        n, c * r * r, h // r, w // r)
+
+
+@op
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — the inverse of unfold (reference
+    paddle/phi/kernels/impl/fold_kernel_impl.h): overlapping patches
+    scatter-ADD back into the image."""
+    oh, ow = _tup(output_sizes, 2)
+    kh, kw = _tup(kernel_sizes, 2)
+    sh, sw = _tup(strides, 2)
+    ph, pw = _tup(paddings, 2)
+    dh, dw = _tup(dilations, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    # static double loop over the kernel footprint: kh*kw scatter-adds
+    for i in range(kh):
+        for j in range(kw):
+            rows = jnp.arange(nh) * sh + i * dh
+            colsj = jnp.arange(nw) * sw + j * dw
+            out = out.at[:, :, rows[:, None], colsj[None, :]].add(
+                cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@op
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    """Randomized leaky relu (reference rrelu op): slope ~ U[lower,
+    upper] per element in training, the mean slope in eval."""
+    if training:
+        from paddle_tpu.core import generator as gen
+
+        a = jax.random.uniform(gen.active_key(), x.shape,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding,
+                       output_padding, dilation, groups, nd, spec):
+    """Mirrors nn_ops.conv2d_transpose: paddle weight layout
+    [C_in, C_out/groups, *K] -> flipped OI* kernel with grouped
+    reshuffle, lhs_dilation = stride."""
+    s = _tup(stride, nd)
+    d = _tup(dilation, nd)
+    p = _tup(padding, nd)
+    opad = _tup(output_padding, nd)
+    ks = weight.shape[-nd:]
+    kd = [(ks[i] - 1) * d[i] + 1 for i in range(nd)]
+    pad_t = [(kd[i] - 1 - p[i], kd[i] - 1 - p[i] + opad[i])
+             for i in range(nd)]
+    w = jnp.flip(weight, axis=tuple(range(-nd, 0)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ci, cog = w.shape[0], w.shape[1]
+        w = w.reshape(groups, ci // groups, cog, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * cog, ci // groups,
+                                          *w.shape[3:])
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad_t, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=int(groups))
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@op
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1,
+                              ("NCH", "OIH", "NCH"))
+
+
+@op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3,
+                              ("NCDHW", "OIDHW", "NCDHW"))
+
+
+# ---------------------------------------------------------------------------
+# loss functionals (reference python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+@op
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+@op
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@op
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    term = (label * jax.nn.log_sigmoid(input)
+            + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        term = term * weight
+    loss = -jnp.mean(term, axis=-1)
+    return _reduce(loss, reduction)
+
+
+@op
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    lab = label.astype(jnp.int32).reshape(n)
+    correct = jnp.take_along_axis(input, lab[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - correct + input) ** p
+    if weight is not None:
+        m = m * jnp.take(weight, lab)[:, None]
+    mask = jax.nn.one_hot(lab, c, dtype=input.dtype)
+    loss = jnp.sum(m * (1 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@op
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (reference contract)
+        stir = (label * jnp.log(jnp.maximum(label, 1.0)) - label
+                + 0.5 * jnp.log(2 * math.pi * jnp.maximum(label, 1.0)))
+        loss = loss + jnp.where(label > 1, stir, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def soft_margin_loss(input, label, reduction="mean"):
+    # logaddexp form: log(1 + exp(-y*x)) without overflow at large |x|
+    loss = jnp.logaddexp(0.0, -label * input)
+    return _reduce(loss, reduction)
+
+
+@op
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1) \
+            ** (1.0 / p)
+
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+@op
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hsigmoid_loss, phi/kernels/cpu/hsigmoid_loss_kernel.cc).
+    Custom path_table/path_code follow the same gather path."""
+    n = input.shape[0]
+    code_len = int(jnp.ceil(jnp.log2(num_classes))) if path_table is \
+        None else path_table.shape[1]
+    lab = label.astype(jnp.int32).reshape(n)
+    if path_table is None:
+        # complete-tree codes: node ids and left/right bits per level
+        codes = []
+        nodes = []
+        for b in range(code_len):
+            c = lab + num_classes  # leaf id in the heap numbering
+            c = c // (2 ** (b + 1))
+            bit = (lab + num_classes) // (2 ** b) % 2
+            nodes.append(c - 1)
+            codes.append(bit.astype(input.dtype))
+        node_ids = jnp.stack(nodes, 1)         # [n, code_len]
+        code_bits = jnp.stack(codes, 1)
+        valid = node_ids >= 0
+    else:
+        node_ids = path_table.astype(jnp.int32).reshape(n, -1)
+        code_bits = path_code.astype(input.dtype).reshape(n, -1)
+        valid = node_ids >= 0
+    node_ids = jnp.maximum(node_ids, 0)
+    w = jnp.take(weight, node_ids, axis=0)     # [n, code_len, d]
+    logits = jnp.einsum("nkd,nd->nk", w, input)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), node_ids)
+    # sigmoid cross entropy per node against the path code
+    per = jnp.maximum(logits, 0) - logits * code_bits + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    per = jnp.where(valid, per, 0.0)
+    return jnp.sum(per, axis=1, keepdims=True)

@@ -126,3 +126,25 @@ def test_aliases_and_utilities():
     b = paddle_tpu.zeros([100])
     paddle_tpu.normal_(b)
     assert float(b.numpy().std()) > 0.1
+
+
+def test_nn_namespace_complete():
+    """paddle.nn must export the reference's full layer set (134 names
+    from python/paddle/nn/__init__.py __all__; spot list below covers
+    the round-5 additions; the hasattr sweep covers the rest)."""
+    from paddle_tpu import nn
+
+    round5 = [
+        "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+        "AdaptiveMaxPool3D", "AvgPool3D", "BeamSearchDecoder", "BiRNN",
+        "ChannelShuffle", "Conv1DTranspose", "Conv3DTranspose", "Fold",
+        "FractionalMaxPool2D", "FractionalMaxPool3D", "GaussianNLLLoss",
+        "HSigmoidLoss", "HingeEmbeddingLoss", "MaxPool3D", "MaxUnPool1D",
+        "MaxUnPool2D", "MaxUnPool3D", "MultiLabelSoftMarginLoss",
+        "MultiMarginLoss", "PixelUnshuffle", "PoissonNLLLoss",
+        "RNNCellBase", "RReLU", "SoftMarginLoss", "Softmax2D",
+        "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+        "Unflatten", "ZeroPad2D", "dynamic_decode",
+    ]
+    missing = [n for n in round5 if not hasattr(nn, n)]
+    assert not missing, f"missing nn symbols: {missing}"

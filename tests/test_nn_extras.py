@@ -1,0 +1,279 @@
+"""nn long-tail layers (nn/layers_extra.py + ops/nn_extras.py):
+pooling/unpooling/fractional, shuffles, fold, conv transposes, the
+remaining losses (torch-referenced), BiRNN and beam-search decoding.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, "float32"))
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_pool3d_and_adaptive(rng):
+    x3 = t(rng.standard_normal((1, 2, 4, 4, 4)))
+    assert tuple(nn.MaxPool3D(2)(x3).shape) == (1, 2, 2, 2, 2)
+    assert tuple(nn.AvgPool3D(2)(x3).shape) == (1, 2, 2, 2, 2)
+    assert tuple(nn.AdaptiveAvgPool3D(3)(x3).shape) == (1, 2, 3, 3, 3)
+    x1 = t(rng.standard_normal((1, 2, 7)))
+    assert tuple(nn.AdaptiveMaxPool1D(3)(x1).shape) == (1, 2, 3)
+    assert tuple(nn.AdaptiveAvgPool1D(3)(x1).shape) == (1, 2, 3)
+    # numerics: avg_pool3d == reshape-mean for divisible sizes
+    got = nn.AvgPool3D(2)(x3).numpy()
+    want = x3.numpy().reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(
+        axis=(3, 5, 7))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fractional_pool(rng):
+    f = nn.FractionalMaxPool2D((3, 3), random_u=0.3)(
+        t(rng.standard_normal((1, 1, 7, 7))))
+    assert tuple(f.shape) == (1, 1, 3, 3)
+    assert np.isfinite(f.numpy()).all()
+    f3 = nn.FractionalMaxPool3D((2, 3, 3), random_u=0.3)(
+        t(rng.standard_normal((1, 2, 4, 7, 7))))
+    assert tuple(f3.shape) == (1, 2, 2, 3, 3)
+    # global max must survive any pooling partition
+    x = t(rng.standard_normal((1, 1, 6, 6)))
+    out = nn.FractionalMaxPool2D((2, 2), random_u=0.7)(x)
+    assert np.isclose(out.numpy().max(), x.numpy().max())
+
+
+def test_max_unpool(rng):
+    up = nn.MaxUnPool1D(2)(t([[[3.0, 4.0]]]),
+                           paddle.to_tensor(np.asarray([[[1, 3]]])))
+    np.testing.assert_allclose(up.numpy(), [[[0.0, 3.0, 0.0, 4.0]]])
+    up2 = nn.MaxUnPool2D(2)(
+        t([[[[5.0]]]]), paddle.to_tensor(np.asarray([[[[3]]]])))
+    np.testing.assert_allclose(up2.numpy(),
+                               [[[[0.0, 0.0], [0.0, 5.0]]]])
+
+
+def test_shuffles_pads_softmax2d(rng):
+    x = t(rng.standard_normal((1, 4, 2, 2)))
+    cs = nn.ChannelShuffle(2)(x)
+    assert tuple(cs.shape) == (1, 4, 2, 2)
+    # channel_shuffle permutes channels only
+    np.testing.assert_allclose(np.sort(cs.numpy(), axis=1),
+                               np.sort(x.numpy(), axis=1))
+    pu = nn.PixelUnshuffle(2)(t(rng.standard_normal((1, 1, 4, 4))))
+    assert tuple(pu.shape) == (1, 4, 2, 2)
+    zp = nn.ZeroPad2D([1, 1, 2, 2])(t(rng.standard_normal((1, 1, 2, 2))))
+    assert tuple(zp.shape) == (1, 1, 6, 4)
+    s2 = nn.Softmax2D()(t(rng.standard_normal((1, 3, 2, 2))))
+    np.testing.assert_allclose(s2.numpy().sum(axis=1),
+                               np.ones((1, 2, 2)), rtol=1e-5)
+    uf = nn.Unflatten(1, [2, 2])(t(rng.standard_normal((3, 4))))
+    assert tuple(uf.shape) == (3, 2, 2)
+
+
+def test_fold_inverts_unfold(rng):
+    img = t(rng.standard_normal((1, 1, 4, 4)))
+    col = nn.functional.unfold(img, 2, strides=2)
+    rec = nn.Fold((4, 4), 2, strides=2)(col)
+    np.testing.assert_allclose(rec.numpy(), img.numpy(), rtol=1e-6)
+
+
+def test_rrelu(rng):
+    x = t(rng.standard_normal((64,)))
+    layer = nn.RReLU(0.1, 0.3)
+    layer.eval()
+    got = layer(x).numpy()
+    want = np.where(x.numpy() >= 0, x.numpy(), 0.2 * x.numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    layer.train()
+    tr = layer(x).numpy()
+    neg = x.numpy() < 0
+    slopes = tr[neg] / x.numpy()[neg]
+    assert (slopes >= 0.1 - 1e-6).all() and (slopes <= 0.3 + 1e-6).all()
+
+
+def test_conv_transposes_match_torch(rng):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+
+    w1 = rng.standard_normal((2, 3, 3)).astype("float32")
+    x1 = rng.standard_normal((1, 2, 5)).astype("float32")
+    ours = paddle.ops.get_op("conv1d_transpose")(
+        t(x1), t(w1), None, stride=2).numpy()
+    ref = TF.conv_transpose1d(torch.tensor(x1), torch.tensor(w1),
+                              stride=2).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    w3 = rng.standard_normal((2, 3, 2, 2, 2)).astype("float32")
+    x3 = rng.standard_normal((1, 2, 3, 3, 3)).astype("float32")
+    ours = paddle.ops.get_op("conv3d_transpose")(
+        t(x3), t(w3), None, stride=2, padding=1).numpy()
+    ref = TF.conv_transpose3d(torch.tensor(x3), torch.tensor(w3),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    # layer classes construct + run
+    c1 = nn.Conv1DTranspose(2, 3, 3, stride=2)
+    assert tuple(c1(t(x1)).shape) == (1, 3, 11)
+    c3 = nn.Conv3DTranspose(2, 3, 2, stride=2)
+    assert tuple(c3(t(x3)).shape) == (1, 3, 6, 6, 6)
+
+
+def test_losses_match_torch(rng):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+
+    inp = rng.standard_normal((4, 5)).astype("float32")
+    lab = rng.standard_normal((4, 5)).astype("float32")
+    var = rng.random((4, 5)).astype("float32") + 0.1
+    y = np.sign(rng.standard_normal((4, 5))).astype("float32")
+    bl = (rng.random((4, 5)) > 0.5).astype("float32")
+    cls = rng.integers(0, 5, 4)
+    pos = np.abs(rng.standard_normal((4, 5))).astype("float32")
+
+    cases = [
+        (nn.GaussianNLLLoss()(t(inp), t(lab), t(var)),
+         TF.gaussian_nll_loss(torch.tensor(inp), torch.tensor(lab),
+                              torch.tensor(var))),
+        (nn.HingeEmbeddingLoss()(t(inp), t(y)),
+         TF.hinge_embedding_loss(torch.tensor(inp), torch.tensor(y))),
+        (nn.MultiLabelSoftMarginLoss()(t(inp), t(bl)),
+         TF.multilabel_soft_margin_loss(torch.tensor(inp),
+                                        torch.tensor(bl))),
+        (nn.MultiMarginLoss()(t(inp),
+                              paddle.to_tensor(cls.astype("int32"))),
+         TF.multi_margin_loss(torch.tensor(inp), torch.tensor(cls))),
+        (nn.PoissonNLLLoss()(t(inp), t(pos)),
+         TF.poisson_nll_loss(torch.tensor(inp), torch.tensor(pos))),
+        (nn.SoftMarginLoss()(t(inp), t(y)),
+         TF.soft_margin_loss(torch.tensor(inp), torch.tensor(y))),
+    ]
+    for got, want in cases:
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-3)
+
+    a = rng.standard_normal((4, 8)).astype("float32")
+    p = rng.standard_normal((4, 8)).astype("float32")
+    n = rng.standard_normal((4, 8)).astype("float32")
+    np.testing.assert_allclose(
+        nn.TripletMarginLoss()(t(a), t(p), t(n)).numpy(),
+        TF.triplet_margin_loss(torch.tensor(a), torch.tensor(p),
+                               torch.tensor(n)).numpy(), rtol=1e-3)
+    # custom-distance variant agrees with default for L2
+    got = nn.TripletMarginWithDistanceLoss()(t(a), t(p), t(n))
+    assert np.isfinite(float(got.numpy()))
+
+
+def test_hsigmoid_trains(rng):
+    paddle.seed(0)
+    hs = nn.HSigmoidLoss(8, 6)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=hs.parameters())
+    X = t(rng.standard_normal((16, 8)))
+    L = paddle.to_tensor(rng.integers(0, 6, 16).astype("int32"))
+    l0 = None
+    for _ in range(25):
+        loss = hs(X, L).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if l0 is None:
+            l0 = float(loss.numpy())
+    assert float(loss.numpy()) < l0 * 0.8
+
+
+def test_birnn(rng):
+    cell_fw = nn.SimpleRNNCell(4, 6)
+    cell_bw = nn.SimpleRNNCell(4, 6)
+    out, (sf, sb) = nn.BiRNN(cell_fw, cell_bw)(
+        t(rng.standard_normal((2, 5, 4))))
+    assert tuple(out.shape) == (2, 5, 12)
+    # forward half equals a forward-only RNN
+    from paddle_tpu.nn.rnn import RNN
+
+    fw_out, _ = RNN(cell_fw)(t(rng.standard_normal((2, 5, 4))))
+    assert tuple(fw_out.shape) == (2, 5, 6)
+
+
+def test_beam_search_decode(rng):
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4)
+    proj = nn.Linear(6, 10)
+    cell = nn.SimpleRNNCell(4, 6)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=9,
+                               beam_size=3,
+                               embedding_fn=lambda ids: emb(ids),
+                               output_fn=lambda h: proj(h))
+    ids, lps = nn.dynamic_decode(dec, max_step_num=6, batch_size=2)
+    assert ids.shape[0] == 2 and ids.shape[1] == 3
+    assert tuple(lps.shape) == (2, 3)
+    # beams are sorted best-first per batch
+    l = lps.numpy()
+    assert (np.diff(l, axis=1) <= 1e-5).all()
+
+
+def test_rnncellbase_initial_states(rng):
+    class MyCell(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.hidden_size = 7
+
+    c = MyCell()
+    s = c.get_initial_states(t(rng.standard_normal((3, 4))))
+    assert tuple(s.shape) == (3, 7)
+
+
+def test_pool3d_ceil_mode_and_layout(rng):
+    x = t(rng.standard_normal((1, 1, 5, 5, 5)))
+    out = paddle.ops.get_op("max_pool3d")(x, 2, stride=2,
+                                          ceil_mode=True)
+    assert tuple(out.shape) == (1, 1, 3, 3, 3)
+    # ceil-mode averages never count padded cells
+    ones = t(np.ones((1, 1, 3, 3, 3)))
+    av = paddle.ops.get_op("avg_pool3d")(ones, 2, stride=2,
+                                         ceil_mode=True)
+    np.testing.assert_allclose(av.numpy(), 1.0, rtol=1e-6)
+    # channels-last layout
+    xn = rng.standard_normal((1, 4, 4, 4, 2)).astype("float32")
+    got = paddle.ops.get_op("max_pool3d")(t(xn), 2,
+                                          data_format="NDHWC").numpy()
+    want = paddle.ops.get_op("max_pool3d")(
+        t(xn.transpose(0, 4, 1, 2, 3)), 2).numpy().transpose(
+        0, 2, 3, 4, 1)
+    np.testing.assert_allclose(got, want)
+
+
+def test_fractional_return_mask_feeds_unpool(rng):
+    xf = t(rng.standard_normal((1, 1, 6, 6)))
+    out, mask = paddle.ops.get_op("fractional_max_pool2d")(
+        xf, (3, 3), random_u=0.4, return_mask=True)
+    flat = xf.numpy().reshape(-1)
+    np.testing.assert_allclose(out.numpy().reshape(-1),
+                               flat[mask.numpy().reshape(-1)])
+
+
+def test_soft_margin_loss_stable_at_large_logits():
+    v = nn.SoftMarginLoss()(t([-100.0]), t([1.0]))
+    assert np.isclose(float(v.numpy()), 100.0, rtol=1e-3)
+
+
+def test_beam_ancestry_greedy_equivalence(rng):
+    """Beam=1 decode must equal the argmax rollout — only true when
+    sequences are backtracked through parent beams (gather_tree)."""
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4)
+    proj = nn.Linear(6, 10)
+    cell = nn.SimpleRNNCell(4, 6)
+    dec1 = nn.BeamSearchDecoder(cell, 0, 9, 1, embedding_fn=emb,
+                                output_fn=proj)
+    ids1, _ = nn.dynamic_decode(dec1, max_step_num=5, batch_size=1)
+    tok = paddle.to_tensor(np.asarray([0], "int32"))
+    st = None
+    want = []
+    for _ in range(ids1.shape[-1]):
+        o, st = cell(emb(tok), st)
+        nxt = int(np.argmax(proj(o).numpy()))
+        want.append(nxt)
+        tok = paddle.to_tensor(np.asarray([nxt], "int32"))
+    np.testing.assert_allclose(ids1.numpy()[0, 0], want)
