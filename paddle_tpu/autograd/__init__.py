@@ -4,3 +4,25 @@ from paddle_tpu.autograd.engine import (  # noqa: F401
 )
 from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
 from paddle_tpu.autograd.functional import grad, jacobian, hessian, vjp, jvp  # noqa: F401
+
+
+class saved_tensors_hooks:
+    """Reference autograd.saved_tensors_hooks packs/unpacks the tensors
+    the tape saves for backward (CPU offload etc.). This build's
+    backward residuals live inside XLA vjp closures and are not
+    interceptable per-tensor, so the context raises rather than
+    silently not firing the hooks; the TPU-native memory levers are
+    jax.checkpoint via paddle_tpu.distributed.fleet.recompute and
+    TrainStep's buffer donation."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self._hooks = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        raise NotImplementedError(
+            "saved_tensors_hooks cannot intercept XLA vjp residuals; "
+            "use recompute (activation checkpointing) for the memory-"
+            "offload use case")
+
+    def __exit__(self, *exc):
+        return False

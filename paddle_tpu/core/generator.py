@@ -70,9 +70,15 @@ default_generator = Generator(0)
 
 
 def seed(s: int) -> Generator:
-    """Global manual seed (parity with ``paddle.seed``)."""
+    """Global manual seed (parity with ``paddle.seed``). Also seeds
+    numpy's global RNG so host-side pipeline randomness (samplers,
+    transforms) is reproducible under the same call — the reference
+    gets this via seed-controlled randperm ops in its samplers."""
+    import numpy as _np
+
     default_generator.manual_seed(s)
     get_rng_tracker().reset(s)
+    _np.random.seed(s % (2 ** 32))
     return default_generator
 
 

@@ -32,3 +32,14 @@ from paddle_tpu.distributed.parallel_wrapper import DataParallel  # noqa: F401
 from paddle_tpu.distributed.engine import (  # noqa: F401
     ParallelConfig, ParallelTrainStep, shard_model_parameters,
 )
+from paddle_tpu.distributed.compat import (  # noqa: F401
+    CountFilterEntry, DistAttr, InMemoryDataset, ProbabilityEntry,
+    QueueDataset, ReduceType, ShowClickEntry, Strategy, alltoall,
+    alltoall_single, broadcast_object_list, destroy_process_group,
+    dtensor_from_fn, gather, get_backend, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, is_available,
+    load_state_dict, save_state_dict, scatter_object_list,
+    shard_scaler, spawn, split, wait,
+)
+from paddle_tpu.distributed import launch  # noqa: F401
+from paddle_tpu.distributed import io  # noqa: F401

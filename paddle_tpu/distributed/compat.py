@@ -1,0 +1,353 @@
+"""paddle.distributed namespace completion (reference:
+python/paddle/distributed/__init__.py __all__): collective aliases and
+object collectives, process helpers, auto-parallel config types, and
+raise-stubs for the PS-stack dataset classes this build declares out of
+scope (README "Scope").
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "alltoall", "alltoall_single", "gather", "wait", "get_backend",
+    "is_available", "destroy_process_group", "broadcast_object_list",
+    "scatter_object_list", "spawn", "shard_scaler", "dtensor_from_fn",
+    "DistAttr", "ReduceType", "Strategy", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "split", "InMemoryDataset",
+    "QueueDataset", "ProbabilityEntry", "CountFilterEntry",
+    "ShowClickEntry", "save_state_dict", "load_state_dict",
+]
+
+
+# ---------------------------------------------------------------------------
+# collective aliases / variants
+# ---------------------------------------------------------------------------
+def alltoall(out_tensor_list, in_tensor_list=None, group=None,
+             sync_op=True):
+    """Reference alltoall (list form) — alias of all_to_all."""
+    from paddle_tpu.distributed.communication import all_to_all
+
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: dim 0 is split evenly across ranks
+    (reference alltoall_single; uneven splits are not supported on the
+    SPMD path)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with uneven split sizes is not supported "
+            "(XLA all_to_all requires equal splits)")
+    from paddle_tpu.distributed import env
+    from paddle_tpu.distributed.communication import all_to_all, get_group
+
+    if env.get_world_size() <= 1:
+        d = in_tensor._data if isinstance(in_tensor, Tensor) else \
+            __import__("jax").numpy.asarray(in_tensor)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._data = d
+            return out_tensor
+        return Tensor._from_data(d)
+    g = group or get_group(0)
+    n = len(g.ranks)
+    chunks = [Tensor._from_data(c) for c in
+              __import__("jax").numpy.split(
+                  in_tensor._data if isinstance(in_tensor, Tensor)
+                  else in_tensor, n)]
+    outs: List = []  # all_to_all APPENDS results
+    all_to_all(outs, chunks, group=group, sync_op=sync_op)
+    import jax.numpy as jnp
+
+    res = jnp.concatenate([o._data for o in outs])
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = res
+        return out_tensor
+    return Tensor._from_data(res)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Reference gather: under the single-controller model every process
+    sees the gathered list; ``dst`` selects where the reference would
+    materialize it."""
+    from paddle_tpu.distributed import env
+    from paddle_tpu.distributed.communication import all_gather
+
+    out: List = []
+    if env.get_world_size() <= 1:
+        out = [tensor]
+    else:
+        all_gather(out, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(out)
+    return gather_list if gather_list is not None else out
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Collectives on the XLA path are issued synchronously into the
+    device stream; wait just drains (reference wait on the calc
+    stream)."""
+    import jax
+
+    d = tensor._data if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(d)
+    return tensor
+
+
+def get_backend(group=None) -> str:
+    import jax
+
+    return "xla" if jax.default_backend() != "cpu" else "gloo"
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None):
+    """Drop cached groups/jits (reference destroys the NCCL comm)."""
+    from paddle_tpu.distributed import communication as comm
+
+    for attr in ("_groups", "_eager_jits"):
+        d = getattr(comm, attr, None)
+        if isinstance(d, dict):
+            d.clear()
+
+
+# ---------------------------------------------------------------------------
+# object collectives (pickle over the tensor collectives)
+# ---------------------------------------------------------------------------
+def broadcast_object_list(object_list, src=0, group=None):
+    """Reference broadcast_object_list: pickle -> byte tensor ->
+    broadcast -> unpickle in place."""
+    from paddle_tpu.distributed.communication import (
+        all_gather_object, get_group,
+    )
+
+    from paddle_tpu.distributed import env
+
+    if env.get_world_size() <= 1:
+        return object_list  # single process: already the source copy
+    g = group or get_group(0)
+    gathered: List = []
+    all_gather_object(gathered, object_list)
+    src_local = g.ranks.index(src) if src in g.ranks else 0
+    object_list[:] = gathered[src_local]
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from paddle_tpu.distributed import env
+    from paddle_tpu.distributed.communication import (
+        all_gather_object, get_group,
+    )
+
+    from paddle_tpu.distributed import env as _env
+
+    if _env.get_world_size() <= 1:
+        out_object_list[:] = list(in_object_list or [])[:1]
+        return out_object_list
+    g = group or get_group(0)
+    gathered: List = []
+    all_gather_object(gathered, in_object_list or [])
+    src_local = g.ranks.index(src) if src in g.ranks else 0
+    objs = gathered[src_local]
+    rank_local = g.ranks.index(env.get_rank()) if env.get_rank() in \
+        g.ranks else 0
+    out_object_list[:] = [objs[rank_local]] if rank_local < len(objs) \
+        else []
+    return out_object_list
+
+
+# ---------------------------------------------------------------------------
+# process helpers
+# ---------------------------------------------------------------------------
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Spawn ``nprocs`` worker processes with the PADDLE_* rendezvous env
+    (reference distributed.spawn over multiprocessing)."""
+    import multiprocessing as mp
+    import os
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        }
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    import os
+
+    os.environ.update(env)
+    func(*args)
+
+
+def shard_scaler(scaler):
+    """Reference shard_scaler syncs found_inf across the sharding group;
+    the compiled-step GradScaler already reduces found_inf inside the
+    jitted step (amp.scaler_unscale_and_check over global grads), so the
+    scaler passes through unchanged."""
+    return scaler
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Build a tensor by calling ``fn`` then shard it (reference
+    dtensor_from_fn, auto_parallel/api.py)."""
+    from paddle_tpu.distributed.api import shard_tensor
+
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+# ---------------------------------------------------------------------------
+# auto-parallel config types
+# ---------------------------------------------------------------------------
+class ReduceType:
+    """Reference phi ReduceType enum (placement_types.h)."""
+
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
+class DistAttr:
+    """Tensor distribution attributes (reference TensorDistAttr wrapper:
+    mesh + per-dim sharding)."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+class _Toggle:
+    def __init__(self, **kw):
+        self.enable = False
+        self.__dict__.update(kw)
+
+
+class Strategy:
+    """Auto-parallel strategy config (reference distributed.Strategy,
+    auto_parallel/strategy.py): toggles consumed by dist.to_static /
+    DistModel (sharding.stage is the one the engine reads)."""
+
+    def __init__(self, config=None):
+        self.sharding = _Toggle(stage=1, degree=-1)
+        self.amp = _Toggle(dtype="bfloat16", level="O1")
+        self.recompute = _Toggle()
+        self.pipeline = _Toggle(schedule_mode="1F1B", micro_batch_size=1,
+                                accumulate_steps=1)
+        self.fused_passes = _Toggle(fused_passes_list=[])
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)
+
+
+# ---------------------------------------------------------------------------
+# gloo compat (CPU debug backend)
+# ---------------------------------------------------------------------------
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only bootstrap (reference gloo_init_parallel_env): maps onto
+    init_parallel_env with the gloo collectives the CPU backend uses."""
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    from paddle_tpu.distributed.env import init_parallel_env
+
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from paddle_tpu.distributed.communication import barrier
+
+    barrier()
+
+
+def gloo_release():
+    destroy_process_group()
+
+
+# ---------------------------------------------------------------------------
+# legacy static-graph model-parallel splitter + PS-stack stubs
+# ---------------------------------------------------------------------------
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split is the legacy static-graph "
+        "model-parallel splitter; use fleet.meta_parallel "
+        "Column/Row/VocabParallelLinear|Embedding (eager/compiled TP) "
+        "or dist.shard_tensor + GSPMD for the same partitioning")
+
+
+class _PSStub:
+    _WHAT = "this class"
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{self._WHAT} belongs to the parameter-server training "
+            "stack, which this TPU build deliberately excludes (see "
+            "README 'Scope': synchronous mesh parallelism replaces the "
+            "brpc PS architecture)")
+
+
+class InMemoryDataset(_PSStub):
+    _WHAT = "InMemoryDataset"
+
+
+class QueueDataset(_PSStub):
+    _WHAT = "QueueDataset"
+
+
+class ProbabilityEntry(_PSStub):
+    _WHAT = "ProbabilityEntry"
+
+
+class CountFilterEntry(_PSStub):
+    _WHAT = "CountFilterEntry"
+
+
+class ShowClickEntry(_PSStub):
+    _WHAT = "ShowClickEntry"
+
+
+# checkpoint re-exports (reference top-level save/load_state_dict)
+from paddle_tpu.distributed.checkpoint import (  # noqa: E402,F401
+    load_state_dict, save_state_dict,
+)

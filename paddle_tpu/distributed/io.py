@@ -1,0 +1,54 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py:
+save/load persistables for distributed static programs). Persistables
+here are a Program's captured parameters; storage rides the sharded
+checkpoint module."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    """Save a static Program's persistable captures (reference
+    save_persistables)."""
+    import numpy as np
+
+    from paddle_tpu import static
+
+    prog = main_program or static.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    arrs = {t.name or f"param_{i}": np.asarray(t._data)
+            for i, t in enumerate(prog.captures) if is_persistable(t)}
+    fname = filename or "persistables.npz"
+    if not fname.endswith(".npz"):
+        fname += ".npz"  # np.savez appends it silently; np.load won't
+    np.savez(os.path.join(dirname, fname), **arrs)
+    return list(arrs)
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu import static
+
+    prog = main_program or static.default_main_program()
+    fname = filename or "persistables.npz"
+    if not fname.endswith(".npz"):
+        fname += ".npz"
+    data = np.load(os.path.join(dirname, fname))
+    by_name = {t.name or f"param_{i}": t
+               for i, t in enumerate(prog.captures) if is_persistable(t)}
+    for k in data.files:
+        if k in by_name:
+            by_name[k]._data = jnp.asarray(data[k])
+    return list(data.files)

@@ -23,7 +23,7 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.registry import API as _ops
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "Exponential", "Gumbel", "Laplace", "kl_divergence"]
+           "Exponential", "Gumbel", "Laplace", "kl_divergence", "register_kl"]
 
 _LOG2PI = math.log(2.0 * math.pi)
 
@@ -292,31 +292,80 @@ class Laplace(Distribution):
             if self._batch_shape else out
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p || q) rule for a distribution pair
+    (reference distribution/kl.py register_kl); user rules take
+    precedence over the built-ins."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     """KL(p || q) for registered pairs (reference distribution/kl.py);
-    differentiable w.r.t. both distributions' parameters."""
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        var_ratio = _ops["square"](p.scale / q.scale)
-        t1 = _ops["square"]((p.loc - q.loc) / q.scale)
-        return 0.5 * (var_ratio + t1 - 1.0 - _ops["log"](var_ratio))
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        lp = _ops["log_softmax"](p.logits, axis=-1)
-        lq = _ops["log_softmax"](q.logits, axis=-1)
-        return _ops["sum"](_ops["exp"](lp) * (lp - lq), axis=-1)
-    if isinstance(p, Uniform) and isinstance(q, Uniform):
-        return _ops["log"]((q.high - q.low) / (p.high - p.low))
-    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
-        eps = 1e-7
-        a = _ops["clip"](p.probs_, eps, 1 - eps)
-        b = _ops["clip"](q.probs_, eps, 1 - eps)
-        return a * _ops["log"](a / b) \
-            + (1.0 - a) * _ops["log"]((1.0 - a) / (1.0 - b))
-    if isinstance(p, Exponential) and isinstance(q, Exponential):
-        r = p.rate / q.rate
-        return _ops["log"](r) + 1.0 / r - 1.0
-    raise NotImplementedError(
-        f"kl_divergence not registered for "
-        f"({type(p).__name__}, {type(q).__name__})")
+    differentiable w.r.t. both distributions' parameters. Dispatch
+    picks the MOST SPECIFIC matching pair (reference total_ordering) —
+    builtins are ordinary registry entries, so a user rule for the same
+    pair overrides them, but a base-class fallback never shadows a more
+    specific rule."""
+    matches = [(cp, cq, fn) for (cp, cq), fn in _KL_REGISTRY.items()
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+
+    def specificity(m):
+        cp, cq, _ = m
+        # deeper in each MRO = more specific; registration order breaks
+        # exact ties LIFO (later registrations win), matching reference
+        return (len(type(p).__mro__) - type(p).__mro__.index(cp)
+                + len(type(q).__mro__) - type(q).__mro__.index(cq),
+                list(_KL_REGISTRY).index((cp, cq)))
+
+    return max(matches, key=specificity)[2](p, q)
+
+
+def _kl_normal_normal(p, q):
+    var_ratio = _ops["square"](p.scale / q.scale)
+    t1 = _ops["square"]((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1.0 - _ops["log"](var_ratio))
+
+
+def _kl_categorical(p, q):
+    lp = _ops["log_softmax"](p.logits, axis=-1)
+    lq = _ops["log_softmax"](q.logits, axis=-1)
+    return _ops["sum"](_ops["exp"](lp) * (lp - lq), axis=-1)
+
+
+def _kl_uniform(p, q):
+    return _ops["log"]((q.high - q.low) / (p.high - p.low))
+
+
+def _kl_bernoulli(p, q):
+    eps = 1e-7
+    a = _ops["clip"](p.probs_, eps, 1 - eps)
+    b = _ops["clip"](q.probs_, eps, 1 - eps)
+    return a * _ops["log"](a / b) \
+        + (1.0 - a) * _ops["log"]((1.0 - a) / (1.0 - b))
+
+
+def _kl_exponential(p, q):
+    r = p.rate / q.rate
+    return _ops["log"](r) + 1.0 / r - 1.0
+
+
+_KL_REGISTRY[(Normal, Normal)] = _kl_normal_normal
+_KL_REGISTRY[(Categorical, Categorical)] = _kl_categorical
+_KL_REGISTRY[(Uniform, Uniform)] = _kl_uniform
+_KL_REGISTRY[(Bernoulli, Bernoulli)] = _kl_bernoulli
+_KL_REGISTRY[(Exponential, Exponential)] = _kl_exponential
 
 
 # ---------------------------------------------------------------------------

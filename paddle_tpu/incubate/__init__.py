@@ -5,3 +5,9 @@ incubate/distributed/models/moe)."""
 from paddle_tpu.incubate import moe  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
 from paddle_tpu.incubate import asp  # noqa: F401
+from paddle_tpu.incubate.compat import (  # noqa: F401
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss, segment_max,
+    segment_mean, segment_min, segment_sum, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)

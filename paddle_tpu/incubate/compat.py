@@ -1,0 +1,239 @@
+"""incubate namespace completion (reference python/paddle/incubate/
+__init__.py __all__): segment reductions, graph sampling, fused softmax
+masks, optimizer wrappers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+           "graph_sample_neighbors", "identity_loss",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "LookAhead", "ModelAverage"]
+
+
+def _d(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _segment(fn_name):
+    def f(data, segment_ids, name=None):
+        d, ids = _d(data), _d(segment_ids).astype(jnp.int32)
+        n = int(np.asarray(ids).max()) + 1 if ids.size else 0
+        fn = getattr(jax.ops, fn_name)
+        return Tensor._from_data(fn(d, ids, num_segments=n))
+
+    f.__name__ = fn_name
+    return f
+
+
+segment_sum = _segment("segment_sum")
+segment_max = _segment("segment_max")
+segment_min = _segment("segment_min")
+
+
+def segment_mean(data, segment_ids, name=None):
+    d, ids = _d(data), _d(segment_ids).astype(jnp.int32)
+    n = int(np.asarray(ids).max()) + 1 if ids.size else 0
+    s = jax.ops.segment_sum(d, ids, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                            num_segments=n)
+    shape = (-1,) + (1,) * (d.ndim - 1)
+    return Tensor._from_data(s / jnp.maximum(c.reshape(shape), 1))
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum",
+                    out_size=None, name=None):
+    from paddle_tpu.ops.registry import API
+
+    return API["graph_send_recv"](x, src_index, dst_index,
+                                  reduce_op=reduce_op.lower(),
+                                  out_size=out_size or 0)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           name=None):
+    """Uniform neighbor sampling on a CSC graph (reference
+    incubate/graph sampling ops — host-side there too)."""
+    rows = np.asarray(_d(row))
+    cp = np.asarray(_d(colptr))
+    nodes = np.asarray(_d(input_nodes)).reshape(-1)
+    out_n, out_count = [], []
+    rng = np.random.default_rng()
+    for v in nodes:
+        nb = rows[cp[v]:cp[v + 1]]
+        if sample_size > 0 and len(nb) > sample_size:
+            nb = rng.choice(nb, sample_size, replace=False)
+        out_n.append(nb)
+        out_count.append(len(nb))
+    flat = np.concatenate(out_n) if out_n else np.zeros((0,), rows.dtype)
+    return (Tensor._from_data(jnp.asarray(flat)),
+            Tensor._from_data(jnp.asarray(np.asarray(out_count,
+                                                     np.int32))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling: iterate graph_sample_neighbors per hop."""
+    frontier = np.asarray(_d(input_nodes)).reshape(-1)
+    all_edges_src, all_edges_dst = [], []
+    for k in (sample_sizes if isinstance(sample_sizes, (list, tuple))
+              else [sample_sizes]):
+        nbrs, counts = graph_sample_neighbors(row, colptr,
+                                              jnp.asarray(frontier),
+                                              sample_size=int(k))
+        nb = np.asarray(nbrs._data)
+        cnt = np.asarray(counts._data)
+        dst = np.repeat(frontier, cnt)
+        all_edges_src.append(nb)
+        all_edges_dst.append(dst)
+        frontier = np.unique(np.concatenate([frontier, nb]))
+    src = np.concatenate(all_edges_src)
+    dst = np.concatenate(all_edges_dst)
+    r_src, r_dst, nodes = _reindex(np.asarray(_d(input_nodes)).reshape(-1),
+                                   src, dst)
+    return (Tensor._from_data(jnp.asarray(r_src)),
+            Tensor._from_data(jnp.asarray(r_dst)),
+            Tensor._from_data(jnp.asarray(nodes)),
+            Tensor._from_data(jnp.asarray(
+                np.arange(len(src), dtype=np.int64))))
+
+
+def _reindex(seed_nodes, src, dst):
+    nodes = np.concatenate([seed_nodes, src, dst])
+    uniq = []
+    seen = set()
+    for v in nodes:
+        if int(v) not in seen:
+            seen.add(int(v))
+            uniq.append(int(v))
+    remap = {v: i for i, v in enumerate(uniq)}
+    return (np.asarray([remap[int(v)] for v in src], np.int64),
+            np.asarray([remap[int(v)] for v in dst], np.int64),
+            np.asarray(uniq, np.int64))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Reference graph_reindex: compact node ids to [0, N)."""
+    seeds = np.asarray(_d(x)).reshape(-1)
+    nb = np.asarray(_d(neighbors)).reshape(-1)
+    cnt = np.asarray(_d(count)).reshape(-1)
+    dst = np.repeat(seeds, cnt)
+    r_src, r_dst, nodes = _reindex(seeds, nb, dst)
+    return (Tensor._from_data(jnp.asarray(r_src)),
+            Tensor._from_data(jnp.asarray(r_dst)),
+            Tensor._from_data(jnp.asarray(nodes)))
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate.identity_loss (IPU loss anchor): reduction of
+    x itself."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion region (reference fused CUDA
+    kernel incubate/operators/softmax_mask_fuse.py)."""
+    return Tensor._from_data(
+        jax.nn.softmax(_d(x) + _d(mask), axis=-1))
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (upper triangle masked out)."""
+    d = _d(x)
+    s = d.shape[-1]
+    mask = jnp.triu(jnp.full((s, s), -1e9, d.dtype), k=1)
+    return Tensor._from_data(jax.nn.softmax(d + mask, axis=-1))
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate LookAhead):
+    every k steps, slow weights move alpha toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._n = 0
+
+    def _params(self):
+        return [p for p in (self.inner_optimizer._parameter_list or [])
+                if not p.stop_gradient]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._n += 1
+        if self._n % self.k:
+            return
+        for p in self._params():
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._data = slow
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return {"inner": getattr(self.inner_optimizer, "state_dict",
+                                 dict)(), "n": self._n}
+
+
+class ModelAverage:
+    """Running parameter average applied at eval time (reference
+    incubate ModelAverage): accumulate each step, apply()/restore()."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum.get(id(p), 0.0) + p._data
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = {id(p): p._data for p in self._params}
+            for p in self._params:
+                if id(p) in self._sum and self._count:
+                    p._data = self._sum[id(p)] / self._count
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}

@@ -21,7 +21,7 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
-           "ChainDataset", "Subset", "random_split", "DataLoader",
+           "ChainDataset", "ComposeDataset", "SubsetRandomSampler", "Subset", "random_split", "DataLoader",
            "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
            "DistributedBatchSampler", "WeightedRandomSampler",
            "get_worker_info", "default_collate_fn"]
@@ -542,3 +542,46 @@ class DataLoader:
                     p.terminate()
             for p in workers:
                 p.join(timeout=5)
+
+
+class ComposeDataset(Dataset):
+    """Field-wise composition: sample i = concatenated fields of every
+    child dataset's sample i (reference io/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self._ds = list(datasets)
+        if not self._ds:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        lens = {len(d) for d in self._ds}
+        if len(lens) > 1:
+            raise ValueError(
+                f"lengths of datasets should be same, got {sorted(lens)}"
+                " (reference ComposeDataset contract)")
+
+    def __len__(self):
+        return len(self._ds[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self._ds:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list))
+                       else [item])
+        return tuple(out)
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+        if not self.indices:
+            raise ValueError("indices cannot be empty")
+
+    def __iter__(self):
+        order = np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)

@@ -30,6 +30,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     from paddle_tpu.nn.layer import Layer
 
     def decorate(obj):
+        if not _TO_STATIC_ENABLED:
+            return obj  # jit.enable_to_static(False): run eagerly
         if isinstance(obj, Layer):
             return TracedFunction(obj, input_spec, build_strategy)
         # plain function: jit it through a thin Layer adapter
@@ -177,3 +179,36 @@ def load(path, **config):
     if "exported" in payload:
         return TranslatedLayer(payload)
     return payload
+
+
+def enable_to_static(enable: bool = True):
+    """Global to_static toggle (reference jit.enable_to_static); when
+    disabled, to_static returns the function unwrapped."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable)
+
+
+_TO_STATIC_ENABLED = True
+_IGNORED_MODULES: list = []
+
+
+def ignore_module(modules):
+    """Modules whose functions to_static must not trace into (reference
+    jit.ignore_module). Recorded for API parity; the tracer treats all
+    non-paddle calls as host code already."""
+    _IGNORED_MODULES.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference sot debugging knob: stored; trace logs are surfaced
+    via paddle_tpu.jit.sot counters instead of source dumps."""
+    import os
+
+    os.environ["PADDLE_JIT_CODE_LEVEL"] = str(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import os
+
+    os.environ["PADDLE_JIT_VERBOSITY"] = str(level)

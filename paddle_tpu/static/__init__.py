@@ -1587,3 +1587,311 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
                            stop_gradient=False)
             for i, v in enumerate(loop_vars)]
     return outs
+
+
+# ---------------------------------------------------------------------------
+# namespace completion (reference python/paddle/static/__init__.py
+# __all__): places, program state I/O, metrics, EMA, debug print, and
+# vendor-specific stubs
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    """Reference static.cpu_places."""
+    import os
+
+    from paddle_tpu.core.place import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDAPlace maps to this build's accelerator —
+    see the top-level CUDAPlace alias)."""
+    import jax
+
+    from paddle_tpu.core.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else \
+        range(len(jax.devices()))
+    return [TPUPlace(int(i)) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError(
+        "XPU is another vendor's accelerator; this build targets "
+        "TPU/CPU (use cuda_places for the accelerator, cpu_places for "
+        "host)")
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to a device inside a program;
+    under XLA, placement is carried by shardings, so the guard is a
+    documented no-op seam."""
+    yield
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A named persistable capture (reference create_global_var)."""
+    from paddle_tpu.core.dtype import to_jax
+
+    t = Tensor(jnp.full([int(s) for s in shape], value, to_jax(dtype)),
+               name=name)
+    t.persistable = persistable
+    t.stop_gradient = True
+    default_main_program()._sym_of(t)  # register as a capture
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from paddle_tpu.compat_extra import create_parameter as _cp
+
+    p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    default_main_program()._sym_of(p)
+    return p
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print inside a compiled program (reference Print op) —
+    lowered to a host callback; returns the input unchanged."""
+    import jax
+
+    d = input._data
+
+    def host(v):
+        print(f"{message or ''} {v}", flush=True)
+
+    if isinstance(d, jax.core.Tracer):
+        jax.debug.callback(host, d)
+    else:
+        host(d)
+    return input
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr — accepted for API compatibility;
+    the weight-norm reparameterization itself belongs to
+    paddle.nn.utils.weight_norm (dynamic graph path)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference static.accuracy)."""
+    from paddle_tpu.ops.registry import API
+
+    topk = API["topk"](input, k)[1]
+    lab = label.reshape([-1, 1])
+    hit = (topk.astype("int64") == lab.astype("int64")).astype(
+        "float32").sum(axis=1)
+    return hit.mean()
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Area under the ROC curve of positive-class scores (reference
+    static.auc; returns (auc_value, ...) — here the value only)."""
+    import numpy as np
+
+    if curve != "ROC":
+        raise NotImplementedError(
+            f"auc curve={curve!r}: only ROC is implemented (returning "
+            "the ROC value for PR would be silently wrong)")
+
+    scores = np.asarray(input._data)[:, 1] if np.asarray(
+        input._data).ndim == 2 else np.asarray(input._data)
+    labels = np.asarray(label._data).reshape(-1)
+    order = np.argsort(-scores)
+    lab = labels[order]
+    pos = lab.sum()
+    neg = len(lab) - pos
+    if pos == 0 or neg == 0:
+        return Tensor(jnp.asarray(0.0))
+    tps = np.cumsum(lab)
+    fps = np.cumsum(1 - lab)
+    tpr = np.concatenate([[0], tps / pos])
+    fpr = np.concatenate([[0], fps / neg])
+    return Tensor(jnp.asarray(float(np.trapezoid(tpr, fpr))))
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference
+    static.ExponentialMovingAverage): update() after each step;
+    apply() swaps EMA weights in (a context manager), restore() swaps
+    back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema: dict = {}
+        self._backup: dict = {}
+        self._params = None
+
+    def _param_list(self, program=None):
+        if self._params is not None:
+            return self._params
+        prog = program or default_main_program()
+        return [t for t in prog.captures if not t.stop_gradient]
+
+    def update(self, program=None):
+        for p in self._param_list(program):
+            prev = self._ema.get(id(p))
+            cur = p._data
+            self._ema[id(p)] = cur if prev is None else \
+                self._decay * prev + (1.0 - self._decay) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True, program=None):
+        params = self._param_list(program)
+        self._backup = {id(p): p._data for p in params}
+        for p in params:
+            if id(p) in self._ema:
+                p._data = self._ema[id(p)]
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore(program=program)
+
+    def restore(self, executor=None, program=None):
+        for p in self._param_list(program):
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+# -- program state I/O (reference static/io.py) -----------------------------
+def _named_persistables(program):
+    out = {}
+    for i, t in enumerate(program.captures):
+        if getattr(t, "persistable", False) or not t.stop_gradient:
+            out[t.name or f"cap_{i}"] = t
+    return out
+
+
+def save(program, path_prefix, protocol=4):
+    """Save a Program's parameters/persistables (reference static.save
+    -> <prefix>.pdparams). The PROGRAM structure itself serializes via
+    save_inference_model (StableHLO)."""
+    import numpy as np
+
+    arrs = {k: np.asarray(t._data)
+            for k, t in _named_persistables(program).items()}
+    np.savez(path_prefix + ".pdparams.npz", **arrs)
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    state = load_program_state(path_prefix)
+    set_program_state(program, state)
+
+
+def load_program_state(path_prefix, var_list=None):
+    import numpy as np
+
+    f = path_prefix if path_prefix.endswith(".npz") else \
+        path_prefix + ".pdparams.npz"
+    data = np.load(f)
+    return {k: data[k] for k in data.files}
+
+
+def set_program_state(program, state_dict):
+    import numpy as np
+
+    named = _named_persistables(program)
+    for k, v in state_dict.items():
+        if k in named:
+            named[k]._data = jnp.asarray(np.asarray(v))
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    import io as _io
+
+    import numpy as np
+
+    prog = program or default_main_program()
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: np.asarray(t._data)
+                     for k, t in _named_persistables(prog).items()})
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    import io as _io
+
+    import numpy as np
+
+    loaded = np.load(_io.BytesIO(data))
+    set_program_state(program, {k: loaded[k] for k in loaded.files})
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    raise NotImplementedError(
+        "the Program's portable serialized form is StableHLO: use "
+        "static.save_inference_model / paddle.jit.save (programs here "
+        "are recorded Python+XLA structures, not ProgramDesc protos)")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "see serialize_program: load executables via "
+        "static.load_inference_model / paddle.jit.load")
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference normalize_program prunes to the feed->fetch subgraph;
+    the Executor's interpreter already evaluates only nodes needed by
+    the fetch list, so the program passes through unchanged."""
+    return program
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server CTR stack "
+        "(README 'Scope'); use static.auc / paddle.metric instead")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU is another vendor's accelerator; this build targets "
+            "TPU (XLA) — see paddle_tpu.distributed for the mesh path")
+
+
+class IpuCompiledProgram(IpuStrategy):
+    pass
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError(
+        "IPU sharding is not applicable; use dist.shard_tensor / "
+        "GSPMD meshes")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError(
+        "IPU sharding is not applicable; use dist.shard_tensor / "
+        "GSPMD meshes")
