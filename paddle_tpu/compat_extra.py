@@ -350,3 +350,247 @@ def create_parameter(shape, dtype=None, name=None, attr=None,
     ini = default_initializer or getattr(attr, "initializer", None) or (
         init.Constant(0.0) if is_bias else init.XavierUniform())
     return Parameter(ini([int(s) for s in shape], dt))
+
+
+# ---------------------------------------------------------------------------
+# linalg long tail (reference python/paddle/tensor/linalg.py). eig /
+# eigvals / ormqr run on HOST (numpy/LAPACK) — XLA has no TPU kernel for
+# general nonsymmetric eigendecomposition, same as the reference's
+# CPU-only eig kernel.
+# ---------------------------------------------------------------------------
+@_export
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given B=x and the Cholesky factor y of A."""
+    import jax.scipy.linalg as jsl
+
+    return Tensor._from_data(
+        jsl.cho_solve((_dd(y), not upper), _dd(x)))
+
+
+def _host_tensor(arr):
+    """Host-path results stay on the CPU backend: complex eigenpairs
+    have no TPU placement (complex device_put is UNIMPLEMENTED there)."""
+    arr = np.asarray(arr)
+    if arr.dtype == np.complex128:
+        arr = arr.astype(np.complex64)
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    try:
+        cpu = jax.devices("cpu")[0]
+        # device_put the NUMPY array straight to CPU — jnp.asarray first
+        # would place it on the default (TPU) device and fail for
+        # complex dtypes
+        return Tensor._from_data(jax.device_put(arr, cpu))
+    except Exception:
+        return Tensor._from_data(jnp.asarray(arr))
+
+
+@_export
+def eig(x, name=None):
+    a = np.asarray(_dd(x))
+    w, v = np.linalg.eig(a)
+    return _host_tensor(w), _host_tensor(v)
+
+
+@_export
+def eigvals(x, name=None):
+    return _host_tensor(np.linalg.eigvals(np.asarray(_dd(x))))
+
+
+@_export
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Reconstruct (P, L, U) from a packed LU factorization, batched
+    (reference lu_unpack; pivots are 1-based like LAPACK). Outputs not
+    requested via the unpack flags are returned as None."""
+    lu = _dd(lu_data)
+    m, n = lu.shape[-2], lu.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        L, U = Tensor._from_data(L), Tensor._from_data(U)
+    if unpack_pivots:
+        piv = np.asarray(_dd(lu_pivots)).astype(np.int64)
+        piv = piv.reshape(-1, piv.shape[-1])          # [batch, k]
+        n_batch = piv.shape[0]
+        Ps = np.zeros((n_batch, m, m), np.asarray(lu).dtype)
+        for b in range(n_batch):
+            perm = np.arange(m)
+            for i, pv in enumerate(piv[b][:k]):
+                j = int(pv) - 1
+                perm[[i, j]] = perm[[j, i]]
+            Ps[b][perm, np.arange(m)] = 1.0
+        P = Ps.reshape(tuple(lu.shape[:-2]) + (m, m))
+        P = Tensor._from_data(jnp.asarray(P))
+    return P, L, U
+
+
+@_export
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by the IMPLICIT full m-by-m Q of a geqrf factorization
+    (reference ormqr / LAPACK semantics). Host path: Q is materialized
+    from the householder reflectors H_i = I - tau_i v_i v_i^T."""
+    a = np.asarray(_dd(x)).astype(np.float64)
+    t = np.asarray(_dd(tau)).astype(np.float64).reshape(-1)
+    m = a.shape[0]
+    q = np.eye(m)
+    for i, ti in enumerate(t):
+        v = np.zeros(m)
+        v[i] = 1.0
+        v[i + 1:] = a[i + 1:, i]
+        q = q @ (np.eye(m) - ti * np.outer(v, v))
+    if transpose:
+        q = q.T
+    b = np.asarray(_dd(y)).astype(np.float64)
+    out = q @ b if left else b @ q
+    return Tensor._from_data(jnp.asarray(
+        out.astype(np.asarray(_dd(y)).dtype)))
+
+
+@_export
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Rank-q SVD (reference svd_lowrank; exact truncated SVD here —
+    the randomized iteration is a CPU/GPU memory optimization)."""
+    d = _dd(x)
+    if M is not None:
+        d = d - _dd(M)
+    u, s, vt = jnp.linalg.svd(d, full_matrices=False)
+    k = int(q)
+    return (Tensor._from_data(u[..., :, :k]),
+            Tensor._from_data(s[..., :k]),
+            Tensor._from_data(jnp.swapaxes(vt, -1, -2)[..., :, :k]))
+
+
+@_export
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    d = _dd(x)
+    k = int(q) if q is not None else min(6, *d.shape[-2:])
+    if center:
+        d = d - d.mean(axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(d, full_matrices=False)
+    return (Tensor._from_data(u[..., :, :k]),
+            Tensor._from_data(s[..., :k]),
+            Tensor._from_data(jnp.swapaxes(vt, -1, -2)[..., :, :k]))
+
+
+@_export
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over the last axis (reference
+    top_p_sampling): keep the smallest prefix of sorted probs whose
+    mass exceeds ps, renormalize, sample. Returns (values, ids)."""
+    from paddle_tpu.core import generator as gen
+
+    probs = _dd(x)
+    p_lim = jnp.reshape(_dd(ps), (-1, 1)).astype(probs.dtype)
+    sort_p = jnp.sort(probs, axis=-1)[..., ::-1]
+    sort_i = jnp.argsort(probs, axis=-1)[..., ::-1]
+    csum = jnp.cumsum(sort_p, axis=-1)
+    keep = csum - sort_p < p_lim  # first token always kept
+    if threshold is not None:
+        # reference: absolute-probability floor applied WITH the top-p
+        # cut (tensor/search.py top_p_sampling threshold arg)
+        thr = jnp.reshape(_dd(threshold), (-1, 1)).astype(probs.dtype)
+        keep = keep & (sort_p >= thr)
+        # keep at least the argmax token
+        keep = keep.at[..., 0].set(True)
+    masked = jnp.where(keep, sort_p, 0.0)
+    masked = masked / jnp.maximum(masked.sum(-1, keepdims=True), 1e-9)
+    key = gen.active_key() if seed is None or int(seed) < 0 else \
+        jax.random.key(int(seed))
+    g = jax.random.categorical(
+        key, jnp.log(jnp.maximum(masked, 1e-9)), axis=-1)
+    ids = jnp.take_along_axis(sort_i, g[..., None], axis=-1)
+    vals = jnp.take_along_axis(probs, ids, axis=-1)
+    # ids are int32 by the codebase's index convention (x64 disabled;
+    # the reference documents int64)
+    return Tensor._from_data(vals), Tensor._from_data(
+        ids.astype(jnp.int32))
+
+
+@_export
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty named tensor placeholder (reference create_tensor)."""
+    from paddle_tpu.core.dtype import to_jax
+
+    t = Tensor(jnp.zeros((0,), to_jax(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+@_export
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    from paddle_tpu.core import generator as gen
+
+    return _fill(x, jax.random.uniform(gen.active_key(), x._data.shape,
+                                       minval=min, maxval=max))
+
+
+@_export
+def exponential_(x, lam=1.0, name=None):
+    from paddle_tpu.core import generator as gen
+
+    return _fill(x, jax.random.exponential(
+        gen.active_key(), x._data.shape) / lam)
+
+
+for _r2 in ("uniform_", "exponential_"):
+    if not hasattr(Tensor, _r2):
+        setattr(Tensor, _r2, EXPORTS[_r2])
+
+
+# stft/istft module-level aliases (implementations live in signal)
+def _stft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, pad_mode="reflect", normalized=False,
+          onesided=True, name=None):
+    from paddle_tpu import signal
+
+    return signal.stft(x, n_fft, hop_length=hop_length,
+                       win_length=win_length, window=window,
+                       center=center, pad_mode=pad_mode,
+                       normalized=normalized, onesided=onesided)
+
+
+def _istft(x, n_fft, hop_length=None, win_length=None, window=None,
+           center=True, normalized=False, onesided=True, length=None,
+           return_complex=False, name=None):
+    from paddle_tpu import signal
+
+    return signal.istft(x, n_fft, hop_length=hop_length,
+                        win_length=win_length, window=window,
+                        center=center, normalized=normalized,
+                        onesided=onesided, length=length,
+                        return_complex=return_complex)
+
+
+EXPORTS["stft"] = _stft
+EXPORTS["istft"] = _istft
+
+
+# ---------------------------------------------------------------------------
+# Tensor method binding parity: every name in the reference's
+# tensor_method_func table becomes a Tensor method (the reference
+# monkey-patches module functions the same way)
+# ---------------------------------------------------------------------------
+def _bind_tensor_methods():
+    import paddle_tpu as _p
+
+    names = ["add_n", "atleast_1d", "atleast_2d", "atleast_3d",
+             "broadcast_shape", "broadcast_tensors", "bucketize",
+             "cdist", "cholesky_solve", "concat", "create_parameter",
+             "create_tensor", "eig", "eigvals", "exponential_",
+             "floor_mod", "histogramdd", "increment", "is_tensor",
+             "istft", "lu_unpack", "mm", "multi_dot", "multiplex",
+             "ormqr", "pca_lowrank", "polar", "rank", "reduce_as",
+             "scatter_nd", "slice", "stack", "stft", "svd_lowrank",
+             "tensordot", "top_p_sampling", "unfold", "uniform_",
+             "vander", "view", "view_as", "where_"]
+    for nm in names:
+        fn = EXPORTS.get(nm) or _API.get(nm) or getattr(_p, nm, None)
+        if fn is not None and not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)
+
+
+_bind_tensor_methods()

@@ -232,3 +232,82 @@ def test_autograd_saved_tensors_hooks_raises():
     with pytest.raises(NotImplementedError):
         with autograd.saved_tensors_hooks(lambda x: x, lambda x: x):
             pass
+
+
+def test_linalg_tail_and_sampling():
+    import scipy.linalg as sla
+
+    rng = np.random.default_rng(0)
+    # cholesky_solve
+    A = rng.standard_normal((3, 3)); A = A @ A.T + 3 * np.eye(3)
+    L = np.linalg.cholesky(A).astype("float32")
+    b = rng.standard_normal((3, 2)).astype("float32")
+    got = paddle.cholesky_solve(paddle.to_tensor(b),
+                                paddle.to_tensor(L)).numpy()
+    np.testing.assert_allclose(got, np.linalg.solve(A, b), rtol=1e-3,
+                               atol=1e-4)
+    # eig on host (complex results live on the CPU backend)
+    M = rng.standard_normal((4, 4)).astype("float32")
+    w, v = paddle.eig(paddle.to_tensor(M))
+    np.testing.assert_allclose(np.sort(w.numpy().real),
+                               np.sort(np.linalg.eigvals(M).real),
+                               rtol=1e-4)
+    # batched lu_unpack reconstructs each batch
+    Ms = rng.standard_normal((2, 3, 3))
+    lus, pivs = zip(*[sla.lu_factor(Ms[i]) for i in range(2)])
+    lu = np.stack(lus).astype("float32")
+    piv = np.stack([(p + 1).astype("int32") for p in pivs])
+    P, Lm, U = paddle.lu_unpack(paddle.to_tensor(lu),
+                                paddle.to_tensor(piv))
+    for i in range(2):
+        np.testing.assert_allclose(
+            P.numpy()[i] @ Lm.numpy()[i] @ U.numpy()[i], Ms[i],
+            rtol=1e-3, atol=1e-4)
+    Pn, Ln, Un = paddle.lu_unpack(paddle.to_tensor(lu),
+                                  paddle.to_tensor(piv),
+                                  unpack_ludata=False)
+    assert Ln is None and Un is None and Pn is not None
+    # ormqr applies the FULL implicit Q (tall factor + transpose)
+    A2 = rng.standard_normal((4, 2))
+    qr, tau = sla.lapack.dgeqrf(A2.copy())[:2]
+    B = rng.standard_normal((4, 3)).astype("float64")
+    Q = np.eye(4)
+    for i, ti in enumerate(tau):
+        vv = np.zeros(4)
+        vv[i] = 1.0
+        vv[i + 1:] = qr[i + 1:, i]
+        Q = Q @ (np.eye(4) - ti * np.outer(vv, vv))
+    got = paddle.ormqr(paddle.to_tensor(qr), paddle.to_tensor(tau),
+                       paddle.to_tensor(B)).numpy()
+    np.testing.assert_allclose(got, Q @ B, rtol=1e-5, atol=1e-6)
+    # svd_lowrank singular values
+    X = rng.standard_normal((6, 4)).astype("float32")
+    _, S, _ = paddle.svd_lowrank(paddle.to_tensor(X), q=3)
+    np.testing.assert_allclose(S.numpy(),
+                               np.linalg.svd(X, compute_uv=False)[:3],
+                               rtol=1e-4)
+    # top_p: threshold floors tokens; seed reproduces
+    probs = paddle.to_tensor(
+        np.asarray([[0.5, 0.3, 0.15, 0.05]], "float32"))
+    seen = set()
+    for _ in range(30):
+        _, i = paddle.top_p_sampling(
+            probs, paddle.to_tensor(np.asarray([0.99], "float32")),
+            threshold=paddle.to_tensor(np.asarray([0.2], "float32")))
+        seen.add(int(i.numpy()[0, 0]))
+    assert seen <= {0, 1}
+    i1 = paddle.top_p_sampling(
+        probs, paddle.to_tensor(np.asarray([0.9], "float32")),
+        seed=5)[1].numpy()
+    i2 = paddle.top_p_sampling(
+        probs, paddle.to_tensor(np.asarray([0.9], "float32")),
+        seed=5)[1].numpy()
+    assert (i1 == i2).all()
+    # in-place random fills + method binding
+    x = paddle.zeros([64])
+    x.uniform_(0.0, 1.0)
+    assert 0.0 <= x.numpy().min() and x.numpy().max() <= 1.0
+    x.exponential_(2.0)
+    assert (x.numpy() >= 0).all()
+    m2 = paddle.to_tensor(np.eye(2, dtype="float32"))
+    np.testing.assert_allclose(m2.mm(m2).numpy(), np.eye(2))
