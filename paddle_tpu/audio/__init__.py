@@ -19,3 +19,97 @@ __all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
 
 from paddle_tpu.audio import features  # noqa: F401,E402
+
+
+# ---------------------------------------------------------------------------
+# audio I/O (reference python/paddle/audio/__init__.py: load/save/info
+# over the wave backend) — WAV via the stdlib, no external deps
+# ---------------------------------------------------------------------------
+class _AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_frames={self.num_frames}, "
+                f"num_channels={self.num_channels})")
+
+
+def backends():
+    """Available audio I/O backends (reference audio.backends.
+    list_available_backends role)."""
+    return ["wave"]
+
+
+def info(filepath):
+    """WAV metadata (reference audio.info)."""
+    import wave as _wave
+
+    with _wave.open(filepath, "rb") as f:
+        return _AudioInfo(f.getframerate(), f.getnframes(),
+                          f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a WAV file -> (waveform Tensor [C, T], sample_rate)
+    (reference audio.load)."""
+    import wave as _wave
+
+    import numpy as _np
+
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        take = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(take)
+    if width == 3:  # 24-bit PCM: expand to int32
+        b = _np.frombuffer(raw, dtype=_np.uint8).reshape(-1, 3)
+        arr = ((b[:, 0].astype(_np.int32))
+               | (b[:, 1].astype(_np.int32) << 8)
+               | (b[:, 2].astype(_np.int32) << 16))
+        arr = (arr << 8) >> 8  # sign-extend
+        arr = arr.reshape(-1, ch)
+        scale = float(2 ** 23)
+    else:
+        dt = {1: _np.uint8, 2: _np.int16, 4: _np.int32}[width]
+        arr = _np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+        scale = float(2 ** (8 * width - 1))
+    if width == 1:
+        arr = arr.astype(_np.float32) / 128.0 - 1.0
+    elif normalize:
+        arr = arr.astype(_np.float32) / scale
+    out = arr.T if channels_first else arr
+    # normalize=False keeps integer PCM values (reference contract)
+    out = _np.ascontiguousarray(
+        out if (not normalize and width > 1) else
+        out.astype(_np.float32))
+    return _T(out), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Save a waveform Tensor to WAV (reference audio.save)."""
+    import wave as _wave
+
+    import numpy as _np
+
+    arr = _np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    pcm = _np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * (2 ** 15 - 1)).astype(_np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

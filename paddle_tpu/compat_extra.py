@@ -347,8 +347,10 @@ def create_parameter(shape, dtype=None, name=None, attr=None,
     from paddle_tpu.nn.layer import Parameter
 
     dt = convert_dtype(dtype) if dtype else get_default_dtype()
+    gi = getattr(init, "_GLOBAL_INITIALIZER", {})
     ini = default_initializer or getattr(attr, "initializer", None) or (
-        init.Constant(0.0) if is_bias else init.XavierUniform())
+        (gi.get("bias") or init.Constant(0.0)) if is_bias
+        else (gi.get("weight") or init.XavierUniform()))
     return Parameter(ini([int(s) for s in shape], dt))
 
 

@@ -121,3 +121,9 @@ from paddle_tpu.nn.functional.flash_attention import (  # noqa: E402
 )
 
 __all__ += ["flash_attn_unpadded"]
+
+# round-5 long-tail functionals (re-exports + new implementations)
+from paddle_tpu.nn.functional import extras as _f_extras  # noqa: E402
+
+globals().update(_f_extras.EXPORTS)
+__all__ = list(dict.fromkeys(__all__ + list(_f_extras.EXPORTS)))

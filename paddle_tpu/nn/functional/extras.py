@@ -1,0 +1,359 @@
+"""nn.functional namespace completion (reference
+python/paddle/nn/functional/__init__.py __all__): re-exports of the
+round-5 registry ops, in-place activation variants, and the remaining
+functionals (alpha_dropout, bilinear, dice/log/npair losses,
+pairwise_distance, temporal_shift, gather_tree, margin_cross_entropy,
+class_center_sample, flash qkv-packed wrappers).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import API as _API, rebind_inplace
+
+EXPORTS = {}
+
+# ---------------------------------------------------------------------------
+# direct re-exports of registry ops added this round
+# ---------------------------------------------------------------------------
+for _nm in ["adaptive_avg_pool1d", "adaptive_avg_pool3d",
+            "adaptive_max_pool1d", "adaptive_max_pool3d", "avg_pool3d",
+            "max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+            "fractional_max_pool2d", "fractional_max_pool3d",
+            "channel_shuffle", "pixel_unshuffle", "fold", "rrelu",
+            "conv1d_transpose", "conv3d_transpose", "gaussian_nll_loss",
+            "hinge_embedding_loss", "multi_label_soft_margin_loss",
+            "multi_margin_loss", "poisson_nll_loss", "soft_margin_loss",
+            "triplet_margin_loss", "hsigmoid_loss"]:
+    EXPORTS[_nm] = _API[_nm]
+
+
+def _export(fn, name=None):
+    EXPORTS[name or fn.__name__] = fn
+    return fn
+
+
+# in-place activation variants (buffer rebinding, reference relu_ etc.)
+for _base in ["relu", "elu", "tanh", "softmax", "hardtanh", "leaky_relu",
+              "thresholded_relu"]:
+    def _mk(base):
+        api = _API[base]
+
+        def fn(x, *a, **k):
+            return rebind_inplace(x, api(x, *a, **k))
+
+        fn.__name__ = base + "_"
+        return fn
+
+    EXPORTS[_base + "_"] = _mk(_base)
+
+
+def _d(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+@_export
+def log_sigmoid(x, name=None):
+    return Tensor._from_data(jax.nn.log_sigmoid(_d(x)))
+
+
+@_export
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    l, r, t, b = (int(v) for v in p)
+    if data_format == "NHWC":
+        pads = ((0, 0), (t, b), (l, r), (0, 0))
+    else:
+        pads = ((0, 0), (0, 0), (t, b), (l, r))
+    return Tensor._from_data(jnp.pad(_d(x), pads))
+
+
+@_export
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-consistent dropout (reference alpha_dropout): dropped units
+    take -alpha' and an affine correction keeps mean/variance."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor._from_data(_d(x))
+    from paddle_tpu.core import generator as gen
+
+    d = _d(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    neg_sat = -alpha * scale
+    keep = jax.random.bernoulli(gen.active_key(), 1.0 - p, d.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * neg_sat ** 2)) ** 0.5)
+    b = -a * p * neg_sat
+    out = a * jnp.where(keep, d, neg_sat) + b
+    return Tensor._from_data(out.astype(d.dtype))
+
+
+@_export
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    """Channel-wise dropout for NCHW (reference dropout2d): whole
+    feature maps are zeroed together."""
+    from paddle_tpu.nn import functional as F
+
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return F.dropout(x, p=p, training=training, axis=axis)
+
+
+@_export
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    from paddle_tpu.nn import functional as F
+
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return F.dropout(x, p=p, training=training, axis=axis)
+
+
+@_export
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear form out[:, k] = x1 W_k x2^T (reference bilinear over
+    the bilinear_tensor_product kernel). weight: [out, in1, in2]."""
+    out = jnp.einsum("bi,oij,bj->bo", _d(x1), _d(weight), _d(x2))
+    if bias is not None:
+        out = out + _d(bias)
+    return Tensor._from_data(out)
+
+
+@_export
+def maxout(x, groups, axis=1, name=None):
+    d = _d(x)
+    axis = axis % d.ndim
+    c = d.shape[axis]
+    shape = (d.shape[:axis] + (c // groups, groups)
+             + d.shape[axis + 1:])
+    return Tensor._from_data(jnp.max(d.reshape(shape), axis=axis + 1))
+
+
+@_export
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference dice_loss: 1 - 2|X∩Y| / (|X|+|Y|); label is int class
+    ids one-hotted against input's last dim."""
+    d = _d(input)
+    lab = jax.nn.one_hot(_d(label).reshape(d.shape[:-1]).astype(
+        jnp.int32), d.shape[-1], dtype=d.dtype)
+    reduce_dims = tuple(range(1, d.ndim))
+    inter = jnp.sum(d * lab, axis=reduce_dims)
+    union = jnp.sum(d, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    return Tensor._from_data(jnp.mean(
+        1.0 - (2.0 * inter + epsilon) / (union + epsilon)))
+
+
+@_export
+def log_loss(input, label, epsilon=1e-4, name=None):
+    d = jnp.clip(_d(input), epsilon, 1.0 - epsilon)
+    lab = _d(label)
+    return Tensor._from_data(-lab * jnp.log(d)
+                             - (1.0 - lab) * jnp.log(1.0 - d))
+
+
+@_export
+def square_error_cost(input, label, name=None):
+    return Tensor._from_data((_d(input) - _d(label)) ** 2)
+
+
+@_export
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference npair_loss: cross-entropy over anchor·positiveᵀ with
+    same-label targets + L2 on embeddings."""
+    a, p = _d(anchor), _d(positive)
+    lab = _d(labels).reshape(-1)
+    sim = a @ p.T
+    same = (lab[:, None] == lab[None, :]).astype(a.dtype)
+    tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+    logp = jax.nn.log_softmax(sim, axis=-1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=-1))
+    reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                    + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+    return Tensor._from_data(ce + reg)
+
+
+@_export
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    diff = _d(x) - _d(y) + epsilon
+    out = jnp.sum(jnp.abs(diff) ** p, axis=-1, keepdims=keepdim) \
+        ** (1.0 / p)
+    return Tensor._from_data(out)
+
+
+@_export
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Reference temporal_shift (TSM): shift 1/4 of channels one frame
+    back, 1/4 one frame forward within each segment."""
+    d = _d(x)
+    if data_format == "NHWC":
+        d = jnp.transpose(d, (0, 3, 1, 2))
+    nt, c, h, w = d.shape
+    n = nt // seg_num
+    v = d.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(
+        v[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                           v[:, :-1, fold:2 * fold]], axis=1)
+    keep = v[:, :, 2 * fold:]
+    out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return Tensor._from_data(out)
+
+
+@_export
+def gather_tree(ids, parents):
+    """Backtrack beam-search ancestry (reference gather_tree op):
+    ids/parents [T, B, K] -> full sequences per final beam."""
+    idv = np.asarray(_d(ids))
+    par = np.asarray(_d(parents))
+    T, B, K = idv.shape
+    out = np.zeros_like(idv)
+    cur = np.tile(np.arange(K), (B, 1))
+    rows = np.arange(B)[:, None]
+    for t in range(T - 1, -1, -1):
+        out[t] = idv[t][rows, cur]
+        cur = par[t][rows, cur]
+    return Tensor._from_data(jnp.asarray(out))
+
+
+@_export
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + positives (reference
+    class_center_sample for PartialFC): returns (remapped_label,
+    sampled_class_indices)."""
+    lab = np.asarray(_d(label)).reshape(-1).astype(np.int64)
+    pos = np.unique(lab)
+    n_extra = max(0, int(num_samples) - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    if n_extra > 0 and len(rest) > 0:
+        extra = np.random.default_rng().choice(
+            rest, min(n_extra, len(rest)), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    else:
+        sampled = pos
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_lab = np.asarray([remap[int(v)] for v in lab], np.int64)
+    return (Tensor._from_data(jnp.asarray(new_lab.astype(np.int32))),
+            Tensor._from_data(jnp.asarray(sampled.astype(np.int32))))
+
+
+@_export
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference margin_cross_entropy):
+    cos(m1*theta + m2) - m3 on the target logit, then scaled CE."""
+    d = _d(logits)
+    lab = _d(label).reshape(-1).astype(jnp.int32)
+    n, c = d.shape
+    theta = jnp.arccos(jnp.clip(d, -1.0 + 1e-7, 1.0 - 1e-7))
+    target_cos = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(lab, c, dtype=d.dtype)
+    adjusted = jnp.where(onehot > 0, target_cos, d) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+    if reduction == "mean":
+        loss_t = Tensor._from_data(jnp.mean(loss))
+    elif reduction == "sum":
+        loss_t = Tensor._from_data(jnp.sum(loss))
+    else:
+        loss_t = Tensor._from_data(loss[:, None])
+    if return_softmax:
+        return loss_t, Tensor._from_data(jax.nn.softmax(adjusted, -1))
+    return loss_t
+
+
+@_export
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, **kwargs):
+    raise NotImplementedError(
+        "sparse_attention is a GPU-only CUDA kernel in the reference; "
+        "the TPU serving/attention paths are flash_attention (Pallas), "
+        "incubate block_multihead_attention (paged), and "
+        "paddle.sparse softmax/masked_matmul for explicit CSR patterns")
+
+
+# flash qkv-packed wrappers over the existing flash attention
+@_export
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """qkv: [B, S, 3, H, D] packed (reference flash_attn_qkvpacked)."""
+    from paddle_tpu.nn import functional as F
+
+    d = _d(qkv)
+    q, k, v = d[:, :, 0], d[:, :, 1], d[:, :, 2]
+    out = F.flash_attention(Tensor._from_data(q), Tensor._from_data(k),
+                            Tensor._from_data(v), dropout=dropout,
+                            causal=causal, training=training)
+    return out
+
+
+@_export
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """qkv: [total, 3, H, D] varlen-packed."""
+    from paddle_tpu.nn.functional.flash_attention import (
+        flash_attn_unpadded,
+    )
+
+    d = _d(qkv)
+    q, k, v = d[:, 0], d[:, 1], d[:, 2]
+    import math
+
+    sc = scale if scale is not None else 1.0 / math.sqrt(d.shape[-1])
+    return flash_attn_unpadded(Tensor._from_data(q), Tensor._from_data(k),
+                               Tensor._from_data(v), cu_seqlens_q,
+                               cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                               scale=sc, causal=causal)
+
+
+@_export
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0,
+                                     dropout_p=0.0, is_causal=True,
+                                     training=True, name=None):
+    """Reference flash_attention_with_sparse_mask (row-sparse causal
+    masks): lowered to dense attention with the expanded mask — XLA
+    fuses it; genuinely sparse patterns should use the Pallas path."""
+    from paddle_tpu.nn import functional as F
+
+    if attn_mask_start_row_indices is None:
+        return F.scaled_dot_product_attention(
+            query, key, value, dropout_p=dropout_p, is_causal=is_causal,
+            training=training)
+    q = _d(query)
+    B, S = q.shape[0], q.shape[1]
+    # start[b, h, j]: first ROW from which attention to column j is
+    # masked (the reference's row-sparse causal encoding)
+    start = _d(attn_mask_start_row_indices).reshape(B, -1, S)
+    rows = jnp.arange(S)[None, None, :, None]
+    cols = jnp.arange(S)[None, None, None, :]
+    allow = (cols <= rows) & (rows < start[..., None, :])
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, q.dtype)
+    mask = jnp.where(allow, 0.0, neg)
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=Tensor._from_data(mask),
+        dropout_p=dropout_p, is_causal=False, training=training)
+
+
+@_export
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference triplet_margin_with_distance_loss — delegates to the
+    layer class's logic (custom distance fn honored)."""
+    from paddle_tpu.nn.layers_extra import TripletMarginWithDistanceLoss
+
+    layer = TripletMarginWithDistanceLoss(
+        distance_function=distance_function, margin=margin, swap=swap,
+        reduction=reduction)
+    return layer(input, positive, negative)

@@ -10,6 +10,7 @@ from paddle_tpu.core import generator as gen
 from paddle_tpu.core.dtype import to_jax
 
 __all__ = [
+    "Bilinear", "set_global_initializer",
     "Constant", "Normal", "TruncatedNormal", "Uniform", "XavierNormal",
     "XavierUniform", "KaimingNormal", "KaimingUniform", "Assign", "Dirac",
     "Orthogonal", "calculate_gain",
@@ -199,3 +200,41 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4.0
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed convs
+    (reference nn/initializer/Bilinear): upsampling layers start as
+    exact bilinear interpolators."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        from paddle_tpu.core.dtype import to_jax
+
+        shape = [int(s) for s in shape]
+        if len(shape) < 3:
+            raise ValueError("Bilinear init needs a conv kernel shape")
+        k = shape[-1]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        grid = (1 - np.abs(np.arange(k) / f - c))
+        kern2d = np.outer(grid, grid) if len(shape) >= 4 else grid
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i] = kern2d
+        return jnp.asarray(w, to_jax(dtype))
+
+
+_GLOBAL_INITIALIZER = {}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: the defaults create_parameter
+    falls back to when no attr/initializer is given. Pass None to
+    reset."""
+    _GLOBAL_INITIALIZER.clear()  # every call replaces BOTH defaults
+    if weight_init is not None:
+        _GLOBAL_INITIALIZER["weight"] = weight_init
+        if bias_init is not None:
+            _GLOBAL_INITIALIZER["bias"] = bias_init

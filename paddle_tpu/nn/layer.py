@@ -88,8 +88,10 @@ class Layer:
         if default_initializer is None and attr is not None:
             default_initializer = getattr(attr, "initializer", None)
         if default_initializer is None:
+            gi = getattr(init, "_GLOBAL_INITIALIZER", {})
             default_initializer = (
-                init.Constant(0.0) if is_bias else init.XavierUniform())
+                gi.get("bias") or init.Constant(0.0)) if is_bias else (
+                gi.get("weight") or init.XavierUniform())
         data = default_initializer(shape, dtype)
         p = Parameter(data)
         if attr is not None:

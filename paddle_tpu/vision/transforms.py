@@ -501,3 +501,187 @@ __all__ += ["BrightnessTransform", "ContrastTransform",
             "SaturationTransform", "HueTransform", "ColorJitter",
             "Grayscale", "RandomVerticalFlip", "RandomRotation",
             "RandomAffine", "RandomPerspective", "RandomErasing"]
+
+
+# ---------------------------------------------------------------------------
+# functional API + BaseTransform (reference
+# python/paddle/vision/transforms/functional.py and transforms.py
+# BaseTransform) — each functional reuses the class implementations'
+# helpers so the two surfaces cannot diverge.
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """Reference BaseTransform: subclasses implement _apply_image (and
+    optionally _apply_* for other keys); __call__ routes per key."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn else data)
+        return tuple(outs)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """PIL/ndarray -> float tensor in [0, 1] (reference F.to_tensor)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    raw = np.asarray(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:
+        arr = arr / 255.0  # dtype-keyed, like the reference (a dark
+        # uint8 image must scale the same as a bright one)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    if data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    if chw:
+        return arr[:, top:top + height, left:left + width]
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[..., ::-1].copy() if chw else arr[:, ::-1].copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[:, ::-1].copy() if chw else arr[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    x, fmt = _as_chw(np.asarray(img))
+    return _restore(np.clip(x * brightness_factor, 0.0, 1.0), fmt)
+
+
+def adjust_contrast(img, contrast_factor):
+    x, fmt = _as_chw(np.asarray(img))
+    mean = x.mean()
+    return _restore(np.clip(mean + contrast_factor * (x - mean),
+                            0.0, 1.0), fmt)
+
+
+def adjust_hue(img, hue_factor):
+    x, fmt = _as_chw(np.asarray(img))
+    hsv = _rgb_to_hsv(x)
+    hsv[0] = (hsv[0] + hue_factor) % 1.0
+    return _restore(np.clip(_hsv_to_rgb(hsv), 0.0, 1.0), fmt)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    x, fmt = _as_chw(np.asarray(img))
+    m = _center_affine(x.shape[1], x.shape[2], float(angle), (0, 0),
+                       1.0, (0, 0))
+    return _restore(_warp_affine(x, m, fill=fill), fmt)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    x, fmt = _as_chw(np.asarray(img))
+    sh = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
+    m = _center_affine(x.shape[1], x.shape[2], float(angle),
+                       tuple(translate), float(scale), tuple(sh))
+    return _restore(_warp_affine(x, m, fill=fill), fmt)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Reference F.perspective: warp so startpoints map to endpoints."""
+    x, fmt = _as_chw(np.asarray(img))
+    # _warp_perspective maps OUTPUT pixels back to source positions, so
+    # it needs the inverse transform: solve startpoints <- endpoints
+    mat = _perspective_coeffs(startpoints, endpoints)
+    out = _warp_perspective(x, mat, fill=fill)
+    return _restore(out, fmt)
+
+
+def _perspective_coeffs(src, dst):
+    a = []
+    b = []
+    for (sx, sy), (dx, dy) in zip(src, dst):
+        a.append([dx, dy, 1, 0, 0, 0, -sx * dx, -sx * dy])
+        a.append([0, 0, 0, dx, dy, 1, -sy * dx, -sy * dy])
+        b.extend([sx, sy])
+    coef = np.linalg.solve(np.asarray(a, np.float64),
+                           np.asarray(b, np.float64))
+    return np.append(coef, 1.0).reshape(3, 3)
+
+
+def _warp_perspective(img, mat, fill=0.0):
+    c, h, w = img.shape
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones]).reshape(3, -1).astype(np.float64)
+    src = mat @ pts
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    sxc = np.clip(np.round(sx), 0, w - 1).astype(np.int64)
+    syc = np.clip(np.round(sy), 0, h - 1).astype(np.int64)
+    out = img[:, syc, sxc]
+    out = np.where(valid[None], out, fill)
+    return out.reshape(c, h, w)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Zero/fill a region (reference F.erase); v is the fill value."""
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    is_tensor = isinstance(img, _T)
+    arr = np.array(img.numpy() if is_tensor else img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    if chw:
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return _T(arr) if is_tensor else arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+__all__ += ["BaseTransform", "to_tensor", "resize", "crop",
+            "center_crop", "hflip", "vflip", "pad",
+            "adjust_brightness", "adjust_contrast", "adjust_hue",
+            "rotate", "affine", "perspective", "to_grayscale", "erase",
+            "normalize"]
