@@ -153,21 +153,12 @@ def bench_gpt_1b(batch=4, seq=2048):
         cfg.hidden_size * seq
     mfu = profiler.estimate_mfu(flops_per_token * batch * seq, 1.0 / sps)
     # per-phase device breakdown (xplane; VERDICT r4 #9) — compute vs
-    # collective vs copy fractions of the measured step
-    phases = {}
+    # collective vs copy fractions of the measured step, via the public
+    # profiler API (the copy_frac the donated-buffer + prefetch work
+    # tracks round over round)
     try:
-        import tempfile
-
-        prof = profiler.Profiler(
-            targets=[profiler.ProfilerTarget.CPU,
-                     profiler.ProfilerTarget.TPU],
-            trace_dir=tempfile.mkdtemp())
-        prof.start()
-        for _ in range(3):
-            loss = step(X, Y)
-        float(loss._data)
-        prof.stop()
-        phases = prof.phase_summary(print_table=False)
+        phases = profiler.device_phases(lambda: step(X, Y), steps=3,
+                                        warmup=0)  # already warm
     except Exception:
         phases = {}
     paddle.set_default_dtype("float32")
@@ -177,9 +168,66 @@ def bench_gpt_1b(batch=4, seq=2048):
 def bench_resnet50_single(batch=64):
     """HONEST single-step eager-dispatch number (no run_steps k-step
     amortization) — reported alongside the k=32 number so no quoted
-    figure relies on an unstated measurement trick (VERDICT r4 #10)."""
+    figure relies on an unstated measurement trick (VERDICT r4 #10).
+    Also returns the phase breakdown of the same config (ResNet-50
+    previously reported no copy-fraction at all)."""
+    from paddle_tpu import profiler
+
     step, X, Y = _resnet50_setup(batch)
-    return _timed_steps(lambda: step(X, Y), steps=20, windows=3) * batch
+    img_s = _timed_steps(lambda: step(X, Y), steps=20, windows=3) * batch
+    try:
+        phases = profiler.device_phases(lambda: step(X, Y), steps=3,
+                                        warmup=0)
+    except Exception:
+        phases = {}
+    return img_s, phases
+
+
+def bench_input_pipeline(batch=64, n_batches=16):
+    """The loader regime the resident-X/Y numbers above exclude: a fresh
+    host batch EVERY step. naive = to_tensor at use time (transfer
+    serialized into the step); prefetched = io.prefetch_to_device
+    (depth-2 double buffer, per-dtype coalesced staging, background
+    thread) overlapping transfer with the previous step's compute.
+    Reports images/sec for both and the overlap speedup."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import prefetch_to_device
+
+    step, X, Y = _resnet50_setup(batch)
+    rng = np.random.RandomState(1)
+    data = [(rng.randn(batch, 3, 32, 32).astype(np.float32),
+             rng.randint(0, 10, (batch,)).astype(np.int64))
+            for _ in range(n_batches)]
+    float(step(X, Y)._data)  # compile outside every timed window
+
+    def run_naive():
+        loss = None
+        for xb, yb in data:
+            loss = step(paddle.to_tensor(xb), paddle.to_tensor(yb))
+        float(loss._data)
+
+    def run_prefetched():
+        loss = None
+        for xb, yb in prefetch_to_device(data, depth=2):
+            loss = step(xb, yb)
+        float(loss._data)
+
+    best = {}
+    for name, fn in (("naive", run_naive), ("prefetched", run_prefetched)):
+        fn()  # warm (first prefetched pass also compiles the unpack)
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            fn()
+            dt = min(dt, time.perf_counter() - t0)
+        best[name] = batch * n_batches / dt
+    return {
+        "naive_images_per_sec": round(best["naive"], 1),
+        "prefetched_images_per_sec": round(best["prefetched"], 1),
+        "overlap_speedup": round(best["prefetched"] / best["naive"], 3),
+    }
 
 
 def _pp_schedules_worker():
@@ -336,7 +384,11 @@ def main():
     backend = jax.default_backend()
     tok_1b, mfu, n_params, phases_1b = bench_gpt_1b()
     img_s = bench_resnet50()
-    img_s_single = bench_resnet50_single()
+    img_s_single, phases_r50 = bench_resnet50_single()
+    try:
+        input_pipe = bench_input_pipeline()
+    except Exception as e:
+        input_pipe = {"error": str(e)[:200]}
     tok_small, mfu_small = bench_gpt_small()
     pp_sched = bench_pp_schedules()
     prev = _load_prev()
@@ -356,6 +408,15 @@ def main():
             "gpt_1b_config": "h2048 L16 a16 v32000 seq2048 batch4 bf16 "
                              "flash-attn adamw",
             "gpt_1b_device_phases": phases_1b,
+            "resnet50_device_phases": phases_r50,
+            # copy_frac as a first-class trend metric across BENCH_r*:
+            # r05 measured 0.545 on the 1B GPT — the number the donated
+            # train-step buffers + device prefetcher exist to crush
+            "copy_frac": {
+                "gpt_1b": phases_1b.get("copy_frac"),
+                "resnet50": phases_r50.get("copy_frac"),
+            },
+            "input_pipeline": input_pipe,
             "mfu_gate": MFU_GATE,
             # k=32 steps/dispatch (run_steps) AND the honest single-step
             # number — both reported so no figure hides its methodology

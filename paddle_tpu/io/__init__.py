@@ -8,7 +8,10 @@ num_workers > 0 forks worker processes that fetch + collate to numpy and
 ship batches through an mp queue with a deterministic reorder buffer
 (reference dataloader/worker.py); thread-prefetch additionally overlaps
 host batching with device compute since device work releases the GIL
-inside XLA.
+inside XLA. ``use_device_prefetch=True`` goes one stage further: the
+whole pipeline stays numpy until ``io.prefetch.DevicePrefetcher`` ships
+each batch to the device ``depth`` steps ahead as one coalesced
+transfer per dtype (see that module for the copy-fraction story).
 """
 from __future__ import annotations
 
@@ -24,7 +27,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
            "ChainDataset", "ComposeDataset", "SubsetRandomSampler", "Subset", "random_split", "DataLoader",
            "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
            "DistributedBatchSampler", "WeightedRandomSampler",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn",
+           "DevicePrefetcher", "prefetch_to_device"]
 
 
 class Dataset:
@@ -264,13 +268,17 @@ def _collate_np(batch):
     return np.stack([np.asarray(s) for s in batch])
 
 
-def _tree_to_numpy(x):
+def _tree_to_host(x):
+    """Tree -> host numpy, dtype-preserving: Tensor.numpy() widens bf16
+    to f32, which would silently change the batch dtype (and force a
+    train-step retrace) on the device-prefetch path; np.asarray of the
+    jax array keeps bf16 via ml_dtypes."""
     if isinstance(x, Tensor):
-        return np.asarray(x.numpy())
+        return np.asarray(x._data)
     if isinstance(x, (list, tuple)):
-        return type(x)(_tree_to_numpy(v) for v in x)
+        return type(x)(_tree_to_host(v) for v in x)
     if isinstance(x, dict):
-        return {k: _tree_to_numpy(v) for k, v in x.items()}
+        return {k: _tree_to_host(v) for k, v in x.items()}
     return x
 
 
@@ -294,7 +302,7 @@ def _worker_loop(wid, nw, dataset, indexed_batches, batch_size, drop_last,
         if worker_init_fn is not None:
             worker_init_fn(wid)
         collate = _collate_np if collate_fn is default_collate_fn \
-            else (lambda b: _tree_to_numpy(collate_fn(b)))
+            else (lambda b: _tree_to_host(collate_fn(b)))
         if indexed_batches is None:
             # iterable dataset: this worker consumes its own iterator
             batch = []
@@ -351,13 +359,22 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_device_prefetch=False,
+                 device_prefetch_depth=2, prefetch_mesh=None,
+                 prefetch_placements=None):
+        if prefetch_factor < 1:
+            raise ValueError(
+                f"prefetch_factor must be >= 1, got {prefetch_factor}")
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
         self.use_shared_memory = use_shared_memory
+        self.use_device_prefetch = use_device_prefetch
+        self.device_prefetch_depth = device_prefetch_depth
+        self.prefetch_mesh = prefetch_mesh
+        self.prefetch_placements = prefetch_placements
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -376,29 +393,67 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
-    def _raw_iter(self):
+    def _raw_iter(self, collate=None):
+        collate = collate or self.collate_fn
         if self._iterable_mode:
             batch = []
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    yield collate(batch)
                     batch = []
             if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+                yield collate(batch)
         else:
             for idx_batch in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in idx_batch])
+                yield collate([self.dataset[i] for i in idx_batch])
+
+    def _numpy_batches(self):
+        """In-process batches as host numpy trees — the device-prefetch
+        source (the num_workers > 0 source is _multiprocess_iter with
+        to_tensor=False, started from the consuming thread in __iter__).
+        Keeping the pipeline in numpy until DevicePrefetcher's one
+        coalesced transfer avoids the collate path's per-array
+        device_put."""
+        if self.collate_fn is default_collate_fn:
+            # unlike the worker-process path, in-process samples MAY be
+            # device Tensors — fetch them to host before packing
+            collate = lambda b: _collate_np(  # noqa: E731
+                [_tree_to_host(s) for s in b])
+        else:
+            collate = lambda b: _tree_to_host(self.collate_fn(b))  # noqa: E731
+        yield from self._raw_iter(collate)
 
     def __iter__(self):
+        if self.use_device_prefetch:
+            if self.num_workers > 0:
+                # fork the worker processes from the CONSUMING thread,
+                # not the prefetch producer thread: forking while
+                # another thread sits inside an XLA dispatch (the
+                # steady-state overlap the prefetcher creates) can
+                # leave the child holding dead locks
+                end = object()
+                src = self._multiprocess_iter(to_tensor=False)
+                first = next(src, end)
+                batches = (itertools.chain([first], src)
+                           if first is not end else iter(()))
+            else:
+                batches = self._numpy_batches()
+            yield from DevicePrefetcher(
+                batches, depth=self.device_prefetch_depth,
+                mesh=self.prefetch_mesh,
+                placements=self.prefetch_placements)
+            return
         if self.num_workers > 0:
             yield from self._multiprocess_iter()
             return
         if not self.use_buffer_reader:
             yield from self._raw_iter()
             return
-        # background prefetch thread (buffered-reader role)
-        q: "queue.Queue" = queue.Queue(maxsize=max(2, self.prefetch_factor))
+        # background prefetch thread (buffered-reader role); capacity is
+        # per-worker depth (reference prefetch_factor semantics) — this
+        # path always has exactly one in-process producer
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
         err = []
 
@@ -422,15 +477,18 @@ class DataLoader:
             raise err[0]
 
     # -- multiprocess workers (reference dataloader/worker.py) ------------
-    def _multiprocess_iter(self):
+    def _multiprocess_iter(self, to_tensor=True):
         """num_workers > 0: forked worker processes fetch + collate
         batches to NUMPY (workers must not touch the accelerator
         runtime); the main process reorders results by batch index so
-        iteration order is deterministic, then materializes Tensors.
+        iteration order is deterministic, then materializes Tensors
+        (``to_tensor=False`` keeps numpy — the device-prefetch source).
         Reference: dataloader_iter.py _DataLoaderIterMultiProcess +
         worker.py (the C++ LoDTensorBlockingQueue role is played by the
         mp.SimpleQueue + reorder buffer)."""
         import multiprocessing as mp
+
+        materialize = _tree_to_tensor if to_tensor else (lambda x: x)
 
         ctx = mp.get_context("fork")
         dataset = self.dataset
@@ -462,7 +520,10 @@ class DataLoader:
             except Exception:
                 result_q = None
         if result_q is None:
-            result_q = ctx.Queue()
+            # per-worker prefetch depth (reference prefetch_factor
+            # semantics): a full queue backpressures the workers
+            result_q = ctx.Queue(
+                maxsize=self.prefetch_factor * max(1, nw))
         workers = []
 
         def _get():
@@ -511,13 +572,13 @@ class DataLoader:
                     if kind == "end":
                         done += 1
                         continue
-                    yield _tree_to_tensor(payload[1])
+                    yield materialize(payload[1])
             else:
                 pending = {}
                 nxt = 0
                 while nxt < n_batches:
                     if nxt in pending:
-                        yield _tree_to_tensor(pending.pop(nxt))
+                        yield materialize(pending.pop(nxt))
                         nxt += 1
                         continue
                     kind, payload = _get()
@@ -585,3 +646,8 @@ class SubsetRandomSampler(Sampler):
 
     def __len__(self):
         return len(self.indices)
+
+
+from paddle_tpu.io.prefetch import (  # noqa: E402
+    DevicePrefetcher, prefetch_to_device,
+)

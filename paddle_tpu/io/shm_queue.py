@@ -83,6 +83,15 @@ def native_available() -> bool:
     return _build_lib() is not None
 
 
+def _is_ml_dtype(dt) -> bool:
+    try:
+        import ml_dtypes
+
+        return isinstance(getattr(ml_dtypes, dt.name, None), type)
+    except ImportError:
+        return False
+
+
 def _pack_tree(obj) -> bytes:
     """Encode a nested (tuple/list/dict) structure of numpy arrays as a
     header (np.save format per leaf) + raw bytes."""
@@ -93,6 +102,19 @@ def _pack_tree(obj) -> bytes:
 
 def _pack_into(obj, buf):
     if isinstance(obj, np.ndarray):
+        dt = obj.dtype
+        if dt.kind == "V" and dt.names is None and _is_ml_dtype(dt):
+            # ml_dtypes extended types (bfloat16, fp8, int4) — np.save
+            # cannot represent them (stores raw '|V2' that np.load hands
+            # back as void): ship a same-width uint view tagged with the
+            # real dtype name and restore the view on load. Genuine
+            # void dtypes stay on the plain 'A' path, which round-trips
+            # them as-is.
+            name = dt.name.encode()
+            buf.write(b"X" + struct.pack("<I", len(name)) + name)
+            np.save(buf, obj.view(np.dtype(f"uint{dt.itemsize * 8}")),
+                    allow_pickle=False)
+            return
         buf.write(b"A")
         np.save(buf, obj, allow_pickle=False)
     elif isinstance(obj, tuple):
@@ -130,6 +152,13 @@ def _unpack_from(buf):
     tag = buf.read(1)
     if tag == b"A":
         return np.load(buf, allow_pickle=False)
+    if tag == b"X":
+        n = struct.unpack("<I", buf.read(4))[0]
+        name = buf.read(n).decode()
+        import ml_dtypes
+
+        raw = np.load(buf, allow_pickle=False)
+        return raw.view(np.dtype(getattr(ml_dtypes, name)))
     if tag in (b"T", b"L"):
         n = struct.unpack("<I", buf.read(4))[0]
         items = [_unpack_from(buf) for _ in range(n)]

@@ -37,10 +37,23 @@ __all__ = ["TrainStep"]
 
 
 class TrainStep:
+    """``donate=True`` (default) hands params/optimizer slots/buffers to
+    XLA as donated inputs: the compiled step updates state in place in
+    HBM instead of allocating fresh buffers and copying — the single
+    biggest lever on the profiler's ``copy_frac`` metric. The cost is
+    that an array snapshotted BEFORE a step (e.g. ``p._data`` stashed in
+    user code) is dead after it; TrainStep itself rebinds every carried
+    reference (params, buffers, ``optimizer._slots``) after each
+    dispatch. ``donate=False`` opts out — the equality tests in
+    tests/test_train_donation.py pin the two modes to bit-identical
+    numerics."""
+
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 accumulate_steps: int = 1, sharding=None, scaler=None):
+                 accumulate_steps: int = 1, sharding=None, scaler=None,
+                 donate: bool = True):
         from paddle_tpu import amp as _amp
 
+        self._donate = bool(donate)
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
@@ -174,7 +187,7 @@ class TrainStep:
             # n_inputs is a static jit arg: calling with a different
             # n_model_inputs retraces instead of reusing a stale split
             return jax.jit(make_step_fn(outcomes), static_argnums=(0,),
-                           donate_argnums=(1, 2, 3, 4))
+                           donate_argnums=self._donate_argnums())
 
         self._make_jitted = make_jitted
         self._jitted = make_jitted(None)  # optimistic whole-graph path
@@ -194,6 +207,64 @@ class TrainStep:
         self._lr_val = None
         self._lr_arr = None
         self._wd_warm: dict = {}  # id(jitted) -> last batch shapes
+        self._dispatch_failed = False  # arms the re-dispatch guard
+
+    def _donate_argnums(self):
+        """(carry, params, slots, buffers) when donating, () otherwise.
+        Batch args, the LR and the scaler state are never donated: the
+        LR array is host-cached across steps and batches may be reused
+        (steady-state benchmarking, run_steps unstacked)."""
+        return (1, 2, 3, 4) if self._donate else ()
+
+    def _state_arrays(self):
+        """Every device array the compiled step donates (the arrays a
+        failed dispatch could have consumed)."""
+        for c in self._carry:
+            yield "carry", c
+        for p in self._params:
+            yield "param", p._data
+        for b in self._buffers:
+            yield "buffer", b._data
+        for s in self._slots:
+            for k, v in s.items():
+                yield f"slot:{k}", v
+
+    def _dead_donated_state(self):
+        if not self._donate:
+            return []
+        return sorted({kind for kind, a in self._state_arrays()
+                       if getattr(a, "is_deleted", lambda: False)()})
+
+    def _check_donated_state(self, context: str):
+        """Donation guard for retrace/guard-miss paths: a dispatch that
+        failed BEFORE execution (trace error -> SOT switch, shape
+        retrace) leaves the donated buffers alive and the step can simply
+        be re-run; a dispatch that failed AFTER consuming them cannot be
+        — fail loudly instead of letting the next eager op hit a deleted
+        PJRT buffer."""
+        dead = self._dead_donated_state()
+        if dead:
+            raise RuntimeError(
+                f"TrainStep state was donated to a dispatch that failed "
+                f"after consuming it ({context}: {dead} buffers "
+                f"deleted). The in-place update was lost; restore from "
+                f"a checkpoint, or construct the TrainStep with "
+                f"donate=False to trade copy overhead for re-runnable "
+                f"failures.")
+
+    def _warn_donated_state(self, context: str):
+        """Same detection, but on a path that must re-raise the ORIGINAL
+        failure (e.g. the nan/inf checker's FloatingPointError) — the
+        state-loss note must not mask it."""
+        dead = self._dead_donated_state()
+        if dead:
+            import warnings
+
+            warnings.warn(
+                f"TrainStep: the failed dispatch ({context}) had already "
+                f"consumed the donated state ({dead}); the step cannot "
+                f"be retried — restore from a checkpoint or use "
+                f"donate=False", RuntimeWarning, stacklevel=3)
 
     def _sync_step_carry(self):
         """If the optimizer's step counter was changed externally (e.g.
@@ -326,7 +397,7 @@ class TrainStep:
                     jnp.asarray(True)
 
             jitted = jax.jit(multi_fn, static_argnums=(0,),
-                             donate_argnums=(1, 2, 3, 4))
+                             donate_argnums=self._donate_argnums())
             self._multi_jitted[(k, stacked)] = jitted
         try:
             losses = self._run(jitted, n_inputs, datas)
@@ -348,6 +419,14 @@ class TrainStep:
 
         from paddle_tpu.distributed.watchdog import default_watchdog
 
+        if self._dispatch_failed:
+            # a previous dispatch failed; if it had consumed the donated
+            # state, a retry would hit jax's raw "Array has been
+            # deleted" — fail with the designed message instead. The
+            # flag keeps the happy path free of per-step O(params)
+            # is_deleted() sweeps.
+            self._check_donated_state("re-dispatch after a failed step")
+            self._dispatch_failed = False
         param_datas = [p._data for p in self._params]
         buffer_datas = [b._data for b in self._buffers]
         # a call that will trace+compile (first call, or new batch
@@ -366,6 +445,14 @@ class TrainStep:
         except BaseException:
             # failed dispatch must not leave an armed deadline behind
             default_watchdog().disarm(wd_id)
+            # trace-time failures (ConcretizationTypeError -> SOT switch,
+            # retrace on new shapes) never executed, so the donated state
+            # is still live and the caller may re-dispatch; an
+            # execution-time failure after donation is flagged but must
+            # not mask the original error — the next _run raises the
+            # designed guard error instead of jax's deleted-array one
+            self._dispatch_failed = True
+            self._warn_donated_state("failed dispatch")
             raise
         self._wd_warm[id(jitted)] = shapes
         attach_step(wd_id, loss)
@@ -390,6 +477,10 @@ class TrainStep:
         from paddle_tpu.autograd import engine as _engine
         from paddle_tpu.jit import sot as _sot
 
+        # guard-miss path: the discarded dispatch DONATED the old state
+        # arrays and _run rebound the re-materialized (value-identical)
+        # outputs; the eager explore must see live buffers
+        self._check_donated_state("eager explore after guard miss")
         saved_buf = [b._data for b in self._buffers]
         try:
             with _engine.no_grad(), _sot.recording() as rec:

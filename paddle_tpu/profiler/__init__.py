@@ -24,7 +24,7 @@ from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark", "estimate_mfu"]
+           "benchmark", "estimate_mfu", "device_phases"]
 
 
 class ProfilerState:
@@ -326,19 +326,10 @@ class Profiler:
         default trace dir) are ignored — without the token filter a
         CPU-only run would report a previous run's device phases as its
         own."""
-        import glob
-
-        from jax.profiler import ProfileData
-
         if self._trace_token is None:
             return None
-        files = [f for f in sorted(glob.glob(
-            os.path.join(self._trace_dir, "**", "*.xplane.pb"),
-            recursive=True))
-            if os.path.getmtime(f) >= self._trace_token - 1.0]
-        if not files:
-            return None
-        return ProfileData.from_file(files[-1])
+        return _latest_trace(self._trace_dir,
+                             min_mtime=self._trace_token - 1.0)
 
     def device_summary(self, top: int = 40, print_table: bool = True):
         """Per-op DEVICE time table from the captured xplane trace — the
@@ -350,16 +341,8 @@ class Profiler:
         if pd is None:
             return {}
         agg: Dict[str, List[float]] = {}
-        for plane in pd.planes:
-            if "TPU" not in plane.name and "GPU" not in plane.name \
-                    and "device" not in plane.name.lower():
-                continue
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for ev in line.events:
-                    agg.setdefault(ev.name, []).append(
-                        ev.duration_ns / 1e6)
+        for name, dur_ms in _iter_device_ops(pd):
+            agg.setdefault(name, []).append(dur_ms)
         rows = [(k, len(v), sum(v), sum(v) / len(v))
                 for k, v in agg.items()]
         rows.sort(key=lambda r: -r[2])
@@ -400,33 +383,208 @@ class Profiler:
         pd = self._load_trace()
         if pd is None:
             return {}
-        phases = {"compute": 0.0, "collective": 0.0, "copy": 0.0}
-        steps = 0
-        for plane in pd.planes:
-            if "TPU" not in plane.name and "GPU" not in plane.name \
-                    and "device" not in plane.name.lower():
-                continue
+        return _phases_from_trace(pd, print_table=print_table)
+
+
+# ---------------------------------------------------------------------------
+# trace loading + device-op iteration (shared by Profiler and the public
+# device_phases API)
+# ---------------------------------------------------------------------------
+def _read_xspace(path: str):
+    """One parsed trace file. Prefers jax's own reader (newer jax); falls
+    back to the dependency-free wire-format reader in profiler/xplane.py
+    (older jax has no ProfileData — the CPU CI container, for one)."""
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        from paddle_tpu.profiler.xplane import XSpace as ProfileData
+    return ProfileData.from_file(path)
+
+
+def _latest_trace(trace_dir: str, min_mtime: Optional[float] = None):
+    import glob
+
+    files = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if min_mtime is not None:
+        files = [f for f in files if os.path.getmtime(f) >= min_mtime]
+    for f in reversed(files):
+        try:
+            return _read_xspace(f)
+        except Exception:
+            # an external run may still be flushing its newest file —
+            # a truncated trace is skipped, not fatal
+            continue
+    return None
+
+
+# XLA:CPU runs ops on host threadpool lines; these events on those lines
+# are executor bookkeeping, not ops
+_CPU_INFRA_EVENTS = ("ThreadpoolListener", "ThunkExecutor",
+                     "TaskDispatcher")
+
+
+def _device_planes(pd):
+    return [p for p in pd.planes
+            if "TPU" in p.name or "GPU" in p.name
+            or "device" in p.name.lower()]
+
+
+def _iter_device_ops(pd):
+    """Yield (op_name, duration_ms) for every XLA op execution in a
+    parsed trace. TPU/GPU traces put ops on a device plane's 'XLA Ops'
+    line; XLA:CPU has no device plane — its ops run on '/host:CPU'
+    threadpool lines named 'tf_XLA*' (used only when no device plane
+    exists, so a TPU trace never double-counts host-side helpers)."""
+    device_planes = _device_planes(pd)
+    if any(line.name == "XLA Ops" for p in device_planes
+           for line in p.lines):
+        for plane in device_planes:
             for line in plane.lines:
-                if line.name == "Steps":
-                    steps = max(steps, sum(1 for _ in line.events))
                 if line.name != "XLA Ops":
                     continue
                 for ev in line.events:
-                    phases[self.classify_phase(ev.name)] += \
-                        ev.duration_ns / 1e6
-        total = sum(phases.values())
-        out = {f"{k}_ms": round(v, 3) for k, v in phases.items()}
-        out["total_device_ms"] = round(total, 3)
-        out["steps_captured"] = steps
-        if total > 0:
-            for k, v in phases.items():
-                out[f"{k}_frac"] = round(v / total, 4)
-        if print_table and total > 0:
-            print(f"{'Phase':<14}{'Total(ms)':>12}{'Fraction':>10}")
-            print("-" * 36)
-            for k, v in phases.items():
-                print(f"{k:<14}{v:>12.3f}{v / total:>10.3f}")
-        return out
+                    yield ev.name, ev.duration_ns / 1e6
+        return
+    for plane in pd.planes:
+        if "host:CPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if not line.name.startswith("tf_XLA"):
+                continue
+            for ev in line.events:
+                if any(t in ev.name for t in _CPU_INFRA_EVENTS):
+                    continue
+                yield ev.name, ev.duration_ns / 1e6
+
+
+def _phases_from_trace(pd, print_table: bool = False) -> dict:
+    phases = {"compute": 0.0, "collective": 0.0, "copy": 0.0}
+    counts = {"compute": 0, "collective": 0, "copy": 0}
+    for name, dur_ms in _iter_device_ops(pd):
+        ph = Profiler.classify_phase(name)
+        phases[ph] += dur_ms
+        counts[ph] += 1
+    steps = 0
+    for plane in _device_planes(pd):
+        for line in plane.lines:
+            if line.name == "Steps":
+                steps = max(steps, sum(1 for _ in line.events))
+    total = sum(phases.values())
+    out = {f"{k}_ms": round(v, 3) for k, v in phases.items()}
+    out["total_device_ms"] = round(total, 3)
+    out["steps_captured"] = steps
+    for k, c in counts.items():
+        out[f"{k}_ops"] = c
+    if total > 0:
+        for k, v in phases.items():
+            out[f"{k}_frac"] = round(v / total, 4)
+    if print_table and total > 0:
+        print(f"{'Phase':<14}{'Total(ms)':>12}{'Ops':>8}{'Fraction':>10}")
+        print("-" * 44)
+        for k, v in phases.items():
+            print(f"{k:<14}{v:>12.3f}{counts[k]:>8}{v / total:>10.3f}")
+    return out
+
+
+def _sync_tree(x):
+    """Force the device queue to drain before the trace window closes.
+    block_until_ready alone is NOT enough on the remote-tunneled PJRT
+    backend (bench.py's documented trap: it can return before the queue
+    drains, silently dropping trailing ops — including the copies this
+    API exists to measure), so after blocking, one scalar is HOST-FETCHED
+    from an array leaf."""
+    leaves = []
+
+    def walk(v):
+        if v is None:
+            return
+        if isinstance(v, (list, tuple)):
+            for u in v:
+                walk(u)
+            return
+        if isinstance(v, dict):
+            for u in v.values():
+                walk(u)
+            return
+        d = getattr(v, "_data", v)  # Tensor -> jax.Array
+        if hasattr(d, "block_until_ready"):
+            leaves.append(d)
+
+    walk(x)
+    import numpy as _np
+
+    for d in leaves:
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+    if leaves:
+        d = leaves[-1]
+        try:
+            # fetch the whole array when tiny (the usual scalar loss),
+            # else one element — either way a real host round-trip
+            _np.asarray(d if d.size <= 1024 else d.ravel()[:1])
+        except Exception:
+            pass
+
+
+def device_phases(step_fn: Optional[Callable] = None, *, steps: int = 3,
+                  warmup: int = 1, trace_dir: Optional[str] = None,
+                  print_table: bool = False) -> dict:
+    """Device-phase breakdown — compute vs collective vs copy — as a
+    first-class metric (keys: ``{phase}_ms``, ``{phase}_ops``,
+    ``{phase}_frac``, ``total_device_ms``, ``steps_captured``).
+
+    Two modes:
+
+    * ``device_phases(fn, steps=3)`` — call ``fn()`` ``warmup`` times
+      un-traced (compile outside the measured window), then ``steps``
+      times under a fresh device trace, sync the last result, and return
+      the breakdown. This is what ``bench.py`` reports per config: the
+      ``copy_frac`` it returns is the number the input-pipeline work
+      (donated train-step buffers, ``io.DevicePrefetcher``) is driving
+      down.
+    * ``device_phases(trace_dir=...)`` — parse the newest xplane trace
+      already captured under ``trace_dir`` (e.g. by an external run).
+
+    Returns ``{}`` when no device trace can be obtained (device tracing
+    unavailable on the backend)."""
+    if step_fn is None:
+        if trace_dir is None:
+            raise ValueError(
+                "device_phases needs a step_fn to profile or a trace_dir "
+                "holding an existing xplane trace")
+        pd = _latest_trace(trace_dir)
+        if pd is None:
+            return {}
+        return _phases_from_trace(pd, print_table=print_table)
+    import tempfile
+
+    out = None
+    for _ in range(max(0, warmup)):
+        out = step_fn()
+    _sync_tree(out)
+    own_dir = None
+    if trace_dir is None:
+        trace_dir = own_dir = tempfile.mkdtemp(prefix="ptpu_phases_")
+    prof = Profiler(
+        targets=[ProfilerTarget.CPU, ProfilerTarget.TPU],
+        trace_dir=trace_dir)
+    try:
+        prof.start()
+        try:
+            for _ in range(max(1, steps)):
+                out = step_fn()
+            _sync_tree(out)
+        finally:
+            prof.stop()
+        return prof.phase_summary(print_table=print_table)
+    finally:
+        if own_dir is not None:
+            import shutil
+
+            shutil.rmtree(own_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,181 @@
+"""Dependency-free XSpace (``*.xplane.pb``) reader.
+
+The device half of the profiler parses the XLA/TPU trace files that
+``jax.profiler.start_trace`` writes. Newer jax ships a reader
+(``jax.profiler.ProfileData``); older environments — including the CPU
+CI container this repo's tier-1 suite runs in — do not, and pulling in
+tensorflow/tensorboard for one proto is not acceptable for a framework
+package. The XSpace schema is tiny and stable (tensorflow/tsl
+profiler/protobuf/xplane.proto), so this module decodes the protobuf
+wire format directly:
+
+    XSpace.planes(1)       -> XPlane
+    XPlane.name(2), lines(3), event_metadata(4: map<int64, XEventMetadata>)
+    XLine.name(2)/display_name(11), events(4)
+    XEvent.metadata_id(1), duration_ps(3)
+    XEventMetadata.id(1), name(2)
+
+Only the fields the phase/op summaries need are materialized; everything
+else is skipped by wire type. The resulting objects mimic the
+``ProfileData`` traversal API (``.planes`` / ``.lines`` / ``.events``
+with ``.name`` and ``.duration_ns``) so ``Profiler`` can use either
+backend interchangeably.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["XSpace", "XPlane", "XLine", "XEvent"]
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    n = len(buf)
+    while True:
+        if i >= n:
+            raise ValueError("truncated varint (partial xplane file?)")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long (corrupt xplane file?)")
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) for one message's bytes.
+    Length-delimited values are returned as memoryview-compatible bytes;
+    varints as ints; fixed32/64 skipped as raw bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, i = _read_varint(buf, i)
+        elif wire == 2:  # length-delimited
+            ln, i = _read_varint(buf, i)
+            if i + ln > n:
+                raise ValueError(
+                    "length-delimited field overruns the buffer "
+                    "(partial xplane file?)")
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:  # fixed32
+            val = buf[i:i + 4]
+            i += 4
+        elif wire == 1:  # fixed64
+            val = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire} in xplane")
+        yield field, wire, val
+
+
+class XEvent:
+    __slots__ = ("name", "duration_ps")
+
+    def __init__(self, name: str, duration_ps: int):
+        self.name = name
+        self.duration_ps = duration_ps
+
+    @property
+    def duration_ns(self) -> float:
+        return self.duration_ps / 1e3
+
+
+class XLine:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name: str, events: List[XEvent]):
+        self.name = name
+        self.events = events
+
+
+class XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name: str, lines: List[XLine]):
+        self.name = name
+        self.lines = lines
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    mid, name = 0, ""
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == 0:
+            mid = val
+        elif field == 2 and wire == 2:
+            name = bytes(val).decode("utf-8", "replace")
+    return mid, name
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int]:
+    mid, dur = 0, 0
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == 0:
+            mid = val
+        elif field == 3 and wire == 0:
+            dur = val
+    return mid, dur
+
+
+def _parse_line(buf: bytes, emeta: Dict[int, str]) -> XLine:
+    name, display, raw_events = "", "", []
+    for field, wire, val in _iter_fields(buf):
+        if field == 2 and wire == 2:
+            name = bytes(val).decode("utf-8", "replace")
+        elif field == 11 and wire == 2:
+            display = bytes(val).decode("utf-8", "replace")
+        elif field == 4 and wire == 2:
+            raw_events.append(val)
+    events = []
+    for ev in raw_events:
+        mid, dur = _parse_event(ev)
+        events.append(XEvent(emeta.get(mid, f"#{mid}"), dur))
+    return XLine(display or name, events)
+
+
+def _parse_plane(buf: bytes) -> XPlane:
+    name, raw_lines, emeta = "", [], {}
+    for field, wire, val in _iter_fields(buf):
+        if field == 2 and wire == 2:
+            name = bytes(val).decode("utf-8", "replace")
+        elif field == 3 and wire == 2:
+            raw_lines.append(val)
+        elif field == 4 and wire == 2:
+            # map entry: key(1) = metadata id, value(2) = XEventMetadata
+            key, meta_buf = None, None
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 0:
+                    key = v2
+                elif f2 == 2 and w2 == 2:
+                    meta_buf = v2
+            if meta_buf is not None:
+                mid, mname = _parse_event_metadata(meta_buf)
+                emeta[mid or key or 0] = mname
+    return XPlane(name, [_parse_line(lb, emeta) for lb in raw_lines])
+
+
+class XSpace:
+    """Parsed trace file; ``.planes`` walks like jax's ProfileData."""
+
+    __slots__ = ("planes",)
+
+    def __init__(self, planes: List[XPlane]):
+        self.planes = planes
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "XSpace":
+        planes = []
+        for field, wire, val in _iter_fields(data):
+            if field == 1 and wire == 2:
+                planes.append(_parse_plane(val))
+        return cls(planes)
+
+    @classmethod
+    def from_file(cls, path: str) -> "XSpace":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())

@@ -1,0 +1,268 @@
+"""DevicePrefetcher: the device-resident async input pipeline.
+
+Contracts under test: batches come out in order and device-COMMITTED
+(a committed jax array takes the C++ fast dispatch path — no implicit
+transfer at use time), the producer thread's exceptions surface in the
+consumer, exhaustion terminates cleanly and the wrapper re-iterates,
+per-dtype coalescing is value-preserving across mixed trees, and
+mesh placements land batches directly in the requested NamedSharding.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import DataLoader, Dataset, DevicePrefetcher, \
+    prefetch_to_device
+
+
+def _batches(n=6, batch=4):
+    rng = np.random.default_rng(0)
+    return [
+        (np.full((batch, 3), i, np.float32),
+         rng.normal(size=(batch, 2)).astype(np.float32),
+         np.full((batch,), i, np.int64))
+        for i in range(n)
+    ]
+
+
+def test_ordering_and_values():
+    data = _batches()
+    out = list(prefetch_to_device(data, depth=2))
+    assert len(out) == len(data)
+    for i, (x, z, y) in enumerate(out):
+        assert isinstance(x, Tensor)
+        assert float(np.asarray(x._data)[0, 0]) == i
+        assert int(np.asarray(y._data)[0]) == i
+        np.testing.assert_array_equal(np.asarray(z._data), data[i][1])
+
+
+def test_yields_committed_device_arrays():
+    for x, z, y in prefetch_to_device(_batches(3), depth=2):
+        for t in (x, z, y):
+            assert t._data.committed, \
+                "prefetched array is uncommitted: use-time dispatch " \
+                "would pay an implicit transfer"
+    # int64 was canonicalized on HOST (the staging buffer is what lands
+    # on device, byte-identical)
+    assert str(y._data.dtype) == "int32"
+
+
+def test_exhaustion_and_reiteration():
+    pf = prefetch_to_device(_batches(4), depth=2)
+    assert len(list(pf)) == 4
+    assert len(list(pf)) == 4  # a list source supports a second epoch
+    assert len(pf) == 4
+
+
+def test_producer_exception_propagates():
+    def gen():
+        yield _batches(1)[0]
+        raise RuntimeError("producer exploded")
+
+    it = iter(DevicePrefetcher(gen(), depth=2))
+    next(it)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        for _ in it:
+            pass
+
+
+def test_early_break_shuts_down_producer():
+    pf = prefetch_to_device(_batches(50), depth=2)
+    for i, b in enumerate(pf):
+        if i == 2:
+            break
+    # a second full pass still works (fresh producer thread)
+    assert len(list(pf)) == 50
+
+
+def test_coalescing_matches_direct_transfer():
+    """Mixed-dtype tree goes through per-dtype packed staging; values
+    must match a plain per-leaf device_put exactly."""
+    rng = np.random.default_rng(1)
+    batch = {
+        "a": rng.normal(size=(5, 7)).astype(np.float32),
+        "b": rng.normal(size=(3,)).astype(np.float32),
+        "nested": [rng.integers(0, 9, (2, 2)).astype(np.int32),
+                   rng.integers(0, 9, (4,)).astype(np.int32)],
+        "scalar": np.float32(2.5),
+    }
+    (out,) = list(prefetch_to_device([batch], depth=1))
+    np.testing.assert_array_equal(np.asarray(out["a"]._data), batch["a"])
+    np.testing.assert_array_equal(np.asarray(out["b"]._data), batch["b"])
+    np.testing.assert_array_equal(np.asarray(out["nested"][0]._data),
+                                  batch["nested"][0])
+    np.testing.assert_array_equal(np.asarray(out["nested"][1]._data),
+                                  batch["nested"][1])
+    assert float(np.asarray(out["scalar"]._data)) == 2.5
+
+
+def test_mesh_placements():
+    from paddle_tpu.distributed.mesh import ProcessMesh, Shard
+
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    data = [(np.ones((8, 3), np.float32), np.ones((8,), np.int64))]
+    (got,) = list(prefetch_to_device(data, depth=1, mesh=mesh,
+                                     placements=[Shard(0)]))
+    x, y = got
+    assert str(x._data.sharding.spec) == "PartitionSpec('dp',)" or \
+        tuple(x._data.sharding.spec) == ("dp", None)
+    # both leaves batch-dim sharded over dp, and committed
+    assert x._data.committed and y._data.committed
+    shard_shapes = {tuple(s.data.shape) for s in x._data.addressable_shards}
+    assert shard_shapes == {(1, 3)}
+
+
+def test_mesh_partial_tail_batch_degrades_to_replicated():
+    """drop_last=False leaves a final batch whose dim is not divisible
+    by the mesh axis; it must land replicated (resharded by the compiled
+    step) instead of crashing the producer at epoch end."""
+    from paddle_tpu.distributed.mesh import ProcessMesh, Shard
+
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    data = [(np.ones((8, 3), np.float32), np.ones((8,), np.int64)),
+            (np.ones((3, 3), np.float32), np.ones((3,), np.int64))]
+    got = list(prefetch_to_device(data, depth=1, mesh=mesh,
+                                  placements=[Shard(0)]))
+    assert len(got) == 2
+    full, tail = got
+    assert tuple(full[0]._data.sharding.spec) == ("dp", None)
+    assert all(d is None for d in tail[0]._data.sharding.spec)
+    np.testing.assert_array_equal(np.asarray(tail[0]._data), data[1][0])
+
+
+def test_non_array_leaves_pass_through():
+    """String/object metadata in a batch (e.g. filenames from a custom
+    collate) must pass through untouched, as on the plain loader path —
+    not crash the producer or get coerced to device arrays."""
+    data = [(np.ones((4, 2), np.float32), ["a.jpg", "b.jpg"], 7)]
+    (got,) = list(prefetch_to_device(data, depth=1))
+    x, names, n = got
+    assert isinstance(x, Tensor) and x._data.committed
+    assert names == ["a.jpg", "b.jpg"]
+    assert n == 7 and isinstance(n, int)
+
+
+def test_mesh_replicated_leaves_still_coalesce():
+    """Shard(1) applies to the 2-D input but degrades to Replicate for
+    the 1-D label — which must still flow through the packed replicated
+    staging path, not a per-leaf transfer."""
+    from paddle_tpu.distributed.mesh import ProcessMesh, Shard
+
+    mesh = ProcessMesh(np.arange(8), ["mp"])
+    rng = np.random.default_rng(2)
+    data = [(rng.normal(size=(4, 8)).astype(np.float32),
+             rng.normal(size=(4,)).astype(np.float32),
+             rng.normal(size=(6,)).astype(np.float32))]
+    (got,) = list(prefetch_to_device(data, depth=1, mesh=mesh,
+                                     placements=[Shard(1)]))
+    x, y, z = got
+    assert tuple(x._data.sharding.spec) == (None, "mp")
+    # replicated leaves: full value on every device
+    for t, ref in ((y, data[0][1]), (z, data[0][2])):
+        assert all(d is None for d in t._data.sharding.spec)  # replicated
+        np.testing.assert_array_equal(np.asarray(t._data), ref)
+        shard_shapes = {tuple(s.data.shape)
+                        for s in t._data.addressable_shards}
+        assert shard_shapes == {ref.shape}
+
+
+def test_mesh_scalar_leaf_singleton_dtype():
+    """A rank-0 side value whose dtype no other leaf shares must not
+    crash the mesh path (the rank-1 staging sharding is invalid for
+    rank-0; the leaf's own replicated sharding applies)."""
+    from paddle_tpu.distributed.mesh import ProcessMesh, Shard
+
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    data = [{"x": np.ones((8, 3), np.float32),
+             "scale": np.int16(7)}]  # lone member of its dtype group
+    (got,) = list(prefetch_to_device(data, depth=1, mesh=mesh,
+                                     placements=[Shard(0)]))
+    assert int(np.asarray(got["scale"]._data)) == 7
+    assert got["x"]._data.committed and got["scale"]._data.committed
+
+
+class _NumpyDataset(Dataset):
+    def __init__(self, n=12):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.full((3,), i, np.float32),
+                np.asarray(i, np.int64))
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_use_device_prefetch():
+    dl = DataLoader(_NumpyDataset(), batch_size=4,
+                    use_device_prefetch=True)
+    seen = []
+    for x, y in dl:
+        assert isinstance(x, Tensor) and x._data.committed
+        assert y._data.committed
+        seen.extend(np.asarray(y._data).tolist())
+    assert seen == list(range(12))
+
+
+def test_dataloader_prefetch_custom_collate_keeps_bf16():
+    """The numpy staging path must be dtype-preserving: Tensor.numpy()
+    widens bf16 to f32, which would silently retrace the train step when
+    use_device_prefetch is flipped on under a bf16 collate."""
+    from paddle_tpu.io import default_collate_fn
+
+    def collate(batch):
+        x, y = default_collate_fn(batch)
+        return x.astype("bfloat16"), y
+
+    dl = DataLoader(_NumpyDataset(), batch_size=4, collate_fn=collate,
+                    use_device_prefetch=True)
+    x, y = next(iter(dl))
+    assert "bfloat16" in str(x.dtype)
+    assert x._data.committed
+
+
+def test_dataloader_device_prefetch_tensor_dataset():
+    """In-process datasets may yield device Tensors; the numpy staging
+    path must fetch them to host rather than trip the worker-process
+    guard."""
+    from paddle_tpu.io import TensorDataset
+
+    xs = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(8, 3))
+    ys = paddle.to_tensor(np.arange(8, dtype=np.int64))
+    dl = DataLoader(TensorDataset([xs, ys]), batch_size=4,
+                    use_device_prefetch=True)
+    got = [np.asarray(y._data) for _, y in dl]
+    np.testing.assert_array_equal(np.concatenate(got), np.arange(8))
+
+
+def test_dataloader_device_prefetch_with_workers():
+    dl = DataLoader(_NumpyDataset(), batch_size=4, num_workers=2,
+                    use_shared_memory=False, use_device_prefetch=True)
+    seen = []
+    for x, y in dl:
+        assert x._data.committed
+        seen.extend(np.asarray(y._data).tolist())
+    assert seen == list(range(12))
+
+
+def test_dataloader_prefetch_factor_queue_capacity():
+    """Reference semantics: buffered-reader queue capacity is
+    prefetch_factor * max(1, num_workers), not a flat floor of 2."""
+    import queue as _q
+    import threading
+    from unittest import mock
+
+    captured = {}
+    real_queue = _q.Queue
+
+    def spy(maxsize=0):
+        captured.setdefault("maxsize", maxsize)
+        return real_queue(maxsize=maxsize)
+
+    dl = DataLoader(_NumpyDataset(), batch_size=4, prefetch_factor=5)
+    with mock.patch("paddle_tpu.io.queue.Queue", side_effect=spy):
+        list(dl)
+    assert captured["maxsize"] == 5
+    with pytest.raises(ValueError):
+        DataLoader(_NumpyDataset(), batch_size=4, prefetch_factor=0)

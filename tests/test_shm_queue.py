@@ -115,3 +115,20 @@ def test_dead_writer_does_not_deadlock_reader():
             break
     else:
         pytest.fail("reader did not unblock after writer death")
+
+
+def test_roundtrip_ml_dtypes_bf16():
+    """np.save can't represent ml_dtypes extended floats; the transport
+    ships them as tagged uint views. A bf16 batch from a custom collate
+    must round-trip dtype- and bit-exact (the device-prefetch path
+    relies on dtype preservation end to end)."""
+    import ml_dtypes
+
+    q = ShmQueue(1 << 20)
+    arr = (np.arange(24, dtype=np.float32) / 7).astype(
+        ml_dtypes.bfloat16).reshape(4, 6)
+    q.put(("ok", 0, [arr, np.arange(4, dtype=np.int64)]))
+    _, _, payload = q.get()
+    assert payload[0].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        payload[0].view(np.uint16), arr.view(np.uint16))

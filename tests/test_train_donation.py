@@ -1,0 +1,167 @@
+"""TrainStep buffer donation correctness.
+
+Donation is a pure buffer-aliasing contract: XLA updates params/slots in
+place in HBM instead of allocating outputs and copying. It must be
+numerically INVISIBLE — these tests pin donated and non-donated runs to
+bit-identical losses and params over multiple steps, on the f32 path,
+the bf16 + f32-master-weights path, and across the SOT guard-miss /
+re-explore path (where a discarded dispatch has already consumed the
+donated buffers and TrainStep must hand the eager explore the
+re-materialized state).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _fresh(donate, seed=7, dtype="float32", multi_precision=False):
+    paddle.set_default_dtype(dtype)
+    try:
+        paddle.seed(seed)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=m.parameters(),
+                             multi_precision=multi_precision)
+        step = paddle.jit.TrainStep(m, nn.CrossEntropyLoss(), opt,
+                                    donate=donate)
+    finally:
+        paddle.set_default_dtype("float32")
+    return m, opt, step
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    X = paddle.to_tensor(rng.normal(size=(16, 8)).astype("float32"))
+    Y = paddle.to_tensor(rng.integers(0, 4, 16).astype("int64"))
+    return X, Y
+
+
+def _run(step, n=5):
+    X, Y = _batch()
+    return [np.asarray(step(X, Y)._data) for _ in range(n)]
+
+
+def _assert_states_equal(m_a, m_b, opt_a, opt_b):
+    for pa, pb in zip(m_a.parameters(), m_b.parameters()):
+        np.testing.assert_array_equal(np.asarray(pa._data),
+                                      np.asarray(pb._data))
+        sa, sb = opt_a._slots[id(pa)], opt_b._slots[id(pb)]
+        assert sa.keys() == sb.keys()
+        for k in sa:
+            np.testing.assert_array_equal(np.asarray(sa[k]),
+                                          np.asarray(sb[k]))
+
+
+def test_donated_matches_undonated_f32():
+    m_d, opt_d, step_d = _fresh(donate=True)
+    m_u, opt_u, step_u = _fresh(donate=False)
+    losses_d = _run(step_d, n=5)
+    losses_u = _run(step_u, n=5)
+    np.testing.assert_array_equal(losses_d, losses_u)
+    _assert_states_equal(m_d, m_u, opt_d, opt_u)
+
+
+def test_donated_matches_undonated_bf16_master_weights():
+    m_d, opt_d, step_d = _fresh(donate=True, dtype="bfloat16",
+                                multi_precision=True)
+    m_u, opt_u, step_u = _fresh(donate=False, dtype="bfloat16",
+                                multi_precision=True)
+    assert "bfloat16" in str(m_d.parameters()[0].dtype)
+    assert "master_weight" in opt_d._slots[id(m_d.parameters()[0])]
+    losses_d = _run(step_d, n=5)
+    losses_u = _run(step_u, n=5)
+    np.testing.assert_array_equal(losses_d, losses_u)
+    _assert_states_equal(m_d, m_u, opt_d, opt_u)
+
+
+def test_donation_consumes_old_buffers():
+    """The donated step must actually donate: the pre-step param buffer
+    is deleted after the dispatch (this is what removes the HBM copy),
+    while donate=False leaves it readable."""
+    m_d, _, step_d = _fresh(donate=True)
+    m_u, _, step_u = _fresh(donate=False)
+    X, Y = _batch()
+    old_d = [p._data for p in m_d.parameters()]
+    old_u = [p._data for p in m_u.parameters()]
+    step_d(X, Y)
+    step_u(X, Y)
+    assert all(a.is_deleted() for a in old_d), \
+        "donate=True did not consume the input buffers"
+    assert not any(a.is_deleted() for a in old_u)
+    # carried references were rebound, not left dangling
+    for p in m_d.parameters():
+        assert not p._data.is_deleted()
+        np.asarray(p._data)  # readable
+
+
+class _Gated(nn.Layer):
+    """Data-dependent Python branch: forces a graph break -> SOT
+    guard-path specialization, and a sign flip in the batch mean forces
+    a guard miss -> discarded donated dispatch -> eager re-explore ->
+    retrace of the new path."""
+
+    def __init__(self):
+        super().__init__()
+        self.pos = nn.Linear(8, 4)
+        self.neg = nn.Linear(8, 4)
+
+    def forward(self, x):
+        if x.mean() > 0:
+            return self.pos(x)
+        return self.neg(x)
+
+
+def _fresh_gated(donate, seed=11):
+    paddle.seed(seed)
+    m = _Gated()
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.CrossEntropyLoss(), opt,
+                                donate=donate)
+    return m, opt, step
+
+
+def test_donation_retrace_after_guard_miss():
+    rng = np.random.default_rng(3)
+    base = rng.normal(size=(16, 8)).astype("float32")
+    X_pos = paddle.to_tensor(np.abs(base))
+    X_neg = paddle.to_tensor(-np.abs(base))
+    Y = paddle.to_tensor(rng.integers(0, 4, 16).astype("int64"))
+    # alternate signs: every flip is a guard miss on the MRU path
+    schedule = [X_pos, X_neg, X_pos, X_neg, X_neg, X_pos]
+    m_d, opt_d, step_d = _fresh_gated(donate=True)
+    m_u, opt_u, step_u = _fresh_gated(donate=False)
+    losses_d = [np.asarray(step_d(x, Y)._data) for x in schedule]
+    losses_u = [np.asarray(step_u(x, Y)._data) for x in schedule]
+    assert step_d._sot_cache is not None and len(step_d._sot_cache) == 2
+    assert step_d._sot_cache.guard_mismatches >= 3
+    np.testing.assert_array_equal(losses_d, losses_u)
+    _assert_states_equal(m_d, m_u, opt_d, opt_u)
+    # state is live and usable after the donated guard-miss churn
+    for p in m_d.parameters():
+        assert not p._data.is_deleted()
+
+
+def test_redispatch_after_consumed_donation_fails_loudly():
+    """If a dispatch fails AFTER consuming the donated state, a retry
+    must raise the designed guard error (restore-from-checkpoint
+    guidance), not jax's raw deleted-array error."""
+    m, _, step = _fresh(donate=True)
+    X, Y = _batch()
+    step(X, Y)
+    # simulate an execution failure that consumed the donated buffers
+    m.parameters()[0]._data.delete()
+    step._dispatch_failed = True
+    with pytest.raises(RuntimeError, match="donate=False"):
+        step(X, Y)
+
+
+def test_run_steps_donated_matches_undonated():
+    X, Y = _batch()
+    m_d, opt_d, step_d = _fresh(donate=True)
+    m_u, opt_u, step_u = _fresh(donate=False)
+    l_d = np.asarray(step_d.run_steps(5, X, Y)._data)
+    l_u = np.asarray(step_u.run_steps(5, X, Y)._data)
+    np.testing.assert_array_equal(l_d, l_u)
+    _assert_states_equal(m_d, m_u, opt_d, opt_u)
