@@ -120,7 +120,8 @@ class GradScaler:
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True,
+                 max_consecutive_skips=50):
         self._enable = enable
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
@@ -132,6 +133,29 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._already_unscaled = False
+        # divergence guard: N consecutive found_inf skips means the run
+        # is NaN for real, not a transient overflow — halving the scale
+        # forever would just hide it (0 disables the guard)
+        self._max_consecutive_skips = int(max_consecutive_skips or 0)
+        self._skipped_steps = 0
+        self._consecutive_skips = 0
+
+    @property
+    def skipped_steps(self) -> int:
+        """Total optimizer steps skipped because of non-finite grads."""
+        return self._skipped_steps
+
+    def _check_diverged(self):
+        if self._max_consecutive_skips and \
+                self._consecutive_skips >= self._max_consecutive_skips:
+            raise RuntimeError(
+                f"training diverged: {self._consecutive_skips} "
+                f"consecutive steps produced non-finite gradients "
+                f"(loss scale is down to {self._scale}); restore from a "
+                f"checkpoint with a lower learning rate instead of "
+                f"letting the scaler halve the scale forever. Raise "
+                f"GradScaler(max_consecutive_skips=...) to tolerate "
+                f"longer bursts.")
 
     def scale(self, loss):
         if not self._enable:
@@ -169,20 +193,25 @@ class GradScaler:
 
     def update(self):
         self._already_unscaled = False
-        if not self._dynamic:
-            return
         if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
+            self._skipped_steps += 1
+            self._consecutive_skips += 1
         else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
+            self._consecutive_skips = 0
+        if self._dynamic:
+            if self._found_inf:
+                self._bad_steps += 1
                 self._good_steps = 0
+                if self._bad_steps >= self._decr_every:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._bad_steps = 0
+            else:
+                self._good_steps += 1
+                self._bad_steps = 0
+                if self._good_steps >= self._incr_every:
+                    self._scale *= self._incr_ratio
+                    self._good_steps = 0
+        self._check_diverged()
 
     def is_enable(self):
         return self._enable
@@ -193,24 +222,33 @@ class GradScaler:
 
     def state_dict(self):
         return {"scale": self._scale, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+                "bad_steps": self._bad_steps,
+                "skipped_steps": self._skipped_steps,
+                "consecutive_skips": self._consecutive_skips}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._skipped_steps = int(state.get("skipped_steps", 0))
+        self._consecutive_skips = int(state.get("consecutive_skips", 0))
 
 
 # -- compiled-step loss scaling (shared by TrainStep/ParallelTrainStep) ----
 def scaler_init_state(scaler):
-    """[scale, good_steps, bad_steps] as a traced f32 triple, or None when
-    scaling is off (reference HybridParallelGradScaler state)."""
+    """[scale, good_steps, bad_steps, skipped_total, consecutive_skips]
+    as a traced f32 vector, or None when scaling is off (reference
+    HybridParallelGradScaler state; the two skip counters back the
+    divergence guard and the observability surface)."""
     import jax.numpy as jnp
 
     if scaler is None or not scaler.is_enable():
         return None
     return jnp.asarray([scaler._scale, float(scaler._good_steps),
-                        float(scaler._bad_steps)], dtype=jnp.float32)
+                        float(scaler._bad_steps),
+                        float(scaler._skipped_steps),
+                        float(scaler._consecutive_skips)],
+                       dtype=jnp.float32)
 
 
 def scaler_unscale_and_check(grads, state):
@@ -230,8 +268,10 @@ def scaler_update_state(scaler, state, found):
     import jax.numpy as jnp
 
     scale, good, bad = state[0], state[1], state[2]
+    skipped2 = state[3] + jnp.where(found, 1.0, 0.0)
+    consec2 = jnp.where(found, state[4] + 1.0, 0.0)
     if not scaler._dynamic:
-        return state
+        return jnp.stack([scale, good, bad, skipped2, consec2])
     bad2 = jnp.where(found, bad + 1.0, 0.0)
     good2 = jnp.where(found, 0.0, good + 1.0)
     dec = bad2 >= scaler._decr_every
@@ -240,17 +280,23 @@ def scaler_update_state(scaler, state, found):
                        jnp.where(inc & ~found, scale * scaler._incr_ratio,
                                  scale))
     return jnp.stack([scale2, jnp.where(inc, 0.0, good2),
-                      jnp.where(dec, 0.0, bad2)])
+                      jnp.where(dec, 0.0, bad2), skipped2, consec2])
 
 
 def scaler_sync_from_state(scaler, state):
-    """Write the traced state back onto the python GradScaler (lazy)."""
+    """Write the traced state back onto the python GradScaler, and apply
+    the divergence guard: a long run of consecutive non-finite steps in
+    the COMPILED path must fail as loudly as the eager one."""
     import numpy as np
 
     s = np.asarray(state)
     scaler._scale = float(s[0])
     scaler._good_steps = int(s[1])
     scaler._bad_steps = int(s[2])
+    if len(s) > 4:  # state from an older checkpoint may be 3 wide
+        scaler._skipped_steps = int(s[3])
+        scaler._consecutive_skips = int(s[4])
+        scaler._check_diverged()
 
 
 def is_bfloat16_supported(place=None):

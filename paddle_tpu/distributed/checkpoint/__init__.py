@@ -18,7 +18,9 @@ the logical dtype recorded in metadata.
 """
 from __future__ import annotations
 
+import json
 import os
+import shutil
 from typing import Dict
 
 import numpy as np
@@ -29,10 +31,23 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.checkpoint.metadata import (
     LocalTensorMetadata, Metadata, TensorMetadata,
 )
+from paddle_tpu.testing import faults as _faults
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata"]
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "CheckpointManager"]
 
 _META_FILE = "metadata.json"
+_OBJECTS_FILE = "objects.json"  # non-numeric leaves (scheduler modes &c)
+
+
+def _fsync_path(path: str):
+    """fsync a written file (or directory entry) so a committed
+    checkpoint survives power loss, not just process death."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _data_file(process_index=None):
@@ -100,23 +115,29 @@ def _offsets_from_index(index, shape):
     return tuple(offs)
 
 
-def save_state_dict(state_dict: Dict, path: str):
-    """Write a (possibly nested) state dict of (possibly sharded) tensors
-    as unique chunks + manifest under directory ``path``.
+def _collect(state_dict: Dict):
+    """Device→host snapshot of a (possibly nested) state dict: every
+    unique shard chunk is copied to a host numpy array and described by
+    a TensorMetadata entry. Returns ``(arrays, tensors_meta, data_file,
+    objects)`` — ``objects`` holds the non-numeric leaves (e.g. an LR
+    scheduler's ``mode="min"``) that travel in a JSON sidecar instead of
+    the tensor chunk format.
 
-    Multi-host: every process writes its addressable shards to its own
-    ``data_{process_index}.npz`` (no filename collisions — reference uses
-    {rank}_{id}.distcp) plus a per-process metadata part; process 0 then
-    merges the parts into the global manifest after a barrier."""
-    os.makedirs(path, exist_ok=True)
+    This is the only part of a save that must block the train loop — the
+    async CheckpointManager runs it synchronously and hands the result to
+    a writer thread, so serialization and IO overlap training."""
     pidx = jax.process_index()
-    pcount = jax.process_count()
     data_file = _data_file(pidx)
     flat = _flatten(state_dict)
     arrays = {}
     tensors_meta = {}
+    objects = {}
     for name, v in flat.items():
-        data = _as_array(v)
+        try:
+            data = _as_array(v)
+        except (TypeError, ValueError):
+            objects[name] = v
+            continue
         gshape = tuple(int(s) for s in data.shape)
         chunks = []
         seen = set()
@@ -149,16 +170,49 @@ def save_state_dict(state_dict: Dict, path: str):
                 (0,) * loc.ndim, tuple(int(s) for s in loc.shape),
                 data_file, key))
         tensors_meta[name] = TensorMetadata(gshape, logical_dt, chunks)
-    np.savez(os.path.join(path, data_file), **arrays)
-    if pcount == 1:
-        Metadata(tensors_meta).save(os.path.join(path, _META_FILE))
-        return
-    # multi-host: write per-process part, barrier, merge on process 0
-    Metadata(tensors_meta).save(
-        os.path.join(path, f"metadata_part{pidx}.json"))
+    return arrays, tensors_meta, data_file, objects
+
+
+def _default_barrier(tag: str):
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    multihost_utils.sync_global_devices(tag)
+
+
+def _write_data(path: str, arrays: Dict, tensors_meta: Dict,
+                data_file: str, barrier=None, objects=None):
+    """Write one process's chunks + manifest into ``path`` (which already
+    exists), fsyncing every file.
+
+    Multi-host: every process writes its addressable shards to its own
+    ``data_{process_index}.npz`` (no filename collisions — reference uses
+    {rank}_{id}.distcp) plus a per-process metadata part; process 0 then
+    merges the parts into the global manifest after a barrier.
+    ``barrier(tag)`` defaults to ``sync_global_devices`` — the async
+    CheckpointManager substitutes a store barrier because collectives
+    must not run off the main thread."""
+    pidx = jax.process_index()
+    pcount = jax.process_count()
+    if barrier is None:
+        barrier = _default_barrier
+    np.savez(os.path.join(path, data_file), **arrays)
+    _fsync_path(os.path.join(path, data_file))
+    if objects and pidx == 0:
+        # host-side non-numeric state is identical on every rank
+        obj_file = os.path.join(path, _OBJECTS_FILE)
+        with open(obj_file, "w") as f:
+            json.dump(objects, f)
+        _fsync_path(obj_file)
+    _faults.fire("ckpt.data_written")
+    if pcount == 1:
+        Metadata(tensors_meta).save(os.path.join(path, _META_FILE))
+        _fsync_path(os.path.join(path, _META_FILE))
+        return
+    # multi-host: write per-process part, barrier, merge on process 0
+    part_file = os.path.join(path, f"metadata_part{pidx}.json")
+    Metadata(tensors_meta).save(part_file)
+    _fsync_path(part_file)
+    barrier(f"ckpt_save:{path}")
     if pidx == 0:
         merged = {}
         for p in range(pcount):
@@ -174,10 +228,140 @@ def save_state_dict(state_dict: Dict, path: str):
                         merged[name].chunks.append(c)
                         have.add(c.global_offset)
         Metadata(merged).save(os.path.join(path, _META_FILE))
-    multihost_utils.sync_global_devices(f"ckpt_save_done:{path}")
+        _fsync_path(os.path.join(path, _META_FILE))
+    barrier(f"ckpt_save_done:{path}")
 
 
-def _assemble_slice(get_npz, meta: TensorMetadata, index):
+def save_state_dict(state_dict: Dict, path: str):
+    """Write a (possibly nested) state dict of (possibly sharded) tensors
+    as unique chunks + manifest under directory ``path``.
+
+    The write is ATOMIC at the directory level: everything is staged into
+    a sibling ``<path>.tmp`` dir and renamed into place only once every
+    file is written and fsynced, so a crash mid-save can never leave a
+    half-checkpoint at ``path`` that ``load_state_dict`` would partially
+    read. When ``path`` already holds a checkpoint, the old one stays
+    intact (briefly renamed to ``<path>.old``) until the new one has
+    fully landed. For step-series checkpoints with commit markers,
+    retention and auto-resume, use :class:`CheckpointManager`."""
+    arrays, tensors_meta, data_file, objects = _collect(state_dict)
+    pidx = jax.process_index()
+    pcount = jax.process_count()
+    path = path.rstrip("/")
+    tmp = path + ".tmp"
+    old = path + ".old"
+    def _is_ckpt(d):
+        return os.path.exists(os.path.join(d, _META_FILE))
+
+    if pidx == 0:
+        # the commit below REPLACES ``path`` wholesale — refuse to
+        # destroy a populated directory that is not a checkpoint (the
+        # pre-atomic API wrote files alongside existing contents)
+        for d in (path, old):
+            if os.path.isdir(d) and not _is_ckpt(d) and os.listdir(d):
+                raise ValueError(
+                    f"refusing to replace {d!r}: it exists, is not "
+                    f"empty, and holds no {_META_FILE} — the atomic "
+                    f"commit would delete its contents. Save to a fresh "
+                    f"or checkpoint-holding path.")
+        # a crash between the two commit renames below leaves the only
+        # complete checkpoint parked at <path>.old — put it back before
+        # treating .old as garbage
+        if not os.path.isdir(path) and os.path.isdir(old) \
+                and _is_ckpt(old):
+            os.rename(old, path)
+        # leftover staging from a previous crashed save is stale garbage
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(old, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+    if pcount > 1:
+        _default_barrier(f"ckpt_stage:{path}")
+    _write_data(tmp, arrays, tensors_meta, data_file, objects=objects)
+    if pidx == 0:
+        _faults.fire("ckpt.before_commit")
+        if os.path.isdir(path):
+            os.rename(path, old)  # keep the old ckpt whole until the end
+        os.replace(tmp, path)
+        _fsync_path(os.path.dirname(os.path.abspath(path)) or ".")
+        shutil.rmtree(old, ignore_errors=True)
+    if pcount > 1:
+        _default_barrier(f"ckpt_commit:{path}")
+
+
+def _union_volume(boxes, shape) -> int:
+    """Exact union volume of half-open (lo, hi) boxes. A summed-volume
+    coverage check double-counts overlapping chunks (possible in a torn
+    multi-host merge mixing mesh shapes) and can mask a hole that would
+    then be returned as uninitialized np.empty memory.
+
+    Coordinate compression: O(k) vectorized cell updates for the k boxes
+    of any real sharding layout (cells ~ k). Degenerate boundary sets
+    that would explode the cell grid fall back to a 1-byte/element mask
+    bounded by the tensor itself."""
+    if not shape:
+        return 1 if boxes else 0
+    bounds = []
+    for d, dim in enumerate(shape):
+        bs = {0, dim}
+        for lo, hi in boxes:
+            bs.add(lo[d])
+            bs.add(hi[d])
+        bounds.append(sorted(bs))
+    cell_shape = [len(b) - 1 for b in bounds]
+    if int(np.prod(cell_shape)) > max(16_000_000,
+                                      int(np.prod(shape))):
+        mask = np.zeros(shape, dtype=bool)
+        for lo, hi in boxes:
+            mask[tuple(slice(l, h) for l, h in zip(lo, hi))] = True
+        return int(mask.sum())
+    idx = [{v: i for i, v in enumerate(b)} for b in bounds]
+    hit = np.zeros(cell_shape, dtype=bool)
+    for lo, hi in boxes:
+        hit[tuple(slice(idx[d][lo[d]], idx[d][hi[d]])
+                  for d in range(len(shape)))] = True
+    vol = np.diff(bounds[0]).astype(np.int64)
+    for b in bounds[1:]:
+        vol = np.multiply.outer(vol, np.diff(b).astype(np.int64))
+    return int(vol[hit].sum())
+
+
+def _validate_tensor(name: str, tm: TensorMetadata, path: str):
+    """Manifest sanity for one tensor BEFORE assembly starts: every
+    referenced chunk file must exist and the chunks must tile the global
+    shape. One clear error naming the tensor beats a deep KeyError out
+    of npz internals or — worse — a silent partial restore."""
+    for ch in tm.chunks:
+        f = os.path.join(path, ch.file)
+        if not os.path.exists(f):
+            raise ValueError(
+                f"checkpoint at {path!r}: tensor {name!r} references "
+                f"chunk file {ch.file!r} which is missing on disk — the "
+                f"checkpoint is torn or incomplete (crashed save? lost "
+                f"shard file?)")
+    total = int(np.prod(tm.global_shape)) if tm.global_shape else 1
+    seen = set()
+    boxes = []
+    for ch in tm.chunks:
+        if ch.global_offset in seen:
+            continue
+        seen.add(ch.global_offset)
+        lo = tuple(int(o) for o in ch.global_offset)
+        hi = tuple(min(o + l, d) for o, l, d in
+                   zip(lo, ch.local_shape, tm.global_shape))
+        if any(h <= l for l, h in zip(lo, hi)):
+            continue
+        boxes.append((lo, hi))
+    covered = _union_volume(boxes, tm.global_shape)
+    if covered < total:
+        raise ValueError(
+            f"checkpoint at {path!r}: chunks for tensor {name!r} cover "
+            f"only {covered}/{total} elements of global shape "
+            f"{tm.global_shape} — the manifest has a coverage hole "
+            f"(missing shard chunks; was the save interrupted before "
+            f"every process wrote its part?)")
+
+
+def _assemble_slice(get_npz, meta: TensorMetadata, index, name="?"):
     """Assemble the requested global slice from saved chunks; raises
     unless the chunks exactly tile the requested region (a lost shard
     file must not silently yield uninitialized memory)."""
@@ -187,6 +371,7 @@ def _assemble_slice(get_npz, meta: TensorMetadata, index):
     shape = [b - a for a, b in zip(starts, stops)]
     total = int(np.prod(shape)) if shape else 1
     covered = 0
+    copied = []  # (lo, hi) in slice-local coords, for the overlap check
     out = None
     for ch in meta.chunks:
         c_starts = list(ch.global_offset)
@@ -196,7 +381,13 @@ def _assemble_slice(get_npz, meta: TensorMetadata, index):
         hi = [min(b, cb) for b, cb in zip(stops, c_stops)]
         if any(l >= h for l, h in zip(lo, hi)) and shape:
             continue
-        chunk = _np_restore(get_npz(ch.file)[ch.key], meta.dtype)
+        try:
+            chunk = _np_restore(get_npz(ch.file)[ch.key], meta.dtype)
+        except KeyError:
+            raise ValueError(
+                f"tensor {name!r}: chunk key {ch.key!r} is absent from "
+                f"{ch.file!r} — the data file is torn or from a "
+                f"different save than the manifest") from None
         if out is None:
             out = np.empty(shape, dtype=chunk.dtype)
         if not shape:  # 0-d
@@ -206,13 +397,20 @@ def _assemble_slice(get_npz, meta: TensorMetadata, index):
         src = tuple(slice(l - ca, h - ca)
                     for l, h, ca in zip(lo, hi, c_starts))
         out[dst] = chunk[src]
+        copied.append((tuple(s.start for s in dst),
+                       tuple(s.stop for s in dst)))
         covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
     if out is None:
-        raise ValueError("no saved chunks cover the requested slice")
+        raise ValueError(
+            f"tensor {name!r}: no saved chunks cover the requested slice")
+    if covered >= total:
+        # the sum can double-count overlapping chunks — confirm exactly,
+        # or a hole would be returned as uninitialized np.empty memory
+        covered = _union_volume(copied, shape)
     if covered < total:
         raise ValueError(
-            f"saved chunks cover only {covered}/{total} elements of the "
-            f"requested slice (missing shard file?)")
+            f"tensor {name!r}: saved chunks cover only {covered}/{total} "
+            f"elements of the requested slice (missing shard file?)")
     return out
 
 
@@ -220,7 +418,22 @@ def load_state_dict(state_dict: Dict, path: str):
     """Fill ``state_dict``'s tensors in place from the checkpoint at
     ``path``, resharding each tensor to its CURRENT sharding (whatever
     mesh/placements the destination tensors live on)."""
+    if jax.process_count() == 1 and not os.path.isdir(path):
+        # a crash between save_state_dict's two commit renames parks the
+        # only complete checkpoint at <path>.old — put it back, the same
+        # recovery the next save would do (single-process only: in a
+        # gang the rename would race peers' reads; CheckpointManager
+        # owns that recovery on rank 0)
+        old = path.rstrip("/") + ".old"
+        if os.path.isdir(old) and os.path.exists(
+                os.path.join(old, _META_FILE)):
+            os.rename(old, path)
     meta = Metadata.load(os.path.join(path, _META_FILE))
+    objects = {}
+    obj_file = os.path.join(path, _OBJECTS_FILE)
+    if os.path.exists(obj_file):
+        with open(obj_file) as f:
+            objects = json.load(f)
     _npz_cache = {}
 
     def get_npz(fname):
@@ -231,6 +444,10 @@ def load_state_dict(state_dict: Dict, path: str):
     flat = _flatten(state_dict)
     missing = []
     for name, v in flat.items():
+        if name in objects:
+            # non-numeric leaf from the JSON sidecar (scheduler mode &c)
+            _set_by_path(state_dict, name, objects[name])
+            continue
         tm = meta.tensors.get(name)
         if tm is None:
             missing.append(name)
@@ -240,14 +457,17 @@ def load_state_dict(state_dict: Dict, path: str):
             raise ValueError(
                 f"shape mismatch for {name!r}: checkpoint "
                 f"{tm.global_shape} vs target {tuple(data.shape)}")
+        _validate_tensor(name, tm, path)
         sharding = data.sharding if isinstance(data, jax.Array) else None
         if sharding is not None:
             new = jax.make_array_from_callback(
                 tm.global_shape, sharding,
-                lambda idx, _tm=tm: _assemble_slice(get_npz, _tm, idx))
+                lambda idx, _tm=tm, _n=name: _assemble_slice(
+                    get_npz, _tm, idx, _n))
         else:
             full = _assemble_slice(
-                get_npz, tm, tuple(slice(0, s) for s in tm.global_shape))
+                get_npz, tm, tuple(slice(0, s) for s in tm.global_shape),
+                name)
             new = jnp.asarray(full)
         new = new.astype(data.dtype)
         if isinstance(v, Tensor):
@@ -263,3 +483,8 @@ def load_state_dict(state_dict: Dict, path: str):
         raise KeyError(
             f"checkpoint at {path} is missing tensors: {missing[:8]}"
             + ("..." if len(missing) > 8 else ""))
+
+
+from paddle_tpu.distributed.checkpoint.manager import (  # noqa: E402
+    CheckpointManager,
+)

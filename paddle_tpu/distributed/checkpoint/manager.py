@@ -1,0 +1,456 @@
+"""CheckpointManager: atomic, async, auto-resuming step checkpoints.
+
+Reference reliability machinery: the {rank}_{id}.distcp sharded writer
+plus fleet's elastic restart contract — long multi-host runs die from
+preemption, torn writes and NaN blow-ups, so a checkpoint is only useful
+if (a) a crash at ANY instant leaves the previous committed checkpoint
+intact and (b) a restarted job can find the newest committed one without
+human help. "Memory-efficient array redistribution through portable
+collective communication" (PAPERS.md) motivates the restore side: the
+chunk+manifest format reloads onto a *different* mesh/process count
+after an elastic restart, and the manager guards that a restore never
+reads a torn directory.
+
+Commit protocol (per step N, under ``root/``)::
+
+    step_N.tmp/          stage: data_*.npz + metadata.json, each fsynced
+    step_N/              os.replace(step_N.tmp, step_N)   (atomic rename)
+    step_N/COMMITTED     marker written LAST (fsynced, atomic rename)
+
+Only directories containing the ``COMMITTED`` marker count: ``latest_step``
+/ ``restore_or_initialize`` skip torn or uncommitted directories, and GC
+removes them together with committed steps beyond ``keep_last_n``.
+
+Async saves block the train loop only for the device→host snapshot
+(:func:`_collect`); serialization and IO run on a writer thread with
+retry + exponential backoff on filesystem errors. One save is in flight
+at a time; a background failure is re-raised on the next ``save``/
+``wait`` so it cannot pass silently.
+
+Multi-host: every process stages its own shards; barriers default to
+``sync_global_devices`` for blocking saves, and switch to the rendezvous
+store's barrier for async saves (collectives must not run off the main
+thread). The manager assumes ONE writer per process — it is not a
+concurrency layer over a shared directory.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import shutil
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+import jax
+
+from paddle_tpu.testing import faults as _faults
+
+__all__ = ["CheckpointManager"]
+
+COMMITTED = "COMMITTED"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+# sentinel: multi-host with no store — barriers must be collectives, so
+# the save has to run on the main thread (async falls back to blocking)
+_NEEDS_MAIN_THREAD = object()
+
+
+def _noop_barrier(tag):
+    pass
+
+# managers with a possibly-in-flight writer thread; drained at process
+# exit so a clean interpreter shutdown never tears a checkpoint
+_live_managers = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_live_managers():
+    for m in list(_live_managers):
+        try:
+            m.wait()
+        except Exception:
+            pass
+
+
+class CheckpointManager:
+    """Manage a series of committed step checkpoints under ``root``.
+
+    >>> mgr = CheckpointManager("/ckpt/run1", keep_last_n=3)
+    >>> start = mgr.restore_or_initialize(state) or 0   # auto-resume
+    >>> for step in range(start + 1, total + 1):
+    ...     train_step(...)
+    ...     mgr.save(step, state)                       # async commit
+    ...     if mgr.reached_preemption(step):
+    ...         mgr.save(step, state, block=True, force=True)
+    ...         sys.exit(0)
+    >>> mgr.wait()
+    """
+
+    def __init__(self, root: str, keep_last_n: int = 5,
+                 async_save: bool = True, save_interval_steps: int = 1,
+                 max_retries: int = 3, backoff_base: float = 0.5):
+        self._root = str(root)
+        # at least the newest committed step is always kept — a manager
+        # that retains nothing cannot resume anything
+        self._keep = max(1, int(keep_last_n))
+        # store-barrier namespace: tags must never repeat, or a peer
+        # blocked in THIS save's barrier would be released by a previous
+        # save's counters (FileStore counters persist; the coordination
+        # service rejects reused ids). PADDLE_RESTART_COUNT (launcher,
+        # bumps per re-form, same on every rank) disambiguates
+        # incarnations sharing a persistent store; _seq disambiguates
+        # saves within one (saves are collective, so it stays in step).
+        self._ns_prefix = f"r{os.environ.get('PADDLE_RESTART_COUNT', '0')}"
+        self._seq = 0
+        self._async = bool(async_save)
+        self._interval = max(1, int(save_interval_steps))
+        self._max_retries = int(max_retries)
+        self._backoff_base = float(backoff_base)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._preempt = None
+        os.makedirs(self._root, exist_ok=True)
+        self._recover_parked()
+        _live_managers.add(self)
+
+    # -- directory model -------------------------------------------------
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self._root, f"step_{int(step)}")
+
+    def _is_committed(self, step_dir: str) -> bool:
+        return os.path.exists(os.path.join(step_dir, COMMITTED))
+
+    def all_steps(self, include_uncommitted: bool = False) -> List[int]:
+        """Steps present under root, ascending; by default only steps
+        whose directory carries the COMMITTED marker."""
+        out = []
+        try:
+            names = os.listdir(self._root)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m is None:
+                continue
+            if include_uncommitted or self._is_committed(
+                    os.path.join(self._root, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _agreed_latest_step(self) -> Optional[int]:
+        """Multi-host: restore must use ONE step on every rank. Each
+        rank's own directory listing can disagree (rank 0's
+        ``_recover_parked`` rename races peers' listdir; a shared
+        filesystem can surface a new commit to some ranks first), so
+        rank 0's view — the rank that runs recovery and GC — is
+        broadcast and wins. Doubles as a sync point: peers block here
+        until rank 0 has finished recovery."""
+        step = self.latest_step()
+        if jax.process_count() == 1:
+            return step
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        agreed = multihost_utils.broadcast_one_to_all(
+            np.asarray([-1 if step is None else int(step)], np.int64))
+        step = int(np.asarray(agreed)[0])
+        return None if step < 0 else step
+
+    # -- save ------------------------------------------------------------
+    def should_save(self, step: int) -> bool:
+        if int(step) % self._interval == 0:
+            return True
+        # single-process only: saves are collective, and the local
+        # preemption flag can differ across ranks for up to a poll
+        # interval — one rank force-saving off the schedule would hang
+        # alone in the commit barriers. Multi-host preemption saves go
+        # through reached_preemption(), which reaches rank-0 consensus.
+        return jax.process_count() == 1 and self.preemption_requested
+
+    def save(self, step: int, state_dict: Dict, block: bool = False,
+             force: bool = False) -> bool:
+        """Snapshot ``state_dict`` (device→host, synchronous) and commit
+        it as step ``step``. Returns False when ``save_interval_steps``
+        says to skip (override with ``force=True``). ``block=True`` runs
+        serialization + IO inline — the final save before an exit must
+        not race process teardown."""
+        if not force and not self.should_save(step):
+            return False
+        self.wait()  # one in flight; re-raises a prior background error
+        from paddle_tpu.distributed.checkpoint import _collect
+
+        arrays, tensors_meta, data_file, objects = _collect(state_dict)
+        self._seq += 1  # fresh store-barrier namespace for this save
+        barrier = self._make_barrier(async_ok=not block)
+        if block or not self._async or barrier is _NEEDS_MAIN_THREAD:
+            self._write_and_commit(step, arrays, tensors_meta, data_file,
+                                   objects,
+                                   None if barrier is _NEEDS_MAIN_THREAD
+                                   else barrier)
+            return True
+
+        def runner():
+            try:
+                self._write_and_commit(step, arrays, tensors_meta,
+                                       data_file, objects, barrier)
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=runner, name=f"ckpt-writer-step{step}", daemon=True)
+        self._thread.start()
+        return True
+
+    def wait(self):
+        """Join any in-flight async save; raise its error if it failed."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    close = wait
+
+    def _make_barrier(self, async_ok: bool):
+        """Barrier for the commit protocol. Single-process: none needed.
+        Multi-host blocking save: sync_global_devices (None = default).
+        Multi-host async save: the rendezvous store's barrier, because
+        XLA collectives must stay on the main thread; with no store
+        available the save falls back to blocking (_NEEDS_MAIN_THREAD)."""
+        if jax.process_count() == 1:
+            return _noop_barrier
+        if not async_ok or not self._async:
+            return None  # _write_data's sync_global_devices default
+        try:
+            from paddle_tpu.distributed.store import current_store
+
+            store = current_store()
+        except Exception:
+            return _NEEDS_MAIN_THREAD
+        ns = f"{self._ns_prefix}_s{self._seq}"
+        return lambda tag: store.barrier(f"ckpt_{ns}_{tag}")
+
+    def _write_and_commit(self, step, arrays, tensors_meta, data_file,
+                          objects, barrier):
+        final = self._step_path(step)
+        tmp = final + ".tmp"
+        delay = self._backoff_base
+        # retries are per-process decisions; in a multi-host gang a lone
+        # retrying rank would re-enter attempt-tagged barriers its peers
+        # never reach and deadlock the job — until retry decisions are
+        # exchanged through the store, multi-host saves get one attempt
+        # (ROADMAP: fault-tolerance follow-ups)
+        retries = self._max_retries if jax.process_count() == 1 else 0
+        for attempt in range(retries + 1):
+            try:
+                self._attempt(step, final, tmp, arrays, tensors_meta,
+                              data_file, objects, barrier, attempt)
+                return
+            except OSError as e:
+                # filesystem errors (full disk, flaky NFS) are retried
+                # with exponential backoff; anything else propagates
+                shutil.rmtree(tmp, ignore_errors=True)
+                if attempt >= retries:
+                    raise OSError(
+                        f"checkpoint step {step}: write failed after "
+                        f"{attempt + 1} attempts: {e}") from e
+                time.sleep(delay)
+                delay *= 2
+
+    def _attempt(self, step, final, tmp, arrays, tensors_meta, data_file,
+                 objects, barrier, attempt):
+        from paddle_tpu.distributed.checkpoint import (
+            _fsync_path, _write_data,
+        )
+
+        pidx = jax.process_index()
+        tagged = None
+        if barrier is not None:
+            tagged = lambda tag: barrier(f"{tag}:a{attempt}")  # noqa: E731
+        if pidx == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+        if tagged is not None:
+            tagged(f"{step}_stage")
+        elif jax.process_count() > 1:
+            from paddle_tpu.distributed.checkpoint import _default_barrier
+
+            _default_barrier(f"ckpt_{step}_stage:a{attempt}")
+        _write_data(tmp, arrays, tensors_meta, data_file, barrier=tagged,
+                    objects=objects)
+        if pidx == 0:
+            _faults.fire("ckpt.before_commit")
+            aside = final + ".old"
+            if os.path.isdir(final):
+                if self._is_committed(final):
+                    # re-save of the same step (e.g. the forced
+                    # preemption save after an async one): keep the
+                    # committed copy whole until the rewrite has fully
+                    # landed — a kill mid-rewrite must not lose the
+                    # newest checkpoint
+                    shutil.rmtree(aside, ignore_errors=True)
+                    os.rename(final, aside)
+                else:
+                    # torn rewrite from a FAILED earlier attempt: the
+                    # committed copy may already be parked at aside —
+                    # drop only the torn dir, never the parked bytes
+                    shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            _faults.fire("ckpt.before_marker")
+            # marker last: its presence certifies every byte before it
+            marker = os.path.join(final, COMMITTED)
+            marker_tmp = marker + ".tmp"
+            with open(marker_tmp, "w") as f:
+                json.dump({"step": int(step), "time": time.time(),
+                           "world": jax.process_count()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(marker_tmp, marker)
+            _fsync_path(final)
+            _fsync_path(self._root)
+            shutil.rmtree(aside, ignore_errors=True)
+            _faults.fire("ckpt.committed")
+        if tagged is not None:
+            tagged(f"{step}_done")
+        elif jax.process_count() > 1:
+            from paddle_tpu.distributed.checkpoint import _default_barrier
+
+            _default_barrier(f"ckpt_{step}_done:a{attempt}")
+        self._gc(keep_step=step)
+
+    def _recover_parked(self):
+        """A crash between a same-step rewrite and its marker leaves the
+        committed copy parked at ``step_N.old`` and a torn ``step_N``:
+        put the committed bytes back before anything treats ``.old`` as
+        garbage (runs at manager init and before every GC pass)."""
+        if jax.process_index() != 0:
+            return
+        try:
+            names = os.listdir(self._root)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if not name.endswith(".old") or \
+                    _STEP_RE.match(name[:-4]) is None:
+                continue
+            parked = os.path.join(self._root, name)
+            dest = os.path.join(self._root, name[:-4])
+            if not self._is_committed(parked):
+                continue  # uncommitted junk; GC removes it
+            if self._is_committed(dest):
+                # the rewrite fully landed — the parked copy is obsolete
+                shutil.rmtree(parked, ignore_errors=True)
+                continue
+            shutil.rmtree(dest, ignore_errors=True)  # torn rewrite
+            os.rename(parked, dest)
+
+    # -- retention -------------------------------------------------------
+    def _gc(self, keep_step: Optional[int] = None):
+        """Remove (rank 0 only): stale staging dirs, torn/uncommitted
+        step dirs, and committed steps beyond ``keep_last_n``."""
+        if jax.process_index() != 0:
+            return
+        self._recover_parked()
+        committed = self.all_steps()
+        for name in os.listdir(self._root):
+            full = os.path.join(self._root, name)
+            if name.endswith((".tmp", ".old")) and os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+                continue
+            m = _STEP_RE.match(name)
+            if m is None:
+                continue
+            step = int(m.group(1))
+            torn = step not in committed
+            stale = len(committed) > self._keep and \
+                step in committed[:-self._keep]
+            if (torn or stale) and step != keep_step:
+                shutil.rmtree(full, ignore_errors=True)
+
+    # -- restore ---------------------------------------------------------
+    def restore(self, state_dict: Dict, step: Optional[int] = None) -> int:
+        """Fill ``state_dict`` in place from checkpoint ``step`` (default:
+        newest committed). The target tensors' CURRENT shardings decide
+        placement, so a checkpoint written under a different mesh or
+        process count reshards on the way in."""
+        from paddle_tpu.distributed.checkpoint import load_state_dict
+
+        if step is None:
+            step = self._agreed_latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint under {self._root!r}")
+        path = self._step_path(step)
+        if not self._is_committed(path):
+            raise ValueError(
+                f"checkpoint step {step} at {path!r} has no COMMITTED "
+                f"marker — refusing to restore from a torn save")
+        load_state_dict(state_dict, path)
+        return int(step)
+
+    def restore_or_initialize(self, state_dict: Dict) -> Optional[int]:
+        """Auto-resume: restore the newest committed checkpoint and
+        return its step, or return None (leaving ``state_dict``
+        untouched) when none exists. Torn/uncommitted directories —
+        e.g. from a SIGKILL mid-save — are skipped, never read."""
+        step = self._agreed_latest_step()
+        if step is None:
+            return None
+        return self.restore(state_dict, step)
+
+    # -- preemption ------------------------------------------------------
+    def install_preemption_handler(self, signals=None):
+        """Capture SIGTERM (the cloud preemption notice): sets a flag the
+        train loop polls via :meth:`reached_preemption` and broadcasts
+        the notice through the gang store so every rank takes its final
+        synchronous save and exits together."""
+        from paddle_tpu.distributed.watchdog import preemption_monitor
+
+        self._preempt = preemption_monitor()
+        self._preempt.install(signals)
+        return self._preempt
+
+    @property
+    def preemption_requested(self) -> bool:
+        if self._preempt is None:
+            return False
+        return self._preempt.requested()
+
+    def reached_preemption(self, step: int) -> bool:
+        """Poll between steps; True once a preemption notice (local
+        SIGTERM or a peer's store broadcast) has arrived. The caller
+        then does ``save(step, state, block=True, force=True)`` and
+        exits 0 — see the class docstring loop.
+
+        Multi-host: every rank must act at the SAME step boundary or the
+        final save deadlocks on mismatched collective barriers, so rank
+        0's view is broadcast on a deterministic schedule (every
+        ``save_interval_steps``). The broadcast is a collective — a
+        store-only scheme cannot rendezvous ranks that pass the same
+        boundary at different wall-clock times — but it only runs at
+        save boundaries, where a save already pays a full device→host
+        snapshot, so its cost is amortized by the save cadence. A notice
+        landing on any rank reaches rank 0 through the gang store within
+        a poll interval; the final save is delayed by at most one
+        interval — budget ``--stop_timeout`` accordingly."""
+        if jax.process_count() == 1:
+            return self.preemption_requested
+        if int(step) % self._interval != 0:
+            return False
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flag = multihost_utils.broadcast_one_to_all(
+            np.asarray([1 if self.preemption_requested else 0],
+                       np.int32))
+        return bool(int(np.asarray(flag)[0]))

@@ -60,6 +60,10 @@ def launch(args, extra_env=None):
         attempt += 1
         if rc == 0 or restarts <= 0:
             return rc
+        if rc in (130, 143):
+            # interrupt / SIGTERM preemption: a deliberate stop, never
+            # restarted (the preempted host is going away)
+            return rc
         restarts -= 1
         print(f"[launch] job failed (rc={rc}); restarting "
               f"({restarts} restarts left)", file=sys.stderr, flush=True)
@@ -106,8 +110,8 @@ def _launch_elastic(args, extra_env, min_n, max_n):
                                 elastic=mgr)
         if rc == 0:
             return 0
-        if rc == 130:  # user interrupt is a stop, not a member failure
-            return rc
+        if rc in (130, 143):  # interrupt/preemption: a stop, not a
+            return rc         # member failure — do not re-form
         attempt += 1
         joins = mgr.join_requests()
         new_world = mgr.decide_world(world, lost=lost, joins=joins)
@@ -202,6 +206,32 @@ def _launch_once(args, extra_env=None, attempt=0, world=None,
                          payload={"pid": procs[i].pid}).start()
                for i in range(n)]
 
+    # preemption wiring: SIGTERM to the launcher (the cloud's preemption
+    # notice lands on the controller) is forwarded to every worker so
+    # each takes its final synchronous checkpoint; workers that exit
+    # clean within the grace window make the whole job exit 0, otherwise
+    # they are killed and the job reports 143 (preempted) — which the
+    # restart loops above deliberately do NOT relaunch.
+    term = {"at": None}
+
+    def _forward_term(signum, frame):
+        if term["at"] is None:
+            term["at"] = time.time()
+            print("[launch] SIGTERM: forwarding to workers for a final "
+                  "checkpoint", file=sys.stderr, flush=True)
+            for q in procs:
+                try:
+                    q.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_term)
+    except ValueError:
+        pass  # not the main thread (tests drive launch() from threads)
+    stop_grace = float(getattr(args, "stop_timeout", 30.0))
+
     rc = 0
     lost = 0
     try:
@@ -217,7 +247,13 @@ def _launch_once(args, extra_env=None, attempt=0, world=None,
                 if p._log:
                     p._log.close()
                 if r != 0:
-                    if rc == 0:
+                    if term["at"] is not None:
+                        # under preemption a nonzero exit means the
+                        # worker missed its grace window, not an organic
+                        # failure — report 143, don't gang-kill peers
+                        # (they already have the signal)
+                        rc = rc or 143
+                    elif rc == 0:
                         # organic failure: a lost member. Later nonzero
                         # exits are collateral from the gang-kill below
                         # and must NOT shrink the next world.
@@ -226,8 +262,14 @@ def _launch_once(args, extra_env=None, attempt=0, world=None,
                         # one dead trainer kills the job (watcher.py role)
                         for q in procs:
                             q.terminate()
-            if elastic is not None and rc == 0 and procs and \
-                    elastic.join_requests() and n < elastic.max:
+            if term["at"] is not None and procs and \
+                    time.time() - term["at"] > stop_grace:
+                for q in procs:
+                    q.kill()
+                rc = rc or 143
+            if elastic is not None and rc == 0 and term["at"] is None \
+                    and procs and elastic.join_requests() \
+                    and n < elastic.max:
                 # scale-out: admit the newcomer by re-forming the gang
                 # (reference elastic manager force-restarts on member
                 # change — a collective world cannot grow in place)
@@ -242,6 +284,11 @@ def _launch_once(args, extra_env=None, attempt=0, world=None,
     finally:
         for hb in hbs:
             hb.stop()
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
     return rc, lost
 
 
@@ -274,6 +321,10 @@ def main(argv=None):
                     help="relaunch the job up to N times after a failure "
                          "(elastic recovery)")
     ap.add_argument("--restart_interval", type=float, default=1.0)
+    ap.add_argument("--stop_timeout", type=float, default=30.0,
+                    help="grace seconds after a forwarded SIGTERM before "
+                         "workers are killed (preemption final-save "
+                         "window)")
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)

@@ -30,7 +30,8 @@ import jax
 
 from paddle_tpu.core import flags as _flags
 
-__all__ = ["StepWatchdog", "default_watchdog", "watch_step"]
+__all__ = ["StepWatchdog", "default_watchdog", "watch_step",
+           "PreemptionMonitor", "preemption_monitor"]
 
 _flags.define_flag("step_timeout_s", float(os.environ.get(
     "PADDLE_STEP_TIMEOUT", "0") or 0),
@@ -41,6 +42,89 @@ _flags.define_flag("step_timeout_s", float(os.environ.get(
 
 ABORT_KEY = "watchdog_abort"
 ABORT_POLL_S = float(os.environ.get("PADDLE_ABORT_POLL", "1.0"))
+
+
+class _StoreChannel:
+    """One gang-store record under ``key``, shared by the watchdog's
+    abort broadcast and the preemption monitor's notice: store lookup
+    with retry backoff, posts stamped with rank + a generation uuid, and
+    changed-since-baseline reads. The generation baseline — whatever
+    record is present on the FIRST look predates this process (a
+    previous gang incarnation's leftover) and only a CHANGED record
+    counts — is wall-clock-free, so cross-host clock skew cannot drop
+    fresh records or replay stale ones."""
+
+    def __init__(self, key: str):
+        self.key = key
+        self.store = None  # injectable for tests (False = lookup failed)
+        self.retry_at = 0.0
+        self.baseline = None
+        self.baseline_read = False
+
+    def get_store(self):
+        if self.store not in (None, False):
+            return self.store
+        # a failed lookup is retried after a backoff — the distributed
+        # runtime often comes up AFTER the channel is first used, and a
+        # permanently cached failure would silently disable the channel
+        # for the life of the process
+        now = time.monotonic()
+        if self.store is False and now - self.retry_at < 10.0:
+            return None
+        self.retry_at = now
+        try:
+            from paddle_tpu.distributed.store import current_store
+
+            self.store = current_store() or False
+        except Exception:
+            self.store = False
+        return self.store or None
+
+    def post(self, payload: dict):
+        store = self.get_store()
+        if store is None:
+            return
+        try:
+            import json
+            import uuid
+
+            from paddle_tpu.distributed import env
+
+            rec = {"rank": env.get_rank(), "ts": time.time(),
+                   "gen": uuid.uuid4().hex}
+            rec.update(payload)
+            store.set(self.key, json.dumps(rec))
+        except Exception:
+            pass
+
+    def read_baseline(self):
+        store = self.get_store()
+        if store is None:
+            return
+        try:
+            v = store.try_get(self.key)
+        except Exception:
+            return
+        self.baseline = v
+        self.baseline_read = True
+
+    def changed(self):
+        """The raw record iff it changed since the baseline, else None.
+        The first read only records the baseline."""
+        store = self.get_store()
+        if store is None:
+            return None
+        try:
+            v = store.try_get(self.key)
+        except Exception:
+            return None
+        if not self.baseline_read:
+            self.baseline = v
+            self.baseline_read = True
+            return None
+        if not v or v == self.baseline:
+            return None
+        return v
 
 
 class StepWatchdog:
@@ -57,16 +141,16 @@ class StepWatchdog:
         self._prober: Optional[threading.Thread] = None
         self._probe_q = None
         self.fired = False
-        self._store = None  # resolved lazily (False = last attempt failed)
-        self._store_retry_at = 0.0
+        self._abort_ch = _StoreChannel(ABORT_KEY)
         self._abort_polled = 0.0
-        # generation baseline: the abort record present when THIS process
-        # first looked (a leftover from a previous gang incarnation) —
-        # only a CHANGED record triggers the gang exit. Wall-clock-free,
-        # so cross-host clock skew cannot drop fresh aborts or replay
-        # stale ones.
-        self._abort_baseline = None
-        self._baseline_read = False
+
+    @property
+    def _store(self):
+        return self._abort_ch.store
+
+    @_store.setter
+    def _store(self, v):
+        self._abort_ch.store = v
 
     @property
     def timeout(self) -> float:
@@ -175,61 +259,16 @@ class StepWatchdog:
     # -- cross-rank abort (the comm_task_manager gang-abort role:
     # paddle/phi/core/distributed/comm_task_manager.cc aborts the whole
     # process group, not just the hung rank) -----------------------------
-    def _get_store(self):
-        if self._store not in (None, False):
-            return self._store
-        # a failed attempt is retried after a backoff — the distributed
-        # runtime often comes up AFTER the first step is armed, and a
-        # permanently cached failure would silently disable the abort
-        # broadcast for the life of the process
-        now = time.monotonic()
-        if self._store is False and now - self._store_retry_at < 10.0:
-            return None
-        self._store_retry_at = now
-        try:
-            from paddle_tpu.distributed.store import current_store
-
-            self._store = current_store() or False
-        except Exception:
-            self._store = False
-        return self._store or None
-
     def _post_abort(self, tags: str):
         """Broadcast 'rank R hung on tag T' so surviving ranks exit
         immediately instead of waiting out their own timeouts."""
-        store = self._get_store()
-        if store is None:
-            return
-        try:
-            import json
-            import uuid
-
-            from paddle_tpu.distributed import env
-
-            store.set(ABORT_KEY, json.dumps(
-                {"rank": env.get_rank(), "tags": tags,
-                 "timeout_s": self.timeout, "ts": time.time(),
-                 "gen": uuid.uuid4().hex}))
-        except Exception:
-            pass
+        self._abort_ch.post({"tags": tags, "timeout_s": self.timeout})
 
     def _check_remote_abort(self):
         if self.fired:
             return
-        store = self._get_store()
-        if store is None:
-            return
-        try:
-            v = store.try_get(ABORT_KEY)
-        except Exception:
-            return
-        if not self._baseline_read:
-            # first look: whatever is already there predates this
-            # process (a previous gang incarnation's record)
-            self._abort_baseline = v
-            self._baseline_read = True
-            return
-        if not v or v == self._abort_baseline:
+        v = self._abort_ch.changed()
+        if v is None:
             return
         import json
 
@@ -322,3 +361,118 @@ def watch_step(arrays, tag: str) -> None:
     wd = default_watchdog()
     if wd.enabled:
         wd.track(arrays, tag)
+
+
+# ---------------------------------------------------------------------------
+# preemption notice (SIGTERM) — the save-and-exit side of the restart loop
+# ---------------------------------------------------------------------------
+PREEMPT_KEY = "preempt_notice"
+
+
+class PreemptionMonitor:
+    """Turn a SIGTERM (cloud preemption notice, launcher shutdown) into a
+    flag the train loop polls between steps, and broadcast it through the
+    same gang store the watchdog uses for aborts — so ONE rank's notice
+    makes every rank take its final synchronous checkpoint and exit
+    together instead of leaving peers to die mid-collective.
+
+    The store record is generation-guarded exactly like the watchdog's
+    abort record: whatever is present on the first poll predates this
+    process (a previous incarnation's notice) and is ignored; only a
+    CHANGED record counts."""
+
+    def __init__(self):
+        self._flag = threading.Event()
+        self._installed = False
+        self._prev = {}
+        self._ch = _StoreChannel(PREEMPT_KEY)
+        self._last_poll = 0.0
+
+    @property
+    def _store(self):
+        return self._ch.store
+
+    @_store.setter
+    def _store(self, v):
+        self._ch.store = v
+
+    def install(self, signals=None):
+        """Chain our handler in front of any existing Python-level one.
+        Must run on the main thread (signal module rule); off it, the
+        local flag can still be set via :meth:`request` and peers'
+        notices still arrive through the store."""
+        import signal as _signal
+
+        if self._installed:
+            return self
+        sigs = tuple(signals) if signals else (_signal.SIGTERM,)
+
+        def handler(signum, frame):
+            self._flag.set()
+            self._post()
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
+        try:
+            for s in sigs:
+                self._prev[s] = _signal.signal(s, handler)
+            self._installed = True
+        except ValueError:
+            pass
+        # read the store baseline NOW, not on the first requested() poll:
+        # a peer's genuine notice posted during this process's long first
+        # compile must not be misfiled as a stale previous-incarnation
+        # record (lazy read remains the fallback when the store comes up
+        # later)
+        self._read_baseline()
+        return self
+
+    def uninstall(self):
+        import signal as _signal
+
+        for s, prev in self._prev.items():
+            try:
+                _signal.signal(s, prev if prev is not None
+                               else _signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass
+        self._prev = {}
+        self._installed = False
+
+    def request(self):
+        """Programmatic preemption (tests, schedulers draining a host)."""
+        self._flag.set()
+        self._post()
+
+    def requested(self) -> bool:
+        if self._flag.is_set():
+            return True
+        now = time.monotonic()
+        if now - self._last_poll < ABORT_POLL_S:
+            return False
+        self._last_poll = now
+        if self._check_remote():
+            self._flag.set()
+            return True
+        return False
+
+    # -- store plumbing (the shared watchdog/preemption record channel) --
+    def _post(self):
+        self._ch.post({})
+
+    def _read_baseline(self):
+        self._ch.read_baseline()
+
+    def _check_remote(self) -> bool:
+        return self._ch.changed() is not None
+
+
+_preempt: Optional[PreemptionMonitor] = None
+
+
+def preemption_monitor() -> PreemptionMonitor:
+    global _preempt
+    if _preempt is None:
+        _preempt = PreemptionMonitor()
+    return _preempt

@@ -128,21 +128,84 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Save model+optimizer every ``save_freq`` epochs (reference :550)."""
+    """Save model+optimizer every ``save_freq`` epochs (reference :550).
 
-    def __init__(self, save_freq=1, save_dir=None):
+    Default behavior is the reference's flat ``<epoch>.pdparams`` /
+    ``final.pdparams`` layout. Passing ``keep_last_n`` and/or
+    ``async_save`` delegates to
+    :class:`paddle_tpu.distributed.checkpoint.CheckpointManager`:
+    atomic committed ``step_<epoch>`` directories with retention, torn-
+    checkpoint GC, background IO, and ``restore_or_initialize``
+    auto-resume — the fault-tolerant path long runs should use."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_last_n=None,
+                 async_save=False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last_n = keep_last_n
+        self.async_save = async_save
+        self._manager = None
+        self._last_epoch = None
+        self._last_saved = None
+
+    def _use_manager(self):
+        return self.save_dir is not None and (
+            self.keep_last_n is not None or self.async_save)
+
+    def _get_manager(self):
+        if self._manager is None:
+            from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+            self._manager = CheckpointManager(
+                self.save_dir,
+                # async_save alone must not silently enable retention —
+                # the legacy path kept every epoch, so the manager does
+                # too unless the user asked for keep_last_n
+                keep_last_n=(self.keep_last_n if self.keep_last_n
+                             is not None else 10 ** 9),
+                async_save=self.async_save,
+                save_interval_steps=self.save_freq)
+        return self._manager
+
+    def _state(self):
+        state = {"model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None:
+            state["opt"] = opt.state_dict()
+        return state
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+        if not self.save_dir:
+            return
+        if self._use_manager():
+            self._last_epoch = epoch + 1
+            mgr = self._get_manager()
+            # don't build (and device-sync) the full state dict on
+            # epochs save() would skip anyway
+            if mgr.should_save(epoch + 1) and \
+                    mgr.save(epoch + 1, self._state()):
+                self._last_saved = epoch + 1
+            return
+        if (epoch + 1) % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch))
             self.model.save(path)
 
     def on_train_end(self, logs=None):
-        if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+        if not self.save_dir:
+            return
+        if self._use_manager():
+            mgr = self._get_manager()
+            if self._last_epoch is not None and \
+                    self._last_saved != self._last_epoch:
+                # the legacy path always saved 'final'; the manager path
+                # must not drop the trained result when the last epoch
+                # falls between save_freq boundaries
+                mgr.save(self._last_epoch, self._state(), block=True,
+                         force=True)
+            mgr.wait()  # surface any background failure
+            return
+        self.model.save(os.path.join(self.save_dir, "final"))
 
 
 class LRScheduler(Callback):

@@ -50,10 +50,18 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  accumulate_steps: int = 1, sharding=None, scaler=None,
-                 donate: bool = True):
+                 donate: bool = True, skip_nonfinite: bool = False):
         from paddle_tpu import amp as _amp
 
         self._donate = bool(donate)
+        # in-graph robustness guard: a NaN/Inf loss or grad turns the
+        # step into the identity update (params, slots, buffers and the
+        # step counter bit-identical to before; only the RNG chain
+        # advances) instead of poisoning the whole run — the compiled
+        # analog of the reference's FLAGS_check_nan_inf + skip. Skips
+        # are counted on device (no per-step host sync) and surfaced via
+        # ``skipped_steps`` / profiler.counters().
+        self._skip_nonfinite = bool(skip_nonfinite)
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
@@ -84,8 +92,9 @@ class TrainStep:
 
             def step_fn(n_inputs, carry, param_datas, slot_list,
                         buffer_datas, lr, scaler_state, *batch):
-                # (step, key) live on device: no per-step host transfer
-                step, chain = carry
+                # (step, key, nonfinite-skip count) live on device: no
+                # per-step host transfer
+                step, chain, nskip = carry
                 step = step + 1.0
                 chain, key = jax.random.split(chain)
                 scaling = scaler_state is not None
@@ -129,6 +138,16 @@ class TrainStep:
                     new_scaler_state = _amp.scaler_update_state(
                         self._scaler, scaler_state, found_inf)
 
+                nonfinite = None
+                if self._skip_nonfinite:
+                    # checked after unscaling (scaled-inf vs true inf)
+                    # and before clipping (global-norm clip of a NaN
+                    # grad would mask it as NaN-everywhere)
+                    nf = jnp.any(~jnp.isfinite(loss))
+                    for g in grads:
+                        nf = nf | jnp.any(~jnp.isfinite(g))
+                    nonfinite = nf
+
                 clip = optimizer._grad_clip
                 clip_fn = getattr(clip, "clip_fn", None)
                 if clip_fn is not None:
@@ -138,6 +157,8 @@ class TrainStep:
                 if found_inf is not None:
                     # skip update on overflow (reference GradScaler.step)
                     skip = found_inf
+                if nonfinite is not None:
+                    skip = nonfinite if skip is None else (skip | nonfinite)
                 if outcomes:
                     inval = ~valid
                     skip = inval if skip is None else (skip | inval)
@@ -166,18 +187,33 @@ class TrainStep:
                               for k, v in ns.items()}
                     new_params[i] = np_
                     new_slots[i] = ns
+                # a skipped/invalid run must leave carried state
+                # untouched (the rng chain still advances — a skipped
+                # draw is benign)
+                rollback = None
+                if nonfinite is not None:
+                    rollback = nonfinite
+                    # a guard-miss run is discarded and replayed, so only
+                    # the valid run counts its skip (no double count)
+                    nskip = nskip + jnp.where(nonfinite & valid, 1.0, 0.0)
                 if outcomes:
-                    # invalid run must leave carried state untouched (the
-                    # rng chain still advances — a skipped draw is benign)
-                    new_buffers = [jnp.where(valid, nb, ob) for nb, ob in
-                                   zip(new_buffers, buffer_datas)]
-                    step = jnp.where(valid, step, step - 1.0)
+                    inval = ~valid
+                    rollback = inval if rollback is None \
+                        else (rollback | inval)
+                    # only a guard miss rolls the scaler back (the step
+                    # will be replayed); a nonfinite skip must NOT — the
+                    # dynamic loss-scale schedule has to see the overflow
                     if new_scaler_state is not None:
                         new_scaler_state = tuple(
                             jnp.where(valid, nv, ov) for nv, ov in
                             zip(new_scaler_state, scaler_state))
-                return loss, (step, chain), new_params, new_slots, \
-                    new_buffers, new_scaler_state, valid
+                if rollback is not None:
+                    keep = ~rollback
+                    new_buffers = [jnp.where(keep, nb, ob) for nb, ob in
+                                   zip(new_buffers, buffer_datas)]
+                    step = jnp.where(keep, step, step - 1.0)
+                return loss, (step, chain, nskip), new_params, \
+                    new_slots, new_buffers, new_scaler_state, valid
 
             return step_fn
 
@@ -202,8 +238,30 @@ class TrainStep:
         # Adam-style bias correction right (see _sync_step_carry).
         self._carry = (jnp.asarray(float(optimizer._step_count),
                                    jnp.float32),
-                       gen.default_generator.next_key())
+                       gen.default_generator.next_key(),
+                       jnp.zeros((), jnp.float32))  # nonfinite skips
         self._host_step_mirror = optimizer._step_count
+        if self._skip_nonfinite:
+            import weakref
+
+            from paddle_tpu import profiler as _prof
+
+            ref = weakref.ref(self)
+            cname = f"train_step/nonfinite_skipped#{id(self)}"
+            _prof.register_counter_provider(
+                cname,
+                lambda: (None if ref() is None else ref().skipped_steps))
+            # counters() drops dead providers lazily, but an app that
+            # never reads counters must not leak one entry per TrainStep
+            weakref.finalize(self, _prof.unregister_counter_provider,
+                             cname)
+            # the host _step_count advances once per DISPATCH (schedulers
+            # need it eagerly), but a skipped step rolls the device step
+            # back — persist the applied count, or a checkpoint restore
+            # would jump bias-corrected rules ahead by the skips
+            optimizer._applied_step_provider = (
+                lambda: (None if ref() is None
+                         else int(np.asarray(ref()._carry[0]))))
         self._lr_val = None
         self._lr_arr = None
         self._wd_warm: dict = {}  # id(jitted) -> last batch shapes
@@ -272,8 +330,16 @@ class TrainStep:
         step so bias-corrected rules don't restart from step 1."""
         if self._opt._step_count != self._host_step_mirror:
             self._carry = (jnp.asarray(float(self._opt._step_count),
-                                       jnp.float32), self._carry[1])
+                                       jnp.float32),
+                           self._carry[1], self._carry[2])
             self._host_step_mirror = self._opt._step_count
+
+    @property
+    def skipped_steps(self) -> int:
+        """Steps the ``skip_nonfinite`` guard turned into identity
+        updates. Carried on device (no per-step sync); reading blocks on
+        the last dispatched step."""
+        return int(np.asarray(self._carry[2]))
 
     @staticmethod
     def _commit(d):

@@ -42,6 +42,12 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._slots: Dict[int, dict] = {}
         self._step_count = 0
+        # state_dict persists this callable's value as "step" when set:
+        # a compiled train loop with an in-graph skip guard advances
+        # _step_count per DISPATCH but rolls the device step back on a
+        # skipped update — the APPLIED count is what a restore must see
+        # (jit.TrainStep(skip_nonfinite=True) installs it; latest wins)
+        self._applied_step_provider = None
         self._multi_precision = bool(multi_precision)
         # ASP n:m sparsity enforcement (incubate/asp): id(param) -> 0/1
         # mask, re-applied after every update; call sites set
@@ -202,7 +208,12 @@ class Optimizer:
 
     # -- state dict --------------------------------------------------------
     def state_dict(self):
-        out = {"step": self._step_count}
+        step = self._step_count
+        if self._applied_step_provider is not None:
+            applied = self._applied_step_provider()
+            if applied is not None:
+                step = int(applied)
+        out = {"step": step}
         if self._lr_scheduler is not None:
             out["LR_Scheduler"] = self._lr_scheduler.state_dict()
         names = self._param_names()

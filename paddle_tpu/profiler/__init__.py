@@ -24,7 +24,9 @@ from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark", "estimate_mfu", "device_phases"]
+           "benchmark", "estimate_mfu", "device_phases",
+           "register_counter_provider", "unregister_counter_provider",
+           "counters"]
 
 
 class ProfilerState:
@@ -618,3 +620,38 @@ def estimate_mfu(flops_per_step: float, step_time_s: float,
     """Model FLOPs utilisation: achieved / peak."""
     peak = peak_flops or device_peak_flops()
     return flops_per_step / max(step_time_s, 1e-12) / peak
+
+
+# ---------------------------------------------------------------------------
+# observability counters (pull model: reading a counter may sync device
+# state, so providers are only invoked when counters() is called — never
+# per step)
+# ---------------------------------------------------------------------------
+_counter_providers: Dict[str, Callable] = {}
+
+
+def register_counter_provider(name: str, fn: Callable) -> None:
+    """Register a zero-arg callable whose value appears in
+    :func:`counters` under ``name``. Used by e.g. TrainStep's
+    ``skip_nonfinite`` guard to surface its device-carried skip count.
+    A provider returning None (dead weakref) is dropped."""
+    _counter_providers[name] = fn
+
+
+def unregister_counter_provider(name: str) -> None:
+    _counter_providers.pop(name, None)
+
+
+def counters() -> Dict[str, float]:
+    """Current values of every registered observability counter."""
+    out = {}
+    for name in list(_counter_providers):
+        try:
+            v = _counter_providers[name]()
+        except Exception:
+            continue
+        if v is None:  # provider's subject was garbage-collected
+            _counter_providers.pop(name, None)
+            continue
+        out[name] = v
+    return out

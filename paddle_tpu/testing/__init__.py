@@ -1,0 +1,11 @@
+"""Test-support utilities shipped with the package so downstream test
+suites can reuse them (reference: paddle's test/legacy_test helpers are
+importable from installed wheels).
+
+``paddle_tpu.testing.faults`` is the fault-injection harness backing the
+fault-tolerance tests (crash/raise/sleep at named points inside the
+checkpoint writer, torn-file helpers, child-process killers).
+"""
+from paddle_tpu.testing import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,222 @@
+"""Fault-injection harness for robustness tests.
+
+Production code calls :func:`fire` at named fault points (the checkpoint
+writer's commit protocol, etc.). With no faults installed the call is a
+dict lookup on an empty dict — effectively free — so the hooks stay in
+production code permanently, the same way the reference keeps
+FLAGS-gated fault hooks compiled into comm_task_manager.
+
+Faults are installed either programmatically (:func:`install`, or the
+:func:`injected` context manager) or through the ``PADDLE_FAULTS``
+environment variable, which is how subprocess end-to-end tests tell a
+worker where to die. Spec grammar (specs separated by ``;``)::
+
+    point:action[:arg][@skip][*times]
+
+    ckpt.data_written:raise            raise OSError at every hit
+    ckpt.before_marker:crash@2         os._exit on the 3rd hit
+    ckpt.data_written:sleep:60*1       sleep 60s, first hit only
+    ckpt.data_written:touch:/tmp/f     create /tmp/f and continue
+
+``@skip`` ignores the first N hits; ``*times`` fires at most N times.
+Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
+the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
+``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test
+process knows the point was reached), ``sigterm`` (deliver SIGTERM to
+the current process).
+"""
+from __future__ import annotations
+
+import os
+import re
+import signal
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FAULT_EXIT", "Fault", "FaultInjector", "fire", "install", "clear",
+    "injected", "active_injector", "tear_file", "child_pids",
+    "kill_one_child", "wait_for_path",
+]
+
+# exit code for the "crash" action: distinct from every code the runtime
+# uses (watchdog 6, gang-abort 7, launch re-form 75) so tests can assert
+# the process died AT the injected point and not from collateral damage
+FAULT_EXIT = 41
+
+ENV_VAR = "PADDLE_FAULTS"
+
+_SPEC_RE = re.compile(
+    r"^(?P<point>[^:@*]+):(?P<action>[^:@*]+)"
+    r"(?::(?P<arg>[^@*]*))?(?:@(?P<skip>\d+))?(?:\*(?P<times>\d+))?$")
+
+
+class Fault:
+    """One installed fault: where to fire, what to do, and how often."""
+
+    def __init__(self, point: str, action: str, arg: Optional[str] = None,
+                 skip: int = 0, times: Optional[int] = None):
+        self.point = point
+        self.action = action
+        self.arg = arg
+        self.skip = int(skip)
+        self.times = times  # None = unlimited
+        self.hits = 0       # calls that reached the point
+        self.fired = 0      # calls that actually performed the action
+
+    @staticmethod
+    def parse(spec: str) -> "Fault":
+        m = _SPEC_RE.match(spec.strip())
+        if m is None:
+            raise ValueError(f"bad fault spec {spec!r} "
+                             f"(want point:action[:arg][@skip][*times])")
+        return Fault(m["point"], m["action"], m["arg"],
+                     int(m["skip"] or 0),
+                     None if m["times"] is None else int(m["times"]))
+
+    def _perform(self):
+        if self.action == "crash":
+            # hard death: no cleanup, buffered IO lost — what SIGKILL or
+            # a power cut does to a half-written checkpoint
+            os._exit(FAULT_EXIT)
+        if self.action == "raise":
+            raise OSError(f"injected fault at {self.point!r}")
+        if self.action == "sleep":
+            time.sleep(float(self.arg or 1.0))
+            return
+        if self.action == "touch":
+            with open(self.arg, "w") as f:
+                f.write(f"{self.point}\n")
+            return
+        if self.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        raise ValueError(f"unknown fault action {self.action!r}")
+
+    def fire(self):
+        self.hits += 1
+        if self.hits <= self.skip:
+            return
+        if self.times is not None and self.fired >= self.times:
+            return
+        self.fired += 1
+        self._perform()
+
+
+class FaultInjector:
+    def __init__(self, spec: str = ""):
+        self._by_point: Dict[str, List[Fault]] = {}
+        for part in (spec or "").split(";"):
+            if part.strip():
+                self.add(Fault.parse(part))
+
+    def add(self, fault: Fault) -> Fault:
+        self._by_point.setdefault(fault.point, []).append(fault)
+        return fault
+
+    def faults(self, point: Optional[str] = None) -> List[Fault]:
+        if point is not None:
+            return list(self._by_point.get(point, []))
+        return [f for fs in self._by_point.values() for f in fs]
+
+    def fire(self, point: str):
+        for f in self._by_point.get(point, ()):
+            f.fire()
+
+
+_active = FaultInjector(os.environ.get(ENV_VAR, ""))
+
+
+def active_injector() -> FaultInjector:
+    return _active
+
+
+def fire(point: str):
+    """Production-side hook: perform any fault installed at ``point``."""
+    if _active._by_point:
+        _active.fire(point)
+
+
+def install(spec: str) -> FaultInjector:
+    """Replace the active injector with one parsed from ``spec``;
+    returns it (so tests can read per-fault hit counters)."""
+    global _active
+    _active = FaultInjector(spec)
+    return _active
+
+
+def clear():
+    global _active
+    _active = FaultInjector("")
+
+
+class injected:
+    """Context manager: install ``spec`` for the block, restore after.
+
+    >>> with faults.injected("ckpt.data_written:raise"):
+    ...     save_state_dict(state, path)   # dies mid-write
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.injector: Optional[FaultInjector] = None
+
+    def __enter__(self) -> FaultInjector:
+        global _active
+        self._prev = _active
+        self.injector = _active = FaultInjector(self.spec)
+        return self.injector
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._prev
+        return False
+
+
+# -- test-side helpers (no production callers) ----------------------------
+def tear_file(path: str, frac: float = 0.5):
+    """Truncate ``path`` to ``frac`` of its size — a torn write, the
+    on-disk state a crash mid-``write()`` leaves behind."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, int(size * frac)))
+
+
+def child_pids(pid: Optional[int] = None) -> List[int]:
+    """Direct children of ``pid`` (default: this process), via /proc."""
+    ppid = os.getpid() if pid is None else pid
+    out = []
+    for d in os.listdir("/proc"):
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat") as f:
+                fields = f.read().rsplit(")", 1)[1].split()
+            if int(fields[1]) == ppid:  # field 4 overall = ppid
+                out.append(int(d))
+        except (OSError, IndexError, ValueError):
+            continue
+    return sorted(out)
+
+
+def kill_one_child(sig: int = signal.SIGKILL,
+                   pid: Optional[int] = None) -> Optional[int]:
+    """SIGKILL one (the newest) child process — the injector for
+    'DataLoader worker killed by the OOM killer'. Returns the pid killed,
+    or None if there were no children."""
+    kids = child_pids(pid)
+    if not kids:
+        return None
+    victim = kids[-1]
+    os.kill(victim, sig)
+    return victim
+
+
+def wait_for_path(path: str, timeout: float = 30.0) -> bool:
+    """Poll until ``path`` exists (a ``touch`` fault's progress marker)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.02)
+    return False

@@ -1,0 +1,94 @@
+"""Fault-tolerant training worker (driven by tests/test_fault_e2e.py).
+
+Trains a tiny model with CheckpointManager auto-resume, saving every
+``SAVE_EVERY`` steps. The driving test injects faults through
+PADDLE_FAULTS (see paddle_tpu/testing/faults.py), SIGKILLs this process
+mid-write, or SIGTERMs it to exercise the preemption save-and-exit path,
+then re-runs it to prove resume lands on the last COMMITTED step.
+
+Env protocol:
+  CKPT_ROOT      checkpoint directory (required)
+  TOTAL_STEPS    stop after this step (default 6)
+  SAVE_EVERY     save interval in steps (default 1)
+  STEP_SLEEP     host sleep per step, widens signal windows (default 0)
+  RESULT_FILE    json written on clean exit:
+                 {resumed_from, final_step, committed, preempted_at}
+  PROGRESS_FILE  rewritten with the current step number every step
+  INSTALL_PREEMPT=1  install the SIGTERM preemption handler
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+root = os.environ["CKPT_ROOT"]
+total = int(os.environ.get("TOTAL_STEPS", "6"))
+save_every = int(os.environ.get("SAVE_EVERY", "1"))
+step_sleep = float(os.environ.get("STEP_SLEEP", "0"))
+result_file = os.environ.get("RESULT_FILE")
+progress_file = os.environ.get("PROGRESS_FILE")
+
+paddle.seed(0)
+m = nn.Linear(4, 4)
+opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+train = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+
+mgr = CheckpointManager(root, keep_last_n=2, async_save=True,
+                        save_interval_steps=save_every)
+if os.environ.get("INSTALL_PREEMPT"):
+    mgr.install_preemption_handler()
+
+# state template AFTER TrainStep init so optimizer slots exist; restore
+# fills the live param/slot arrays in place and set_state_dict pushes
+# the step counter back so Adam bias correction resumes correctly
+state = {"model": m.state_dict(), "opt": opt.state_dict()}
+resumed_from = mgr.restore_or_initialize(state)
+if resumed_from is not None:
+    opt.set_state_dict(state["opt"])
+start = resumed_from or 0
+
+rng = np.random.default_rng(42)
+X = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+Y = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+
+
+def write_result(extra):
+    if result_file:
+        payload = {"resumed_from": resumed_from, "committed":
+                   mgr.all_steps(), "opt_step": int(opt._step_count),
+                   **extra}
+        with open(result_file + ".tmp", "w") as f:
+            json.dump(payload, f)
+        os.replace(result_file + ".tmp", result_file)
+
+
+step = start
+for step in range(start + 1, total + 1):
+    train(X, Y)
+    mgr.save(step, {"model": m.state_dict(), "opt": opt.state_dict()})
+    if progress_file:
+        with open(progress_file, "w") as f:
+            f.write(str(step))
+    if step_sleep:
+        time.sleep(step_sleep)
+    if mgr.reached_preemption(step):
+        mgr.save(step, {"model": m.state_dict(),
+                        "opt": opt.state_dict()},
+                 block=True, force=True)
+        write_result({"preempted_at": step, "final_step": step})
+        print(f"PREEMPTED_SAVED step={step}", flush=True)
+        sys.exit(0)
+
+mgr.wait()
+write_result({"final_step": step})
+print(f"CKPT_WORKER_DONE step={step}", flush=True)

@@ -1,0 +1,155 @@
+"""End-to-end fault-injection proofs (subprocess save→kill→resume).
+
+Acceptance pins for the fault-tolerant training layer:
+  * SIGKILL during an async checkpoint write leaves the previous
+    committed checkpoint intact and ``restore_or_initialize`` resumes
+    from it at the correct step;
+  * SIGTERM mid-run produces a final committed checkpoint before a
+    clean (rc 0) exit — directly and through the launcher's forwarding.
+
+Slow-marked: each scenario boots a fresh interpreter (jax import).
+The fast in-process protocol tests live in test_faults.py.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(os.path.dirname(__file__), "mp_scripts")
+WORKER = os.path.join(SCRIPTS, "ckpt_train_worker.py")
+
+pytestmark = pytest.mark.slow
+
+
+def _env(tmp_path, **kw):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "CKPT_ROOT": str(tmp_path / "ckpt"),
+        "RESULT_FILE": str(tmp_path / "result.json"),
+        "PROGRESS_FILE": str(tmp_path / "progress"),
+    })
+    env.update({k: str(v) for k, v in kw.items()})
+    return env
+
+
+def test_sigkill_during_async_write_resumes_from_committed(tmp_path):
+    """Kill -9 while the async writer is mid-checkpoint: the torn step
+    must be invisible to resume, which continues from the last COMMITTED
+    step and finishes the run."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    marker = str(tmp_path / "in_write")
+    # 3rd save (step 3): mark progress, then stall mid-write so the
+    # parent can SIGKILL at the worst possible moment — data written,
+    # commit not reached
+    env = _env(
+        tmp_path, TOTAL_STEPS=6,
+        PADDLE_FAULTS=f"ckpt.data_written:touch:{marker}@2;"
+                      f"ckpt.data_written:sleep:120@2")
+    p = subprocess.Popen([sys.executable, WORKER], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        assert faults.wait_for_path(marker, timeout=120), \
+            "worker never reached the injected write stall"
+        p.send_signal(signal.SIGKILL)
+    finally:
+        p.wait(timeout=30)
+    assert p.returncode == -signal.SIGKILL
+
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, async_save=False)
+    # previous committed checkpoint intact; the torn write invisible
+    assert mgr.latest_step() == 2
+    assert os.path.exists(os.path.join(root, "step_2", "COMMITTED"))
+    leftovers = [d for d in os.listdir(root) if d != "step_1"
+                 and not d.startswith("step_2")]
+    assert all(not os.path.exists(os.path.join(root, d, "COMMITTED"))
+               for d in leftovers), leftovers
+
+    # resume run, no faults: must pick up at step 2 and finish
+    out = subprocess.run([sys.executable, WORKER],
+                         env=_env(tmp_path, TOTAL_STEPS=6),
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.load(open(tmp_path / "result.json"))
+    assert result["resumed_from"] == 2
+    assert result["final_step"] == 6
+    assert result["opt_step"] == 6  # optimizer counter resumed, not reset
+    assert result["committed"] == [5, 6]  # keep_last_n=2 + torn GC'd
+    assert sorted(os.listdir(root)) == ["step_5", "step_6"]
+
+
+def test_sigterm_produces_final_committed_checkpoint(tmp_path):
+    """SIGTERM mid-run: the preemption handler triggers a final
+    synchronous committed save and a clean rc-0 exit."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    env = _env(tmp_path, TOTAL_STEPS=100000, STEP_SLEEP="0.05",
+               SAVE_EVERY=100000, INSTALL_PREEMPT=1)
+    p = subprocess.Popen([sys.executable, WORKER], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        assert faults.wait_for_path(str(tmp_path / "progress"),
+                                    timeout=240)
+        time.sleep(0.3)  # let a few steps pass
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+    finally:
+        p.kill()
+    assert p.returncode == 0, out
+    assert "PREEMPTED_SAVED" in out
+    result = json.load(open(tmp_path / "result.json"))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    # the ONLY save of the run is the preemption one (interval 100000)
+    assert mgr.latest_step() == result["preempted_at"] > 0
+    st = None  # restore proves the final checkpoint is readable
+    import numpy as np  # noqa: F401  (paddle import below needs numpy)
+    import paddle_tpu as paddle
+
+    st = {"model": {"weight": paddle.zeros([4, 4]),
+                    "bias": paddle.zeros([4])},
+          "opt": {"step": 0}}
+    assert mgr.restore(st) == result["preempted_at"]
+    assert st["opt"]["step"] == result["preempted_at"]
+
+
+def test_launcher_forwards_sigterm_for_final_save(tmp_path):
+    """The distributed launcher is the process the cloud signals:
+    SIGTERM to it must fan out to workers, wait for their final save,
+    and exit 0 without restarting the gang."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    env = _env(tmp_path, TOTAL_STEPS=100000, STEP_SLEEP="0.05",
+               SAVE_EVERY=100000, INSTALL_PREEMPT=1)
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restart", "3",
+         "--stop_timeout", "60", WORKER],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        assert faults.wait_for_path(str(tmp_path / "progress"),
+                                    timeout=240)
+        time.sleep(0.3)
+        launcher.send_signal(signal.SIGTERM)
+        out, _ = launcher.communicate(timeout=120)
+    finally:
+        launcher.kill()
+    # clean exit, no restart attempted despite --max_restart
+    assert launcher.returncode == 0, out
+    assert "forwarding to workers" in out
+    result = json.load(open(tmp_path / "result.json"))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.latest_step() == result["preempted_at"] > 0

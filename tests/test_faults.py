@@ -1,0 +1,670 @@
+"""Fault-tolerance layer, fast (in-process) tier-1 tests.
+
+Covers: atomic save_state_dict staging, manifest validation, the
+CheckpointManager commit/retention/retry protocol, the TrainStep
+skip_nonfinite guard's bit-identity pins, the GradScaler divergence
+guard, preemption signalling, and the DataLoader killed-worker path.
+End-to-end subprocess kill/resume proofs live in test_fault_e2e.py
+(slow-marked); the injectors here come from paddle_tpu.testing.faults.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(os.path.dirname(__file__), "mp_scripts")
+
+
+# ---------------------------------------------------------------------------
+# fault-injector harness itself
+# ---------------------------------------------------------------------------
+def test_fault_spec_parsing():
+    f = faults.Fault.parse("ckpt.data_written:sleep:2.5@1*3")
+    assert (f.point, f.action, f.arg, f.skip, f.times) == \
+        ("ckpt.data_written", "sleep", "2.5", 1, 3)
+    with pytest.raises(ValueError):
+        faults.Fault.parse("nonsense")
+
+
+def test_fault_skip_and_times():
+    with faults.injected("p:raise@1*1") as inj:
+        faults.fire("p")  # skipped
+        with pytest.raises(OSError):
+            faults.fire("p")
+        faults.fire("p")  # times exhausted
+        assert inj.faults("p")[0].hits == 3
+        assert inj.faults("p")[0].fired == 1
+    faults.fire("p")  # injector restored: no-op
+
+
+# ---------------------------------------------------------------------------
+# satellite: atomic save_state_dict
+# ---------------------------------------------------------------------------
+def test_save_crash_midwrite_keeps_old_checkpoint(tmp_path):
+    """A save that dies mid-write must leave the previous checkpoint at
+    ``path`` fully readable (staging + rename, never in-place)."""
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"x": paddle.ones([4])}, p)
+    with faults.injected("ckpt.data_written:raise"):
+        with pytest.raises(OSError):
+            ckpt.save_state_dict({"x": paddle.zeros([4])}, p)
+    y = paddle.zeros([4])
+    ckpt.load_state_dict({"x": y}, p)
+    np.testing.assert_array_equal(y.numpy(), np.ones(4, np.float32))
+    # and a later save recovers despite the leftover staging dir
+    ckpt.save_state_dict({"x": paddle.full([4], 7.0)}, p)
+    ckpt.load_state_dict({"x": y}, p)
+    np.testing.assert_array_equal(y.numpy(), np.full(4, 7.0, np.float32))
+
+
+def test_save_never_tears_destination(tmp_path):
+    """Even a crash at the commit point leaves either the old or the new
+    checkpoint at ``path`` — never a half-written mix."""
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"x": paddle.ones([4])}, p)
+    files_before = sorted(os.listdir(p))
+    with faults.injected("ckpt.before_commit:raise"):
+        with pytest.raises(OSError):
+            ckpt.save_state_dict({"x": paddle.zeros([4])}, p)
+    assert sorted(os.listdir(p)) == files_before
+
+
+# ---------------------------------------------------------------------------
+# satellite: manifest validation
+# ---------------------------------------------------------------------------
+def test_missing_chunk_file_named_error(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": paddle.ones([2, 2])}, p)
+    os.remove(os.path.join(p, "data_0.npz"))
+    with pytest.raises(ValueError, match="'w'.*missing"):
+        ckpt.load_state_dict({"w": paddle.zeros([2, 2])}, p)
+
+
+def test_manifest_coverage_hole_named_error(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": paddle.ones([4, 4])}, p)
+    mpath = os.path.join(p, "metadata.json")
+    meta = json.load(open(mpath))
+    # shrink the chunk so it no longer tiles the global shape
+    meta["tensors"]["w"]["chunks"][0]["local_shape"] = [2, 4]
+    json.dump(meta, open(mpath, "w"))
+    with pytest.raises(ValueError, match="'w'.*coverage hole"):
+        ckpt.load_state_dict({"w": paddle.zeros([4, 4])}, p)
+
+
+def test_torn_npz_key_named_error(tmp_path):
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": paddle.ones([2, 2])}, p)
+    # replace the data file with one missing the tensor's key
+    np.savez(os.path.join(p, "data_0.npz"), other=np.zeros(1))
+    with pytest.raises(ValueError, match="'w'"):
+        ckpt.load_state_dict({"w": paddle.zeros([2, 2])}, p)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: CheckpointManager
+# ---------------------------------------------------------------------------
+def _mgr_state(value=1.0):
+    return {"x": paddle.full([4], value)}
+
+
+def test_manager_commit_latest_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    assert mgr.latest_step() is None
+    assert mgr.restore_or_initialize(_mgr_state()) is None
+    mgr.save(1, _mgr_state(1.0), block=True)
+    mgr.save(2, _mgr_state(2.0), block=True)
+    assert mgr.all_steps() == [1, 2]
+    marker = json.load(open(tmp_path / "step_2" / "COMMITTED"))
+    assert marker["step"] == 2
+    st = _mgr_state(0.0)
+    assert mgr.restore_or_initialize(st) == 2
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 2.0, np.float32))
+
+
+def test_manager_async_save_and_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=3)
+    assert mgr.save(1, _mgr_state())
+    mgr.wait()
+    assert mgr.latest_step() == 1
+
+
+def test_manager_save_interval(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=3)
+    assert not mgr.save(1, _mgr_state())
+    assert not mgr.save(2, _mgr_state())
+    assert mgr.save(3, _mgr_state(), block=True)
+    assert mgr.save(5, _mgr_state(), block=True, force=True)
+    assert mgr.all_steps() == [3, 5]
+
+
+def test_manager_retention_gc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for s in (1, 2, 3):
+        mgr.save(s, _mgr_state(float(s)), block=True)
+    assert mgr.all_steps() == [2, 3]
+    assert sorted(os.listdir(tmp_path)) == ["step_2", "step_3"]
+
+
+def test_manager_skips_and_gcs_uncommitted(tmp_path):
+    """A torn step dir (no COMMITTED marker — a SIGKILL mid-commit) is
+    never restored from and is garbage-collected by the next save."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=3)
+    mgr.save(5, _mgr_state(5.0), block=True)
+    torn = tmp_path / "step_7"
+    torn.mkdir()
+    (torn / "data_0.npz").write_bytes(b"half a npz")
+    stale = tmp_path / "step_9.tmp"
+    stale.mkdir()
+    assert mgr.latest_step() == 5
+    st = _mgr_state(0.0)
+    assert mgr.restore_or_initialize(st) == 5
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 5.0, np.float32))
+    mgr.save(8, _mgr_state(8.0), block=True)
+    assert sorted(os.listdir(tmp_path)) == ["step_5", "step_8"]
+
+
+def test_manager_resave_same_step_preserves_committed(tmp_path):
+    """Re-saving an already-committed step (the forced preemption save
+    after an async one) must never delete the committed copy before the
+    rewrite has fully landed."""
+    mgr = CheckpointManager(str(tmp_path), max_retries=0)
+    mgr.save(1, _mgr_state(1.0), block=True)
+    mgr.save(1, _mgr_state(1.5), block=True, force=True)  # clean re-save
+    st = _mgr_state(0.0)
+    assert mgr.restore(st, step=1) == 1
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 1.5, np.float32))
+    # crash between the rewrite and its marker: the old committed bytes
+    # survive on disk (parked at step_1.old), nothing is half-written
+    with faults.injected("ckpt.before_marker:raise"):
+        with pytest.raises(OSError):
+            mgr.save(1, _mgr_state(2.0), block=True, force=True)
+    assert os.path.exists(tmp_path / "step_1.old" / "COMMITTED")
+    # a restarted process (fresh manager) recovers the parked copy
+    mgr2 = CheckpointManager(str(tmp_path), max_retries=0)
+    assert mgr2.latest_step() == 1
+    st = _mgr_state(0.0)
+    assert mgr2.restore(st, step=1) == 1
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 1.5, np.float32))
+    mgr2.save(2, _mgr_state(2.0), block=True)
+    assert sorted(os.listdir(tmp_path)) == ["step_1", "step_2"]
+
+
+def test_save_recovers_checkpoint_parked_at_old(tmp_path):
+    """save_state_dict crash window between its two commit renames: the
+    complete checkpoint at <path>.old is recovered, not deleted."""
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"x": paddle.ones([4])}, p)
+    os.rename(p, p + ".old")  # the state a crash at that instant leaves
+    ckpt.save_state_dict({"x": paddle.full([4], 2.0)}, p)
+    y = paddle.zeros([4])
+    ckpt.load_state_dict({"x": y}, p)
+    np.testing.assert_array_equal(y.numpy(), np.full(4, 2.0, np.float32))
+    assert not os.path.exists(p + ".old")
+
+
+def test_manager_retry_never_deletes_parked_committed(tmp_path):
+    """A FAILED re-save attempt leaves a torn ``step_N`` and the
+    committed copy parked at ``step_N.old``; the retry (and any later
+    failure) must drop only the torn dir — never the parked bytes."""
+    mgr = CheckpointManager(str(tmp_path), max_retries=1,
+                            backoff_base=0.01)
+    mgr.save(1, _mgr_state(1.5), block=True)
+    # every attempt dies between the rewrite and its marker
+    with faults.injected("ckpt.before_marker:raise"):
+        with pytest.raises(OSError):
+            mgr.save(1, _mgr_state(9.0), block=True, force=True)
+    assert os.path.exists(tmp_path / "step_1.old" / "COMMITTED")
+    mgr2 = CheckpointManager(str(tmp_path))  # recovers the parked copy
+    st = _mgr_state(0.0)
+    assert mgr2.restore_or_initialize(st) == 1
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 1.5, np.float32))
+
+
+def test_overlapping_chunks_cannot_mask_coverage_hole(tmp_path):
+    """Overlapping chunks whose volumes SUM past the global size but
+    leave an element uncovered must still raise — a summed coverage
+    check would pass and return uninitialized np.empty memory."""
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": paddle.ones([5])}, p)
+    mpath = os.path.join(p, "metadata.json")
+    meta = json.load(open(mpath))
+    chunk = meta["tensors"]["w"]["chunks"][0]
+    c0 = dict(chunk, global_offset=[0], local_shape=[3])
+    c1 = dict(chunk, global_offset=[1], local_shape=[3])
+    meta["tensors"]["w"]["chunks"] = [c0, c1]  # union [0,4): hole at 4
+    json.dump(meta, open(mpath, "w"))
+    with pytest.raises(ValueError, match="'w'.*coverage hole"):
+        ckpt.load_state_dict({"w": paddle.zeros([5])}, p)
+
+
+def test_load_recovers_checkpoint_parked_at_old(tmp_path):
+    """A restart that only LOADS (no save first) after a crash between
+    save_state_dict's two commit renames must still find the complete
+    checkpoint parked at <path>.old."""
+    p = str(tmp_path / "ck")
+    ckpt.save_state_dict({"x": paddle.full([4], 3.0)}, p)
+    os.rename(p, p + ".old")  # the state a crash at that instant leaves
+    y = paddle.zeros([4])
+    ckpt.load_state_dict({"x": y}, p)
+    np.testing.assert_array_equal(y.numpy(), np.full(4, 3.0, np.float32))
+    assert os.path.isdir(p) and not os.path.exists(p + ".old")
+
+
+def test_save_refuses_to_replace_non_checkpoint_dir(tmp_path):
+    """The atomic commit replaces ``path`` wholesale — a populated
+    directory that is NOT a checkpoint (user logs, configs) must be
+    refused, never silently deleted."""
+    p = str(tmp_path / "run_dir")
+    os.makedirs(p)
+    with open(os.path.join(p, "config.yaml"), "w") as f:
+        f.write("lr: 0.1\n")
+    with pytest.raises(ValueError, match="refusing to replace"):
+        ckpt.save_state_dict({"x": paddle.ones([2])}, p)
+    assert os.path.exists(os.path.join(p, "config.yaml"))
+    # an innocent sibling named <path>.old is protected the same way
+    p2 = str(tmp_path / "job")
+    os.makedirs(p2 + ".old")
+    with open(os.path.join(p2 + ".old", "notes.txt"), "w") as f:
+        f.write("keep me\n")
+    with pytest.raises(ValueError, match="refusing to replace"):
+        ckpt.save_state_dict({"x": paddle.ones([2])}, p2)
+    assert os.path.exists(os.path.join(p2 + ".old", "notes.txt"))
+
+
+def test_nonnumeric_state_travels_in_sidecar(tmp_path):
+    """Scheduler-style string state (e.g. ReduceOnPlateau's mode='min')
+    must round-trip through save/load instead of crashing jnp.asarray —
+    it rides in the objects.json sidecar, not the chunk format."""
+    p = str(tmp_path / "ck")
+    state = {"w": paddle.ones([3]),
+             "opt": {"step": 4,
+                     "LR_Scheduler": {"mode": "min", "factor": 0.5,
+                                      "threshold_mode": "rel"}}}
+    ckpt.save_state_dict(state, p)
+    assert os.path.exists(os.path.join(p, "objects.json"))
+    dst = {"w": paddle.zeros([3]),
+           "opt": {"step": 0,
+                   "LR_Scheduler": {"mode": "max", "factor": 0.0,
+                                    "threshold_mode": "abs"}}}
+    ckpt.load_state_dict(dst, p)
+    np.testing.assert_array_equal(dst["w"].numpy(),
+                                  np.ones(3, np.float32))
+    assert dst["opt"]["step"] == 4
+    assert dst["opt"]["LR_Scheduler"] == {"mode": "min", "factor": 0.5,
+                                          "threshold_mode": "rel"}
+
+
+def test_manager_keep_last_n_floor(tmp_path):
+    """keep_last_n is clamped to >= 1: retention must never be silently
+    disabled (committed[:-0] would classify nothing as stale) and never
+    delete the only resumable checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=0)
+    for s in (1, 2, 3):
+        mgr.save(s, _mgr_state(float(s)), block=True)
+    assert mgr.all_steps() == [3]
+    assert sorted(os.listdir(tmp_path)) == ["step_3"]
+
+
+def test_manager_barrier_namespace_advances(tmp_path):
+    """Every save gets a fresh store-barrier namespace — a reused tag
+    would release peers out of a PREVIOUS save's counters (FileStore
+    counters persist; the coordination service rejects reused ids)."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _mgr_state(), block=True)
+    seq1 = mgr._seq
+    mgr.save(1, _mgr_state(), block=True, force=True)  # same-step re-save
+    assert mgr._seq > seq1
+    assert mgr._ns_prefix.startswith("r")
+
+
+def test_manager_refuses_uncommitted_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _mgr_state(), block=True)
+    os.remove(tmp_path / "step_1" / "COMMITTED")
+    with pytest.raises(ValueError, match="COMMITTED"):
+        mgr.restore(_mgr_state(), step=1)
+
+
+def test_manager_retry_with_backoff(tmp_path):
+    """Transient filesystem errors are retried with exponential backoff;
+    persistent ones surface after max_retries attempts."""
+    mgr = CheckpointManager(str(tmp_path), max_retries=3,
+                            backoff_base=0.01)
+    with faults.injected("ckpt.data_written:raise*2") as inj:
+        mgr.save(1, _mgr_state(), block=True)
+    assert inj.faults()[0].fired == 2  # two failures, third attempt won
+    assert mgr.latest_step() == 1
+    with faults.injected("ckpt.data_written:raise"):
+        with pytest.raises(OSError, match="after 4 attempts"):
+            mgr.save(2, _mgr_state(), block=True)
+    assert mgr.latest_step() == 1  # failed save committed nothing
+
+
+def test_manager_async_error_surfaces_on_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_retries=0,
+                            backoff_base=0.01)
+    with faults.injected("ckpt.data_written:raise"):
+        mgr.save(1, _mgr_state())
+        with pytest.raises(OSError):
+            mgr.wait()
+    mgr.save(2, _mgr_state(), block=True)  # manager still usable
+    assert mgr.latest_step() == 2
+
+
+def test_manager_trainstep_resume_roundtrip(tmp_path):
+    """Model+optimizer resume through the manager: restored params and
+    slots are bit-identical and training continues from the right step."""
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    train = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+    X, Y = paddle.randn([8, 4]), paddle.randn([8, 4])
+    train(X, Y)
+    train(X, Y)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, {"model": m.state_dict(), "opt": opt.state_dict()},
+             block=True)
+    ref_w = m.weight.numpy().copy()
+
+    paddle.seed(9)
+    m2 = nn.Linear(4, 4)
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+    paddle.jit.TrainStep(m2, nn.MSELoss(), opt2)  # materialize slots
+    st = {"model": m2.state_dict(), "opt": opt2.state_dict()}
+    assert mgr.restore_or_initialize(st) == 2
+    opt2.set_state_dict(st["opt"])
+    np.testing.assert_array_equal(m2.weight.numpy(), ref_w)
+    assert opt2._step_count == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption signalling
+# ---------------------------------------------------------------------------
+def test_preemption_monitor_sigterm_sets_flag(tmp_path):
+    from paddle_tpu.distributed.watchdog import PreemptionMonitor
+
+    mon = PreemptionMonitor()
+    mon._store = False  # no store in this test
+    mon.install()
+    try:
+        assert not mon.requested()
+        signal.raise_signal(signal.SIGTERM)
+        assert mon.requested()
+    finally:
+        mon.uninstall()
+
+
+def test_preemption_broadcasts_through_store(tmp_path):
+    """One rank's notice reaches peers via the gang store; a stale
+    record from a previous incarnation does not (generation baseline)."""
+    from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.distributed.watchdog import PreemptionMonitor
+
+    store = FileStore(str(tmp_path))
+    a, b = PreemptionMonitor(), PreemptionMonitor()
+    a._store = b._store = store
+    b._last_poll = -1e9
+    assert not b.requested()   # first poll records the (empty) baseline
+    a.request()
+    b._last_poll = -1e9        # bypass the poll rate limit
+    assert b.requested()
+
+
+def test_preemption_baseline_read_eagerly_at_install(tmp_path):
+    """A peer's notice posted BEFORE this rank's first poll (e.g. during
+    a long first compile) must still be seen: the stale-record baseline
+    is read at install time, not lazily on the first poll."""
+    from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.distributed.watchdog import PreemptionMonitor
+
+    store = FileStore(str(tmp_path))
+    store.set("preempt_notice", b'{"rank": 9, "gen": "previous-run"}')
+    a, b = PreemptionMonitor(), PreemptionMonitor()
+    a._store = b._store = store
+    b._read_baseline()          # what install() does
+    a.request()                 # peer preempted before b ever polled
+    b._last_poll = -1e9
+    assert b.requested()
+
+
+def test_manager_preemption_forces_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    assert not mgr.should_save(7)
+    mon = mgr.install_preemption_handler()
+    try:
+        mon._store = False
+        mon.request()
+        assert mgr.reached_preemption(7)
+        assert mgr.should_save(7)  # interval is overridden
+        mgr.save(7, _mgr_state(), block=True, force=True)
+        assert mgr.latest_step() == 7
+    finally:
+        mon.uninstall()
+        mon._flag.clear()  # module singleton: don't leak into other tests
+
+
+# ---------------------------------------------------------------------------
+# TrainStep(skip_nonfinite=True) — acceptance-criteria pins
+# ---------------------------------------------------------------------------
+def _guard_setup(dtype, donate, skip_nonfinite=True, seed=0):
+    paddle.seed(seed)
+    m = nn.Linear(3, 3)
+    if dtype == "bfloat16":
+        m.to(dtype="bfloat16")
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt, donate=donate,
+                                skip_nonfinite=skip_nonfinite)
+    rng = np.random.default_rng(3)
+    X = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32)
+                         ).astype(dtype)
+    Y = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32)
+                         ).astype(dtype)
+    return m, opt, step, X, Y
+
+
+def _host_state(m, opt):
+    """Bit-exact host copies of params + optimizer slots (survives
+    donation of the device buffers)."""
+    params = {k: np.asarray(v._data).copy()
+              for k, v in m.state_dict().items()}
+    slots = [{k: np.asarray(v).copy() for k, v in s.items()}
+             for s in opt._slots.values()]
+    return params, slots
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("donate", [True, False])
+def test_skip_nonfinite_identity_update(dtype, donate):
+    """A NaN batch leaves params AND optimizer slots bit-identical, for
+    f32/bf16 x donated/undonated, and bumps the skip counter."""
+    m, opt, step, X, Y = _guard_setup(dtype, donate)
+    step(X, Y)  # one clean step so slots are non-trivial
+    before_p, before_s = _host_state(m, opt)
+    Xn = paddle.to_tensor(
+        np.full((4, 3), np.nan, np.float32)).astype(dtype)
+    loss = step(Xn, Y)
+    assert not np.isfinite(float(np.asarray(loss._data, np.float32)))
+    after_p, after_s = _host_state(m, opt)
+    for k in before_p:
+        np.testing.assert_array_equal(
+            before_p[k].view(np.uint8), after_p[k].view(np.uint8),
+            err_msg=k)
+    for bs, as_ in zip(before_s, after_s):
+        for k in bs:
+            np.testing.assert_array_equal(
+                bs[k].view(np.uint8), as_[k].view(np.uint8), err_msg=k)
+    assert step.skipped_steps == 1
+    # the guard recovers: a clean step after the skip still trains
+    step(X, Y)
+    assert step.skipped_steps == 1
+
+
+def test_skip_nonfinite_clean_run_bitwise_matches_guard_off():
+    """With no non-finite step, the guard must be a bit-exact no-op."""
+    m_on, _, step_on, X, Y = _guard_setup("float32", True,
+                                          skip_nonfinite=True)
+    m_off, _, step_off, X2, Y2 = _guard_setup("float32", True,
+                                              skip_nonfinite=False)
+    for _ in range(3):
+        step_on(X, Y)
+        step_off(X2, Y2)
+    on = {k: np.asarray(v._data) for k, v in m_on.state_dict().items()}
+    off = {k: np.asarray(v._data) for k, v in m_off.state_dict().items()}
+    for k in on:
+        np.testing.assert_array_equal(on[k].view(np.uint8),
+                                      off[k].view(np.uint8), err_msg=k)
+
+
+def test_skip_counter_surfaces_in_profiler():
+    from paddle_tpu import profiler
+
+    m, opt, step, X, Y = _guard_setup("float32", True)
+    Xn = paddle.to_tensor(np.full((4, 3), np.nan, np.float32))
+    step(Xn, Y)
+    key = f"train_step/nonfinite_skipped#{id(step)}"
+    assert profiler.counters().get(key) == 1
+
+
+def test_skip_counter_provider_unregisters_on_gc():
+    """Apps that never read counters() must not leak one registry entry
+    per TrainStep (weakref.finalize cleans up at GC)."""
+    import gc
+
+    from paddle_tpu import profiler
+
+    m, opt, step, X, Y = _guard_setup("float32", True)
+    key = f"train_step/nonfinite_skipped#{id(step)}"
+    assert key in profiler._counter_providers
+    del step
+    gc.collect()
+    assert key not in profiler._counter_providers
+
+
+def test_skip_nonfinite_state_dict_persists_applied_step():
+    """The host _step_count advances per DISPATCH; a skipped step rolls
+    the device step back. opt.state_dict() must persist the APPLIED
+    count, or a restore jumps bias-corrected rules over the skips."""
+    m, opt, step, X, Y = _guard_setup("float32", True)
+    step(X, Y)
+    Xn = paddle.to_tensor(np.full((4, 3), np.nan, np.float32))
+    step(Xn, Y)  # skipped: dispatches=2, applied=1
+    step(X, Y)   # dispatches=3, applied=2
+    assert opt._step_count == 3          # eager mirror (schedulers)
+    assert opt.state_dict()["step"] == 2  # persisted: device truth
+
+
+# ---------------------------------------------------------------------------
+# satellite: GradScaler divergence guard
+# ---------------------------------------------------------------------------
+def test_gradscaler_divergence_raises_eager():
+    from paddle_tpu import amp
+
+    scaler = amp.GradScaler(init_loss_scaling=2.0 ** 10,
+                            max_consecutive_skips=3)
+    for _ in range(2):
+        scaler._found_inf = True
+        scaler.update()
+    assert scaler.skipped_steps == 2
+    scaler._found_inf = False
+    scaler.update()  # a good step resets the consecutive counter
+    for _ in range(2):
+        scaler._found_inf = True
+        scaler.update()
+    scaler._found_inf = True
+    with pytest.raises(RuntimeError, match="diverged"):
+        scaler.update()
+    assert scaler.skipped_steps == 5
+
+
+def test_gradscaler_divergence_raises_compiled():
+    """The compiled TrainStep path hits the same guard: every step NaN
+    -> RuntimeError after max_consecutive_skips, with counters synced."""
+    from paddle_tpu import amp
+
+    paddle.seed(0)
+    m = nn.Linear(3, 3)
+    opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0,
+                            max_consecutive_skips=2)
+    step = paddle.jit.TrainStep(m, nn.MSELoss(), opt, scaler=scaler)
+    Xn = paddle.to_tensor(np.full((4, 3), np.nan, np.float32))
+    Y = paddle.zeros([4, 3])
+    step(Xn, Y)
+    assert scaler.skipped_steps == 1
+    with pytest.raises(RuntimeError, match="diverged"):
+        step(Xn, Y)
+    assert scaler.skipped_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: watchdog timeout contract (dump + exit code 6)
+# ---------------------------------------------------------------------------
+def test_watchdog_hung_step_dumps_stacks_and_exits_6(tmp_path):
+    """A hung compiled step must produce the host stack dump and abort
+    with exit code 6 — the dump-and-abort contract the launcher's
+    restart loop relies on."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "PADDLE_STEP_TIMEOUT": "2",
+        "PADDLE_STEP_COMPILE_ALLOWANCE": "3",
+        "PADDLE_RESTART_COUNT": "0",  # hang_worker hangs on attempt 0
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    p = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "hang_worker.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 6, (p.returncode, p.stderr[-2000:])
+    assert "[watchdog]" in p.stderr
+    assert "exceeded" in p.stderr
+    # faulthandler's all-thread dump: every thread section starts with
+    # "Thread 0x..." / "Current thread 0x..."
+    assert "Current thread" in p.stderr or "Thread 0x" in p.stderr
+
+
+# ---------------------------------------------------------------------------
+# DataLoader worker killed by the OS
+# ---------------------------------------------------------------------------
+class _SlowDataset:
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        time.sleep(0.05)
+        return np.float32([i])
+
+
+def test_dataloader_killed_worker_raises(tmp_path):
+    """SIGKILLing a worker (the OOM-killer scenario) must surface as a
+    clear error instead of hanging the iteration forever."""
+    from paddle_tpu.io import DataLoader
+
+    loader = DataLoader(_SlowDataset(), batch_size=1, num_workers=2,
+                        use_shared_memory=False)
+    it = iter(loader)
+    next(it)  # workers are up and producing
+    victim = faults.kill_one_child()
+    assert victim is not None
+    with pytest.raises(RuntimeError, match="worker died"):
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            next(it)

@@ -75,6 +75,41 @@ def test_model_checkpoint_and_load(tmp_path):
         m2.network[0].weight.numpy(), m.network[0].weight.numpy())
 
 
+def test_model_checkpoint_manager_delegation(tmp_path):
+    """keep_last_n/async_save switch ModelCheckpoint onto the
+    fault-tolerant CheckpointManager: committed step dirs, retention,
+    and restore_or_initialize resume."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    m = make_model()
+    ds = RandClsDataset()
+    m.fit(ds, epochs=3, batch_size=16, verbose=0,
+          callbacks=[hapi.ModelCheckpoint(save_dir=str(tmp_path),
+                                          save_freq=2, keep_last_n=2,
+                                          async_save=True)])
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    # epoch 2 via the interval; epoch 3 (the trained result, off the
+    # save_freq boundary) via the forced end-of-training save
+    assert mgr.all_steps() == [2, 3]
+    assert os.path.exists(tmp_path / "step_3" / "COMMITTED")
+
+    m2 = make_model()
+    state = {"model": m2.network.state_dict(),
+             "opt": m2._optimizer.state_dict()}
+    assert mgr.restore_or_initialize(state) == 3
+    np.testing.assert_array_equal(
+        m2.network[0].weight.numpy(), m.network[0].weight.numpy())
+
+
+def test_model_checkpoint_async_alone_keeps_everything(tmp_path):
+    """async_save=True without keep_last_n must not silently enable
+    retention — the legacy path kept every epoch checkpoint."""
+    cb = hapi.ModelCheckpoint(save_dir=str(tmp_path), async_save=True)
+    assert cb._get_manager()._keep >= 10 ** 9
+    cb2 = hapi.ModelCheckpoint(save_dir=str(tmp_path), keep_last_n=3)
+    assert cb2._get_manager()._keep == 3
+
+
 def test_lr_scheduler_callback():
     net = nn.Sequential(nn.Linear(8, 2))
     sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
