@@ -139,12 +139,13 @@ class ModelCheckpoint(Callback):
     auto-resume — the fault-tolerant path long runs should use."""
 
     def __init__(self, save_freq=1, save_dir=None, keep_last_n=None,
-                 async_save=False):
+                 async_save=False, auto_resume=True):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
         self.keep_last_n = keep_last_n
         self.async_save = async_save
+        self.auto_resume = auto_resume
         self._manager = None
         self._last_epoch = None
         self._last_saved = None
@@ -174,6 +175,34 @@ class ModelCheckpoint(Callback):
         if opt is not None:
             state["opt"] = opt.state_dict()
         return state
+
+    def restore_or_initialize(self, model=None):
+        """Auto-resume hook ``Model.fit`` calls at fit start (PR-3
+        follow-up): when this callback runs through the manager and its
+        ``save_dir`` holds committed steps, restore the newest one into
+        the live model+optimizer and return its step (the epoch count
+        already trained); otherwise return None. The optimizer's state
+        template must exist before restore, so fit calls this AFTER
+        building its TrainStep (slots materialized) — same contract as
+        the raw CheckpointManager resume loop."""
+        if model is not None:
+            self.model = model
+        if not self._use_manager() or not self.auto_resume:
+            return None
+        mgr = self._get_manager()
+        if mgr.latest_step() is None:
+            return None
+        state = self._state()
+        step = mgr.restore_or_initialize(state)
+        if step is None:
+            return None
+        # arrays restore in place; non-array leaves (the optimizer step
+        # counter driving Adam bias correction) must be pushed back
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and "opt" in state:
+            opt.set_state_dict(state["opt"])
+        self._last_saved = step
+        return step
 
     def on_epoch_end(self, epoch, logs=None):
         if not self.save_dir:

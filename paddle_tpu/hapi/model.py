@@ -197,10 +197,25 @@ class Model:
         step_obj = self._ensure_train_step()
         self.network.train()
 
+        # auto-resume (ROADMAP PR-3 follow-up): a ModelCheckpoint riding
+        # the fault-tolerant CheckpointManager restores the newest
+        # committed step into the live model+optimizer and fit skips the
+        # epochs already trained. Runs AFTER _ensure_train_step so the
+        # optimizer's slot template exists for the in-place restore.
+        start_epoch = 0
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+        for cb in cbks.callbacks:
+            if isinstance(cb, ModelCheckpoint):
+                resumed = cb.restore_or_initialize(self)
+                if resumed:
+                    start_epoch = min(int(resumed), epochs)
+                break
+
         cbks.call("on_train_begin", {})
         history = []
         logs = {}
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.call("on_epoch_begin", epoch, {})
             logs = {}
             for m in self._metrics:

@@ -9,7 +9,8 @@ from paddle_tpu.jit.trace import TracedFunction, functionalize, in_tracing  # no
 from paddle_tpu.jit.train import TrainStep  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "TracedFunction", "TrainStep",
-           "functionalize", "save", "load", "InputSpec"]
+           "functionalize", "save", "load", "InputSpec",
+           "WeightsOnlyPayload"]
 
 
 class InputSpec:
@@ -90,7 +91,12 @@ def save(layer, path, input_spec=None, **config):
     the reference's save_inference_model + AnalysisPredictor
     (paddle/fluid/inference/api/analysis_predictor.h:100) collapsed into
     AOT XLA. Weights are explicit arguments of the exported module (not
-    baked constants), so load can swap them."""
+    baked constants), so load can swap them.
+
+    Without ``input_spec`` only the weights are serialized and
+    :func:`load` returns a :class:`WeightsOnlyPayload` dict, NOT a
+    callable module — pass ``input_spec`` when the artifact must be
+    executable."""
     import pickle
 
     import jax
@@ -167,10 +173,40 @@ class TranslatedLayer:
     eval = lambda self: self  # noqa: E731
 
 
+class WeightsOnlyPayload(dict):
+    """What :func:`load` returns for an artifact saved WITHOUT
+    ``input_spec``: a plain dict payload (``state_dict`` mapping
+    parameter names to numpy arrays, plus ``class``, the saved Layer's
+    class name) — NOT an executable module. Rebuild the Layer yourself
+    and ``set_state_dict(payload["state_dict"])``.
+
+    Calling it like a model raises immediately with the fix, instead of
+    the bare ``'dict' object is not callable`` the asymmetry used to
+    produce."""
+
+    def __call__(self, *a, **k):
+        raise RuntimeError(
+            "this jit.load result is a weights-only payload "
+            f"(saved class {self.get('class')!r} without input_spec), "
+            "not an executable module. Re-export with "
+            "jit.save(layer, path, input_spec=[InputSpec(...)]) to get "
+            "a callable TranslatedLayer, or rebuild the Layer and "
+            "load_payload['state_dict'] into it via set_state_dict().")
+
+    def state_dict(self):
+        return dict(self["state_dict"])
+
+
 def load(path, **config):
-    """Load a jit.save artifact. With an exported forward, returns an
-    executable TranslatedLayer; a weights-only artifact returns the raw
-    payload dict (state_dict + class name)."""
+    """Load a :func:`save` artifact. The return type follows what was
+    saved (the documented asymmetry):
+
+    * saved WITH ``input_spec`` — an executable :class:`TranslatedLayer`
+      (compiled exported forward + weights; the AnalysisPredictor role);
+    * saved WITHOUT ``input_spec`` — a :class:`WeightsOnlyPayload` dict
+      (``{"state_dict": ..., "class": ...}``); calling it raises a
+      RuntimeError explaining the mismatch rather than a bare TypeError.
+    """
     import pickle
 
     p = path + ".pdmodel" if not path.endswith(".pdmodel") else path
@@ -178,7 +214,7 @@ def load(path, **config):
         payload = pickle.load(f)
     if "exported" in payload:
         return TranslatedLayer(payload)
-    return payload
+    return WeightsOnlyPayload(payload)
 
 
 def enable_to_static(enable: bool = True):

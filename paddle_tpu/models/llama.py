@@ -100,6 +100,22 @@ if "rope_apply" not in _registry.OPS:
          "methods": []}])
 
 
+def _rope_apply_at(q, k, cos, sin):
+    """Rotary embedding at PER-TOKEN absolute positions: q (B,S,H,D) /
+    k (B,S,KH,D) raw arrays, cos/sin (B,S,D) gathered per position —
+    the serving decode path where each sequence sits at a different
+    offset (the contiguous-prefix fast path above keeps (S,D) tables)."""
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    return ((q * c + rot(q) * s).astype(q.dtype),
+            (k * c + rot(k) * s).astype(k.dtype))
+
+
 def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                            / head_dim))
@@ -176,6 +192,40 @@ class LlamaAttention(nn.Layer):
         out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
         return self.o_proj(out)
 
+    def forward_paged(self, x, cos, sin, key_cache, value_cache,
+                      block_tables, seq_lens_encoder, seq_lens_decoder,
+                      seq_lens_this_time):
+        """Serving attention over the paged KV cache. ``x`` (B,S,h);
+        ``cos``/``sin`` (B,S,D) gathered at absolute token positions;
+        caches (num_blocks, block_size, KH, D). Returns
+        (out (B,S,h), key_cache', value_cache') — caches are returned
+        functionally (donated at the engine's jit boundary)."""
+        from paddle_tpu.incubate.nn import functional as F
+
+        b, s, _ = x.shape
+        q = ops.reshape(self.q_proj(x),
+                        [b, s, self.n_heads, self.head_dim])._data
+        k = ops.reshape(self.k_proj(x),
+                        [b, s, self.n_kv, self.head_dim])._data
+        v = ops.reshape(self.v_proj(x),
+                        [b, s, self.n_kv, self.head_dim])._data
+        q, k = _rope_apply_at(q, k, cos, sin)
+        if self.n_kv != self.n_heads:
+            # pack K/V into the first n_kv of the H-wide qkv slots (the
+            # fused-projection layout block_multihead_attention unpacks)
+            pad = [(0, 0), (0, 0), (0, self.n_heads - self.n_kv), (0, 0)]
+            k = jnp.pad(k, pad)
+            v = jnp.pad(v, pad)
+        qkv = jnp.stack([q, k, v], axis=2)  # (B, S, 3, H, D)
+        out, kc, vc = F.block_multihead_attention(
+            qkv, key_cache, value_cache,
+            seq_lens_encoder=seq_lens_encoder,
+            seq_lens_decoder=seq_lens_decoder,
+            seq_lens_this_time=seq_lens_this_time,
+            block_tables=block_tables)
+        out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out), kc, vc
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -222,6 +272,23 @@ class LlamaDecoderLayer(nn.Layer):
             out = sharding_constraint(out, {1: "mp"})
         return out
 
+    def forward_paged(self, x, positions, key_cache, value_cache,
+                      block_tables, seq_lens_encoder, seq_lens_decoder,
+                      seq_lens_this_time):
+        """One decoder block over the paged cache. ``positions`` (B,S)
+        absolute token positions (pad rows may hold anything in range —
+        the attention op masks them by ``seq_lens_this_time``)."""
+        pos = jnp.clip(positions, 0, self.rope_cos.shape[0] - 1)
+        cos = self.rope_cos._data[pos]   # (B, S, D)
+        sin = self.rope_sin._data[pos]
+        attn_out, kc, vc = self.self_attn.forward_paged(
+            self.input_layernorm(x), cos, sin, key_cache, value_cache,
+            block_tables, seq_lens_encoder, seq_lens_decoder,
+            seq_lens_this_time)
+        h = x + attn_out
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, kc, vc
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -245,6 +312,40 @@ class LlamaModel(nn.Layer):
             else:
                 x = layer(x, attn_mask)
         return self.norm(x)
+
+    def forward_paged(self, input_ids, key_caches, value_caches,
+                      block_tables, seq_lens_encoder, seq_lens_decoder,
+                      seq_lens_this_time):
+        """KV-cache forward over stacked per-layer paged caches
+        (L, num_blocks, block_size, KH, D). Per-sequence mode comes from
+        the length tensors (block_attention.py): ``seq_lens_decoder[b]>0``
+        = decode continuing a cached prefix, else prefill from 0.
+        Returns (hidden (B,S,h), key_caches', value_caches')."""
+        kcs = key_caches._data if isinstance(key_caches, Tensor) \
+            else jnp.asarray(key_caches)
+        vcs = value_caches._data if isinstance(value_caches, Tensor) \
+            else jnp.asarray(value_caches)
+        dec = (seq_lens_decoder._data if isinstance(seq_lens_decoder,
+                                                    Tensor)
+               else jnp.asarray(seq_lens_decoder)).reshape(-1)
+        if not isinstance(input_ids, Tensor):
+            input_ids = Tensor(input_ids)
+        s = input_ids.shape[1]
+        # absolute position of each new token: after the cached prefix
+        # (decode) or from 0 (prefill); pad rows land in-range and are
+        # masked out downstream by seq_lens_this_time
+        positions = (jnp.where(dec > 0, dec, 0)[:, None]
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])
+        x = self.embed_tokens(input_ids)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            x, kc, vc = layer.forward_paged(
+                x, positions, kcs[i], vcs[i], block_tables,
+                seq_lens_encoder, seq_lens_decoder, seq_lens_this_time)
+            new_k.append(kc._data if isinstance(kc, Tensor) else kc)
+            new_v.append(vc._data if isinstance(vc, Tensor) else vc)
+        return (self.norm(x), jnp.stack(new_k, axis=0),
+                jnp.stack(new_v, axis=0))
 
 
 class LlamaPretrainingCriterion(nn.Layer):
@@ -278,10 +379,48 @@ class LlamaForCausalLM(nn.Layer):
     def criterion(config=None):
         return LlamaPretrainingCriterion(config)
 
+    def forward_paged(self, input_ids, key_caches, value_caches,
+                      block_tables, seq_lens_encoder, seq_lens_decoder,
+                      seq_lens_this_time):
+        """Serving step: paged forward + lm_head on each sequence's LAST
+        valid token (the sampling position). Returns
+        (logits (B, vocab), key_caches', value_caches'). This is the
+        function ``paddle_tpu.serving.LLMEngine`` compiles as its
+        prefill/decode step."""
+        h, kcs, vcs = self.llama.forward_paged(
+            input_ids, key_caches, value_caches, block_tables,
+            seq_lens_encoder, seq_lens_decoder, seq_lens_this_time)
+        now = (seq_lens_this_time._data
+               if isinstance(seq_lens_this_time, Tensor)
+               else jnp.asarray(seq_lens_this_time)).reshape(-1)
+        hd = h._data if isinstance(h, Tensor) else h
+        b = hd.shape[0]
+        last = jnp.clip(now - 1, 0, hd.shape[1] - 1)
+        h_last = hd[jnp.arange(b), last]              # (B, hidden)
+        logits = self.lm_head(Tensor._from_data(h_last))
+        return logits, kcs, vcs
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0):
-        """Greedy/sampled decoding (eager; full-context recompute per step —
-        a KV-cache decode path is a later milestone)."""
+                 top_k=0, use_cache=None):
+        """Decode ``max_new_tokens`` continuations. ``use_cache`` routes
+        through the paged KV-cache serving engine (compiled prefill +
+        per-token decode; token-identical to the naive loop for greedy,
+        pinned by tests/test_serving_engine.py). Default: the paged path
+        for greedy decoding, the naive full-recompute loop otherwise
+        (sampled decoding draws from the eager RNG stream, which the
+        engine's per-request streams intentionally don't replicate).
+        ``use_cache=False`` forces the naive loop."""
+        if use_cache is None:
+            use_cache = temperature <= 0
+        if use_cache:
+            return self._generate_paged(input_ids, max_new_tokens,
+                                        temperature, top_k)
+        return self._generate_naive(input_ids, max_new_tokens,
+                                    temperature, top_k)
+
+    def _generate_naive(self, input_ids, max_new_tokens, temperature,
+                        top_k):
+        """Full-context recompute per token (the pre-serving fallback)."""
         from paddle_tpu.core import generator as gen
         import jax
 
@@ -297,6 +436,49 @@ class LlamaForCausalLM(nn.Layer):
                 nxt_t = ops.argmax(nxt_logits, axis=-1)
             out = ops.concat([out, ops.unsqueeze(nxt_t, 1)], axis=1)
         return out
+
+    def _generate_paged(self, input_ids, max_new_tokens, temperature,
+                        top_k):
+        """KV-cache decode through a cached serving engine; prefix
+        compute happens once, then one compiled step per token."""
+        import numpy as np
+
+        from paddle_tpu.serving import (
+            EngineConfig, LLMEngine, SamplingParams,
+        )
+
+        ids = np.asarray(input_ids.numpy(), np.int32)
+        b, s = ids.shape
+        need_len = s + max_new_tokens
+        if need_len > self.config.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_position_embeddings "
+                f"({self.config.max_position_embeddings})")
+        eng = getattr(self, "_serving_engine", None)
+        if (eng is None or eng.cfg.max_num_seqs < b
+                or eng.cfg.max_model_len < need_len):
+            # size the cache to the padded need, NOT the rope table's
+            # full span — (L, blocks, bs, KH, D) at a real config's
+            # max_position_embeddings is multi-GB the naive loop never
+            # allocated; the reuse check above rebuilds when a later
+            # call outgrows it
+            mlen = 1
+            while mlen < need_len:
+                mlen *= 2
+            cfg = EngineConfig(
+                max_num_seqs=max(b, 1),
+                max_model_len=min(mlen,
+                                  self.config.max_position_embeddings),
+                max_batched_tokens=max(2048, b * s))
+            eng = LLMEngine(self, cfg)
+            self._serving_engine = eng
+        sampling = SamplingParams(max_new_tokens=max_new_tokens,
+                                  temperature=temperature, top_k=top_k)
+        generated = eng.generate([list(row) for row in ids], sampling)
+        full = np.concatenate(
+            [ids, np.asarray(generated, np.int32)], axis=1)
+        return Tensor(full.astype(np.int32))
 
 
 def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int):

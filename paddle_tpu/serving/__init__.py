@@ -1,0 +1,49 @@
+"""paddle_tpu.serving — continuous-batching LLM inference.
+
+An Orca/vLLM-style iteration-level serving engine over the paged
+KV-cache attention op (``incubate.nn.functional.
+block_multihead_attention``), filling the reference's inference-stack
+role (AnalysisPredictor + the fastdeploy serving layer) TPU-natively:
+
+=================  ====================================================
+:class:`BlockManager`  paged KV allocator: free-list, per-request block
+                       tables, exact accounting, OOM signal
+:class:`Scheduler`     iteration-level admission + prefill/decode
+                       interleave, token budget, preemption-on-OOM
+:class:`LLMEngine`     compiled bucketed prefill/decode steps, paged
+                       Llama decode, sampling, streaming callbacks
+:class:`ServingMetrics` queue/KV/latency gauges through
+                       ``profiler.register_counter_provider``
+=================  ====================================================
+
+Quick start::
+
+    from paddle_tpu.serving import LLMEngine, EngineConfig, SamplingParams
+    eng = LLMEngine(llama_model, EngineConfig(max_num_seqs=8))
+    eng.add_request(prompt_token_ids,
+                    SamplingParams(max_new_tokens=64, temperature=0.7))
+    while eng.has_unfinished():
+        for out in eng.step():
+            ...                              # out.token streamed per step
+            if out.finished:                 # long-lived engines: release
+                eng.release_request(out.request_id)
+
+(``eng.generate(prompts)`` wraps admit -> serve -> release for the
+batch-synchronous case.)
+"""
+from paddle_tpu.serving.block_manager import (  # noqa: F401
+    BlockManager, NoFreeBlocksError,
+)
+from paddle_tpu.serving.engine import EngineConfig, LLMEngine  # noqa: F401
+from paddle_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from paddle_tpu.serving.request import (  # noqa: F401
+    Request, RequestOutput, RequestStatus, SamplingParams,
+)
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    ScheduledBatch, Scheduler, SchedulerConfig,
+)
+
+__all__ = ["BlockManager", "NoFreeBlocksError", "EngineConfig",
+           "LLMEngine", "ServingMetrics", "Request", "RequestOutput",
+           "RequestStatus", "SamplingParams", "ScheduledBatch",
+           "Scheduler", "SchedulerConfig"]

@@ -1,0 +1,125 @@
+"""Paged KV-cache block allocator (vLLM PagedAttention block manager).
+
+The physical cache is ``num_blocks`` fixed-size blocks per layer (one
+shared free list — every layer's cache uses the same block ids, so the
+block table a request holds indexes all layers at once, exactly how
+``incubate.nn.functional.block_multihead_attention`` consumes it).
+
+Invariants (pinned by tests/test_serving.py randomized sequences):
+  * a block id is owned by at most one request at a time,
+  * ``num_free_blocks + sum(len(table) for tables) == num_blocks`` always,
+  * ``free``/preemption returns every owned block to the free list.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+__all__ = ["BlockManager", "NoFreeBlocksError"]
+
+
+class NoFreeBlocksError(RuntimeError):
+    """Raised when an allocation is attempted past capacity; the
+    scheduler catches this OOM signal and preempts."""
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class BlockManager:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError("num_blocks and block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: recently-freed blocks are reused first (their
+        # cache lines are the ones most likely still resident)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._tables: Dict[str, List[int]] = {}
+
+    # -- accounting ------------------------------------------------------
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return cdiv(num_tokens, self.block_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.blocks_needed(num_tokens) <= len(self._free)
+
+    def has_table(self, request_id: str) -> bool:
+        return request_id in self._tables
+
+    def block_table(self, request_id: str) -> List[int]:
+        return list(self._tables[request_id])
+
+    def utilization(self) -> float:
+        return self.num_used_blocks / self.num_blocks
+
+    # -- allocation ------------------------------------------------------
+    def allocate(self, request_id: str, num_tokens: int) -> List[int]:
+        """Claim blocks covering ``num_tokens`` for a request being
+        admitted (prefill). The request must not already own a table."""
+        if request_id in self._tables:
+            raise ValueError(
+                f"request {request_id!r} already holds a block table — "
+                f"free() it before re-allocating")
+        need = self.blocks_needed(num_tokens)
+        if need > len(self._free):
+            raise NoFreeBlocksError(
+                f"need {need} blocks for {num_tokens} tokens, "
+                f"{len(self._free)} free")
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[request_id] = table
+        return list(table)
+
+    def can_append(self, request_id: str, new_len: int) -> bool:
+        """Would growing this request's sequence to ``new_len`` tokens
+        fit (either inside its last block or with one free block)?"""
+        need = self.blocks_needed(new_len) - len(self._tables[request_id])
+        return need <= len(self._free)
+
+    def append_slot(self, request_id: str, new_len: int) -> List[int]:
+        """Ensure the table covers ``new_len`` tokens, growing by at most
+        one block per decode step. Raises NoFreeBlocksError on OOM (the
+        scheduler's preemption trigger)."""
+        table = self._tables[request_id]
+        need = self.blocks_needed(new_len) - len(table)
+        if need <= 0:
+            return list(table)
+        if need > len(self._free):
+            raise NoFreeBlocksError(
+                f"request {request_id!r}: {need} more block(s) needed "
+                f"for length {new_len}, {len(self._free)} free")
+        for _ in range(need):
+            table.append(self._free.pop())
+        return list(table)
+
+    def free(self, request_id: str) -> int:
+        """Release every block the request owns (completion OR
+        preemption). Returns the number reclaimed; idempotent for
+        unknown ids (a request preempted before admission owns none)."""
+        table = self._tables.pop(request_id, None)
+        if table is None:
+            return 0
+        self._free.extend(table)
+        return len(table)
+
+    # -- introspection (tests + metrics) ---------------------------------
+    def check_invariants(self):
+        """Exact free-block accounting; raises AssertionError on any
+        violation (used by the randomized-sequence tests every step)."""
+        owned = [b for t in self._tables.values() for b in t]
+        assert len(owned) == len(set(owned)), "double-allocated block"
+        assert len(owned) + len(self._free) == self.num_blocks, (
+            f"block leak: {len(owned)} owned + {len(self._free)} free "
+            f"!= {self.num_blocks}")
+        assert len(set(self._free)) == len(self._free), \
+            "duplicate block in free list"
+        both = set(owned) & set(self._free)
+        assert not both, f"blocks both owned and free: {sorted(both)}"

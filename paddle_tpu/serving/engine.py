@@ -1,0 +1,346 @@
+"""LLMEngine — continuous-batching inference over a paged KV cache.
+
+The serving analog of the reference's AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.h:100), rebuilt around
+the TPU-native execution model:
+
+* the KV cache is ONE stacked device array per K and V —
+  ``(layers, num_blocks, block_size, kv_heads, head_dim)`` — indexed by
+  per-request block tables ("Ragged Paged Attention", arxiv 2604.15464:
+  paged attention is the right TPU kernel shape), allocated by
+  :class:`BlockManager` and attended through
+  ``incubate.nn.functional.block_multihead_attention``;
+* prefill and decode are the SAME compiled function (the op's per-
+  sequence mode select), jitted over a bounded set of bucketed padded
+  shapes so XLA recompiles O(log max_len * log max_batch) times, not
+  per request;
+* cache buffers are donated at the jit boundary on TPU (the functional
+  update aliases in place — the divergence note in block_attention.py);
+* scheduling is iteration-level (:class:`Scheduler`): late arrivals
+  join the running batch at the next step, and KV OOM preempts the
+  lowest-priority request back to the waiting queue (recompute).
+
+Sampling runs host-side per request (greedy / temperature / top-p /
+top-k) on the last-token logits the compiled step returns — B×vocab is
+tiny next to the model pass, and per-request RNG streams stay
+reproducible across preemptions.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving.block_manager import BlockManager, cdiv
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.request import (
+    Request, RequestOutput, RequestStatus, SamplingParams,
+)
+from paddle_tpu.serving.scheduler import (
+    ScheduledBatch, Scheduler, SchedulerConfig,
+)
+
+__all__ = ["EngineConfig", "LLMEngine"]
+
+
+@dataclass
+class EngineConfig:
+    """Engine knobs. ``num_blocks=None`` sizes the cache so every one of
+    ``max_num_seqs`` concurrent requests can reach ``max_model_len``
+    (no preemption ever needed); smaller values oversubscribe the cache
+    and rely on preemption — the vLLM deployment posture."""
+
+    block_size: int = 16
+    num_blocks: Optional[int] = None
+    max_num_seqs: int = 8
+    max_batched_tokens: int = 2048
+    max_model_len: Optional[int] = None   # default: model max positions
+    dtype: Optional[str] = None           # default: model param dtype
+    donate_cache: Optional[bool] = None   # default: True off-CPU
+    min_prefill_bucket: int = 8
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        if self.num_blocks is not None and self.num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        if self.min_prefill_bucket < 1:
+            raise ValueError("min_prefill_bucket must be >= 1")
+        if self.max_model_len is not None and self.max_model_len < 1:
+            raise ValueError("max_model_len must be >= 1")
+        # max_num_seqs / max_batched_tokens validate in SchedulerConfig
+
+
+class LLMEngine:
+    """Drive a :class:`~paddle_tpu.models.llama.LlamaForCausalLM` (or
+    any model exposing the same ``forward_paged`` contract) as a
+    continuously-batched token server::
+
+        eng = LLMEngine(model, EngineConfig(max_num_seqs=8))
+        eng.add_request("r0", prompt_ids, SamplingParams(max_new_tokens=16),
+                        callback=lambda rid, tok, done: ...)
+        while eng.has_unfinished():
+            for out in eng.step():   # one prefill OR decode iteration
+                if out.finished:
+                    eng.release_request(out.request_id)
+
+    Finished requests stay queryable via :meth:`get_request` until
+    :meth:`release_request` drops them — release in long-lived engines
+    or memory grows with every request ever served
+    (:meth:`generate` does all of this for the batch-synchronous case).
+    """
+
+    def __init__(self, model, config: Optional[EngineConfig] = None):
+        import jax
+
+        self.model = model
+        self.cfg = config or EngineConfig()
+        mcfg = model.config
+        if self.cfg.max_model_len is None:
+            self.cfg.max_model_len = mcfg.max_position_embeddings
+        if self.cfg.max_model_len > mcfg.max_position_embeddings:
+            raise ValueError(
+                f"max_model_len {self.cfg.max_model_len} exceeds the "
+                f"model's rope table "
+                f"({mcfg.max_position_embeddings} positions)")
+        self.max_blocks_per_seq = cdiv(self.cfg.max_model_len,
+                                       self.cfg.block_size)
+        if self.cfg.num_blocks is None:
+            self.cfg.num_blocks = (self.cfg.max_num_seqs *
+                                   self.max_blocks_per_seq)
+
+        self.block_manager = BlockManager(self.cfg.num_blocks,
+                                          self.cfg.block_size)
+        self.scheduler = Scheduler(
+            self.block_manager,
+            SchedulerConfig(max_num_seqs=self.cfg.max_num_seqs,
+                            max_batched_tokens=self.cfg.max_batched_tokens))
+
+        # -- device caches: (L, NB, BS, KH, D) stacked per layer --------
+        import jax.numpy as jnp
+
+        kh = mcfg.num_key_value_heads
+        hd = mcfg.hidden_size // mcfg.num_attention_heads
+        if self.cfg.dtype is not None:
+            from paddle_tpu.core.dtype import to_jax
+
+            cache_dtype = to_jax(self.cfg.dtype)
+        else:
+            cache_dtype = model.lm_head.weight._data.dtype
+        shape = (mcfg.num_hidden_layers, self.cfg.num_blocks,
+                 self.cfg.block_size, kh, hd)
+        self._kcs = jnp.zeros(shape, cache_dtype)
+        self._vcs = jnp.zeros(shape, cache_dtype)
+
+        # -- compiled prefill/decode step -------------------------------
+        from paddle_tpu.jit.trace import functionalize
+
+        apply, (_, self._params), (_, self._buffers) = functionalize(
+            model.forward_paged)
+
+        def raw_step(param_datas, buffer_datas, key, ids, kcs, vcs, bt,
+                     enc, dec, now):
+            (logits, k2, v2), _ = apply(param_datas, buffer_datas, key,
+                                        ids, kcs, vcs, bt, enc, dec, now)
+            return logits, k2, v2
+
+        donate = self.cfg.donate_cache
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
+        self._jstep = jax.jit(
+            raw_step, donate_argnums=(4, 5) if donate else ())
+        self._key = jax.random.key(0)
+
+        self._requests: Dict[str, Request] = {}
+        self._auto_id = itertools.count()
+        self.metrics = ServingMetrics(self)
+
+    # -- request lifecycle ----------------------------------------------
+    def add_request(self, request_id, prompt_ids: Sequence[int] = None,
+                    sampling: Optional[SamplingParams] = None,
+                    callback: Optional[Callable] = None) -> str:
+        """Admit a request into the waiting queue. ``request_id`` may be
+        omitted by passing the prompt first — ``add_request(prompt_ids)``
+        or ``add_request(prompt_ids, SamplingParams(...))``. Returns the
+        request id."""
+        if isinstance(prompt_ids, SamplingParams):
+            if sampling is not None:
+                raise TypeError("sampling passed twice")
+            prompt_ids, sampling = None, prompt_ids
+        if prompt_ids is None:
+            request_id, prompt_ids = None, request_id
+        if request_id is None:
+            request_id = f"req-{next(self._auto_id)}"
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id!r}")
+        sampling = sampling or SamplingParams()
+        prompt_ids = [int(t) for t in prompt_ids]
+        total = len(prompt_ids) + sampling.max_new_tokens
+        if total > self.cfg.max_model_len:
+            raise ValueError(
+                f"request {request_id!r}: prompt ({len(prompt_ids)}) + "
+                f"max_new_tokens ({sampling.max_new_tokens}) = {total} "
+                f"exceeds max_model_len {self.cfg.max_model_len}")
+        if cdiv(total, self.cfg.block_size) > self.cfg.num_blocks:
+            raise ValueError(
+                f"request {request_id!r} needs "
+                f"{cdiv(total, self.cfg.block_size)} KV blocks at full "
+                f"length but the cache only has {self.cfg.num_blocks} — "
+                f"it could never be served even alone")
+        req = Request(request_id=request_id, prompt_ids=prompt_ids,
+                      sampling=sampling, callback=callback)
+        self._requests[request_id] = req
+        self.scheduler.add(req)
+        return request_id
+
+    def abort_request(self, request_id: str) -> bool:
+        return self.scheduler.abort(request_id)
+
+    def release_request(self, request_id: str) -> Optional[Request]:
+        """Drop a FINISHED request's bookkeeping (long-lived engines —
+        e.g. the one ``LlamaForCausalLM.generate`` caches — would
+        otherwise accumulate every request ever served). Returns the
+        released request, or None if unknown; refuses to release an
+        unfinished request (use :meth:`abort_request`)."""
+        req = self._requests.get(request_id)
+        if req is None:
+            return None
+        if not req.is_finished:
+            raise ValueError(
+                f"request {request_id!r} is {req.status.value}, not "
+                f"finished — abort_request() cancels in-flight requests")
+        return self._requests.pop(request_id)
+
+    def reset_metrics(self) -> ServingMetrics:
+        """Fresh metrics window (e.g. after a compile-warmup pass, so
+        TTFT/tokens-per-sec report steady state, not XLA compiles)."""
+        self.metrics = ServingMetrics(self)
+        return self.metrics
+
+    def get_request(self, request_id: str) -> Request:
+        return self._requests[request_id]
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    # -- bucketed padding -----------------------------------------------
+    def _batch_bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.max_num_seqs)
+
+    def _seq_bucket(self, n: int) -> int:
+        s = self.cfg.min_prefill_bucket
+        while s < n:
+            s *= 2
+        cap = cdiv(self.cfg.max_model_len, 8) * 8
+        return min(s, cap)
+
+    # -- one engine iteration -------------------------------------------
+    def step(self) -> List[RequestOutput]:
+        """Schedule + run ONE model iteration (a prefill batch or a
+        decode batch), sample one token per scheduled request, retire
+        finished requests. Returns this step's per-request outputs."""
+        batch = self.scheduler.schedule()
+        if batch.is_empty:
+            if self.scheduler.has_unfinished():
+                raise RuntimeError(
+                    "scheduler produced an empty batch with unfinished "
+                    "requests — KV cache too small for any waiting "
+                    "request (admission validation should prevent this)")
+            return []
+        reqs = batch.requests
+        is_prefill = batch.kind == "prefill"
+        n_run = [len(r.tokens_to_run()) for r in reqs]
+        S = self._seq_bucket(max(n_run)) if is_prefill else 1
+        B = self._batch_bucket(len(reqs))
+
+        ids = np.zeros((B, S), np.int32)
+        enc = np.zeros((B,), np.int32)
+        dec = np.zeros((B,), np.int32)
+        now = np.zeros((B,), np.int32)
+        bt = np.full((B, self.max_blocks_per_seq), -1, np.int32)
+        for i, r in enumerate(reqs):
+            run = r.tokens_to_run()
+            ids[i, :len(run)] = run
+            now[i] = len(run)
+            if is_prefill:
+                enc[i] = len(run)
+            dec[i] = r.num_cached
+            table = self.block_manager.block_table(r.request_id)
+            bt[i, :len(table)] = table
+
+        logits, self._kcs, self._vcs = self._jstep(
+            [p._data for p in self._params],
+            [b._data for b in self._buffers],
+            self._key, ids, self._kcs, self._vcs, bt, enc, dec, now)
+        logits_np = np.asarray(logits)[:len(reqs)]
+
+        self.metrics.record_step(batch.kind, len(reqs), int(sum(n_run)),
+                                 self.cfg.max_num_seqs)
+        outputs: List[RequestOutput] = []
+        for i, r in enumerate(reqs):
+            r.num_cached += n_run[i]
+            token = self._sample(r, logits_np[i])
+            finished = r.append_token(token)
+            self.metrics.record_token()
+            if finished:
+                self.scheduler.finish(r)
+                self.metrics.record_finish(r)
+            out = RequestOutput(request_id=r.request_id, token=token,
+                                finished=finished,
+                                generated=list(r.generated))
+            outputs.append(out)
+            if r.callback is not None:
+                r.callback(r.request_id, token, finished)
+        return outputs
+
+    # -- sampling (host-side, per request) ------------------------------
+    @staticmethod
+    def _sample(req: Request, logits: np.ndarray) -> int:
+        sp = req.sampling
+        if sp.temperature <= 0.0:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / sp.temperature
+        x -= x.max()
+        p = np.exp(x)
+        p /= p.sum()
+        if sp.top_k > 0 and sp.top_k < p.size:
+            kth = np.partition(p, -sp.top_k)[-sp.top_k]
+            p = np.where(p >= kth, p, 0.0)
+            p /= p.sum()
+        if sp.top_p < 1.0:
+            order = np.argsort(-p)
+            csum = np.cumsum(p[order])
+            keep_n = int(np.searchsorted(csum, sp.top_p) + 1)
+            mask = np.zeros_like(p)
+            mask[order[:keep_n]] = p[order[:keep_n]]
+            p = mask / mask.sum()
+        return int(req._rng.choice(p.size, p=p))
+
+    # -- run-to-completion convenience ----------------------------------
+    def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
+        outs: List[RequestOutput] = []
+        steps = 0
+        while self.has_unfinished():
+            outs.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return outs
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingParams] = None
+                 ) -> List[List[int]]:
+        """Batch convenience: admit every prompt, serve to completion,
+        return the GENERATED token lists in input order. Finished
+        requests are released (a long-lived engine must not accumulate
+        every request it ever served); use add_request/step/get_request
+        to keep per-request state around."""
+        rids = [self.add_request(list(p), sampling=sampling)
+                for p in prompts]
+        self.run()
+        return [self.release_request(rid).generated for rid in rids]

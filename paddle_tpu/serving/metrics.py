@@ -1,0 +1,169 @@
+"""Serving observability: queue/batch/KV gauges + latency aggregates.
+
+Exposed two ways:
+
+* pull — every gauge registers with
+  ``profiler.register_counter_provider`` (the PR-3 observability
+  machinery), so ``profiler.counters()`` reports ``serving/<name>``
+  alongside training counters like ``train_step/nonfinite_skipped``;
+* snapshot — :meth:`ServingMetrics.snapshot` returns one dict (what
+  ``bench.py --serving`` emits as the BENCH_serving JSON).
+
+TTFT (time-to-first-token) and TPOT (time-per-output-token, a.k.a.
+inter-token latency) follow the standard serving definitions: TTFT is
+arrival -> first sampled token; TPOT is (finish - first token) /
+(n_generated - 1)."""
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Dict, List
+
+__all__ = ["ServingMetrics"]
+
+
+def _mean(xs: List[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+class ServingMetrics:
+    """Owned by one :class:`~paddle_tpu.serving.LLMEngine`."""
+
+    GAUGES = ("queue_depth", "num_running", "num_waiting",
+              "kv_block_utilization", "tokens_per_sec", "ttft_ms_avg",
+              "tpot_ms_avg", "preemptions", "batch_occupancy")
+
+    def __init__(self, engine):
+        self._engine = weakref.ref(engine)
+        self.start_time = time.monotonic()
+        self.num_prompt_tokens = 0
+        self.num_generated_tokens = 0
+        self.num_finished = 0
+        self.engine_steps = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.ttfts_s: List[float] = []
+        self.tpots_s: List[float] = []
+        # batch occupancy: scheduled seqs / max_num_seqs per decode step
+        self._occupancy_sum = 0.0
+        self._occupancy_n = 0
+        self._registered: List[str] = []
+        self._register(engine)
+
+    # -- recording (called by the engine) --------------------------------
+    def record_step(self, kind: str, n_seqs: int, n_tokens: int,
+                    max_num_seqs: int):
+        self.engine_steps += 1
+        if kind == "prefill":
+            self.prefill_steps += 1
+            self.num_prompt_tokens += n_tokens
+        elif kind == "decode":
+            self.decode_steps += 1
+            self._occupancy_sum += n_seqs / max_num_seqs
+            self._occupancy_n += 1
+
+    def record_token(self):
+        self.num_generated_tokens += 1
+
+    def record_finish(self, request):
+        self.num_finished += 1
+        if request.first_token_time is not None:
+            self.ttfts_s.append(
+                request.first_token_time - request.arrival_time)
+            if request.num_generated > 1 and request.finish_time:
+                self.tpots_s.append(
+                    (request.finish_time - request.first_token_time)
+                    / (request.num_generated - 1))
+
+    # -- derived ---------------------------------------------------------
+    @property
+    def tokens_per_sec(self) -> float:
+        dt = time.monotonic() - self.start_time
+        return self.num_generated_tokens / dt if dt > 0 else 0.0
+
+    @property
+    def batch_occupancy(self) -> float:
+        return (self._occupancy_sum / self._occupancy_n
+                if self._occupancy_n else 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        eng = self._engine()
+        out = {
+            "num_prompt_tokens": self.num_prompt_tokens,
+            "num_generated_tokens": self.num_generated_tokens,
+            "num_finished": self.num_finished,
+            "engine_steps": self.engine_steps,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+            "ttft_ms_avg": round(_mean(self.ttfts_s) * 1e3, 3),
+            "ttft_ms_p90": round(
+                _percentile(self.ttfts_s, 0.9) * 1e3, 3),
+            "tpot_ms_avg": round(_mean(self.tpots_s) * 1e3, 3),
+            "batch_occupancy": round(self.batch_occupancy, 4),
+        }
+        if eng is not None:
+            out.update({
+                "num_running": eng.scheduler.num_running,
+                "num_waiting": eng.scheduler.num_waiting,
+                "preemptions": eng.scheduler.num_preemptions,
+                "kv_block_utilization": round(
+                    eng.block_manager.utilization(), 4),
+                "kv_blocks_total": eng.block_manager.num_blocks,
+            })
+        return out
+
+    # -- profiler counter providers --------------------------------------
+    def _register(self, engine):
+        from paddle_tpu import profiler
+
+        ref = weakref.ref(engine)
+        mref = weakref.ref(self)
+
+        def provider(name):
+            def get():
+                eng, m = ref(), mref()
+                if eng is None or m is None:
+                    return None  # counters() drops dead providers
+                if name == "queue_depth":
+                    return eng.scheduler.num_waiting
+                if name == "num_running":
+                    return eng.scheduler.num_running
+                if name == "num_waiting":
+                    return eng.scheduler.num_waiting
+                if name == "kv_block_utilization":
+                    return eng.block_manager.utilization()
+                if name == "tokens_per_sec":
+                    return m.tokens_per_sec
+                if name == "ttft_ms_avg":
+                    return _mean(m.ttfts_s) * 1e3
+                if name == "tpot_ms_avg":
+                    return _mean(m.tpots_s) * 1e3
+                if name == "preemptions":
+                    return eng.scheduler.num_preemptions
+                if name == "batch_occupancy":
+                    return m.batch_occupancy
+                return None
+            return get
+
+        for g in self.GAUGES:
+            cname = f"serving/{g}#{id(engine)}"
+            profiler.register_counter_provider(cname, provider(g))
+            self._registered.append(cname)
+        # an app that never reads counters() must not leak providers
+        weakref.finalize(engine, _unregister_all, list(self._registered))
+
+
+def _unregister_all(names):
+    from paddle_tpu import profiler
+
+    for n in names:
+        profiler.unregister_counter_provider(n)

@@ -1,0 +1,146 @@
+"""Serving request/state primitives.
+
+Reference capability: the AnalysisPredictor request lifecycle
+(paddle/fluid/inference/api/analysis_predictor.h) generalized to the
+Orca/vLLM continuous-batching model: a request is admitted, prefilled
+once, then produces one token per engine iteration until EOS/max-token
+completion — and may be preempted back to WAITING when the paged KV
+cache runs out of blocks (recompute-on-readmission)."""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["SamplingParams", "RequestStatus", "Request", "RequestOutput"]
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode knobs. ``temperature<=0`` is greedy argmax;
+    otherwise softmax sampling at that temperature, optionally truncated
+    to the ``top_k`` highest-probability tokens and/or the smallest
+    nucleus with cumulative mass >= ``top_p``."""
+
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    eos_token_id: Optional[int] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+class RequestStatus(Enum):
+    WAITING = "waiting"      # queued (new, or preempted for recompute)
+    RUNNING = "running"      # KV cached; decoding one token per step
+    FINISHED = "finished"    # EOS / max_new_tokens reached
+
+
+@dataclass
+class Request:
+    """One in-flight generation. ``tokens`` is prompt + generated so far;
+    ``num_cached`` counts the leading tokens whose K/V live in the paged
+    cache (0 after admission or preemption — preempted requests recompute
+    their whole prefix on re-admission)."""
+
+    request_id: str
+    prompt_ids: List[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    callback: Optional[Callable] = None   # (request_id, token, finished)
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    status: RequestStatus = RequestStatus.WAITING
+    tokens: List[int] = field(default_factory=list)
+    num_cached: int = 0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    num_preemptions: int = 0
+
+    def __post_init__(self):
+        if not self.prompt_ids:
+            raise ValueError(f"request {self.request_id!r}: empty prompt")
+        self.tokens = list(self.prompt_ids)
+        seed = self.sampling.seed
+        if seed is None:
+            # deterministic per request id ACROSS processes (str hash()
+            # is salted per interpreter), so a preempt/re-admit cycle —
+            # or a replayed run — samples the same stream
+            import hashlib
+
+            digest = hashlib.sha256(
+                b"paddle_tpu.serving:" +
+                self.request_id.encode()).digest()
+            seed = int.from_bytes(digest[:8], "little")
+        self._rng = np.random.default_rng(seed)
+
+    # -- derived ---------------------------------------------------------
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[len(self.prompt_ids):]
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens) - len(self.prompt_ids)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status == RequestStatus.FINISHED
+
+    def tokens_to_run(self) -> List[int]:
+        """Tokens whose K/V must be computed this iteration: the whole
+        uncached prefix for a prefill, the single newest token for a
+        decode step."""
+        return self.tokens[self.num_cached:]
+
+    def preempt(self):
+        """Back to WAITING for recompute: the scheduler has freed this
+        request's blocks; all progress (generated tokens) is kept, only
+        the KV cache contents are recomputed on re-admission."""
+        self.status = RequestStatus.WAITING
+        self.num_cached = 0
+        self.num_preemptions += 1
+
+    def append_token(self, token: int) -> bool:
+        """Record a sampled token; returns True when the request is now
+        finished (EOS or max_new_tokens)."""
+        self.tokens.append(int(token))
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        sp = self.sampling
+        done = (self.num_generated >= sp.max_new_tokens or
+                (sp.eos_token_id is not None and
+                 int(token) == sp.eos_token_id))
+        if done:
+            self.status = RequestStatus.FINISHED
+            self.finish_time = time.monotonic()
+        return done
+
+
+@dataclass
+class RequestOutput:
+    """One step's emission for a request (streamed via ``callback`` and
+    returned from ``LLMEngine.step``)."""
+
+    request_id: str
+    token: int
+    finished: bool
+    generated: List[int]
+
+    @property
+    def text_tokens(self) -> List[int]:  # parity alias
+        return self.generated

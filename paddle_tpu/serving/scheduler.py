@@ -1,0 +1,204 @@
+"""Iteration-level (continuous-batching) scheduler.
+
+Orca's insight, as shipped by vLLM: scheduling decisions happen every
+model iteration, not per request. Each call to :meth:`schedule` emits
+either a PREFILL batch (admitting waiting requests under a token budget
+and the free-block supply) or a DECODE batch (one token for every
+running request), so late-arriving requests join the running batch at
+the next iteration boundary instead of waiting for a full drain.
+
+Preemption: when a decode step needs a block and none are free, the
+lowest-priority running request (latest arrival) is evicted — its
+blocks reclaimed, its state reset to WAITING for recompute — until the
+victim set frees enough. FCFS admission order plus eviction-from-the-
+back gives the oldest request a monotonically growing claim on the
+cache, so every admitted request eventually finishes (the starvation
+guard pinned by tests/test_serving.py)."""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+from paddle_tpu.serving.block_manager import BlockManager, NoFreeBlocksError
+from paddle_tpu.serving.request import Request, RequestStatus
+
+__all__ = ["SchedulerConfig", "ScheduledBatch", "Scheduler"]
+
+
+@dataclass
+class SchedulerConfig:
+    """Admission/batching knobs.
+
+    ``max_num_seqs``   — max concurrently RUNNING requests (decode batch
+                         width; also caps a prefill batch).
+    ``max_batched_tokens`` — per-iteration PADDED-token budget for
+                         prefill batches: rows × longest row admitted,
+                         since the engine pads every row to the batch's
+                         longest request. (Bucket rounding can still
+                         exceed this by up to 2× — pow2 seq buckets.)
+    """
+
+    max_num_seqs: int = 8
+    max_batched_tokens: int = 2048
+
+    def __post_init__(self):
+        if self.max_num_seqs < 1:
+            raise ValueError("max_num_seqs must be >= 1")
+        if self.max_batched_tokens < 1:
+            raise ValueError("max_batched_tokens must be >= 1")
+
+
+@dataclass
+class ScheduledBatch:
+    """One iteration's work: requests + phase. ``preempted`` lists
+    requests evicted while forming this batch (already reset to
+    WAITING and re-queued)."""
+
+    kind: str                       # "prefill" | "decode" | "idle"
+    requests: List[Request] = field(default_factory=list)
+    preempted: List[Request] = field(default_factory=list)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.requests
+
+
+class Scheduler:
+    def __init__(self, block_manager: BlockManager,
+                 config: Optional[SchedulerConfig] = None):
+        self.block_manager = block_manager
+        self.config = config or SchedulerConfig()
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+        self.num_preemptions = 0
+
+    # -- queue ops -------------------------------------------------------
+    def add(self, request: Request):
+        request.status = RequestStatus.WAITING
+        self.waiting.append(request)
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def finish(self, request: Request):
+        """Completion: reclaim blocks, drop from the running set."""
+        self.block_manager.free(request.request_id)
+        if request in self.running:
+            self.running.remove(request)
+
+    def abort(self, request_id: str) -> bool:
+        """Cancel a request wherever it is; True when found."""
+        for q in (self.running, self.waiting):
+            for r in list(q):
+                if r.request_id == request_id:
+                    self.block_manager.free(r.request_id)
+                    q.remove(r)
+                    r.status = RequestStatus.FINISHED
+                    return True
+        return False
+
+    # -- preemption ------------------------------------------------------
+    def _preempt_one(self, for_request: Request) -> Optional[Request]:
+        """Evict the lowest-priority (latest-arrival) running request to
+        free blocks for ``for_request`` — but never a HIGHER-priority
+        (earlier) one: when ``for_request`` is itself the lowest
+        priority, returns None and the caller self-preempts. The victim
+        goes to the FRONT of the waiting queue so its recompute is not
+        starved behind newer arrivals."""
+        candidates = [r for r in self.running
+                      if r is not for_request
+                      and r.arrival_time >= for_request.arrival_time]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda r: r.arrival_time)
+        self.running.remove(victim)
+        self.block_manager.free(victim.request_id)
+        victim.preempt()
+        self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        return victim
+
+    # -- the per-iteration decision --------------------------------------
+    def schedule(self) -> ScheduledBatch:
+        # Phase 1 — admit waiting requests (FCFS) when capacity allows.
+        # A request is admitted only when its FULL uncached prefix fits
+        # the token budget and the free-block supply; admission claims
+        # the blocks immediately so the batch can't oversubscribe.
+        prefills: List[Request] = []
+        batch_max = 0  # longest row admitted -> the padded row width
+        while self.waiting:
+            req = self.waiting[0]
+            need = len(req.tokens_to_run())
+            if len(self.running) + len(prefills) >= self.config.max_num_seqs:
+                break
+            # budget the PADDED batch (rows x longest row): the engine
+            # pads every row to the longest, so raw token counts would
+            # under-bound the compiled work by the padding factor
+            padded = (len(prefills) + 1) * max(batch_max, need)
+            if prefills and padded > self.config.max_batched_tokens:
+                break  # batch full; this request leads the next one
+            # (a lone over-budget prompt is still admitted, alone —
+            # rejecting it forever would starve it)
+            if not self.block_manager.can_allocate(need):
+                break  # blocks free up as running requests finish
+            self.block_manager.allocate(req.request_id, need)
+            self.waiting.popleft()
+            req.status = RequestStatus.RUNNING
+            prefills.append(req)
+            batch_max = max(batch_max, need)
+        if prefills:
+            self.running.extend(prefills)
+            return ScheduledBatch(kind="prefill", requests=prefills)
+
+        # Phase 2 — decode: one token for every running request. Each
+        # needs a slot for its new K/V; an OOM on slot growth triggers
+        # preemption of the latest arrival (possibly the request itself,
+        # when it IS the lowest priority).
+        preempted: List[Request] = []
+        decodes: List[Request] = []
+        for req in sorted(self.running, key=lambda r: r.arrival_time):
+            if req not in self.running:
+                continue  # evicted while a later arrival was processed
+            # this step computes K/V for tokens[-1] at position
+            # len(tokens)-1, so coverage of len(tokens) slots is exact —
+            # +1 would claim each next block one step early (and a
+            # never-written block on the final decode step)
+            got_slot = False
+            while True:
+                try:
+                    self.block_manager.append_slot(req.request_id,
+                                                   len(req.tokens))
+                    got_slot = True
+                    break
+                except NoFreeBlocksError:
+                    victim = self._preempt_one(req)
+                    if victim is None:
+                        break  # nothing left to evict but req itself
+                    preempted.append(victim)
+                    if victim in decodes:
+                        # an earlier arrival lost its claimed slot too
+                        decodes.remove(victim)
+            if got_slot:
+                decodes.append(req)
+            else:
+                # req could not be saved even after evicting every other
+                # candidate: preempt req itself (vLLM recompute)
+                self.running.remove(req)
+                self.block_manager.free(req.request_id)
+                req.preempt()
+                self.waiting.appendleft(req)
+                self.num_preemptions += 1
+                preempted.append(req)
+        if decodes:
+            return ScheduledBatch(kind="decode", requests=decodes,
+                                  preempted=preempted)
+        return ScheduledBatch(kind="idle", preempted=preempted)

@@ -101,6 +101,59 @@ def test_model_checkpoint_manager_delegation(tmp_path):
         m2.network[0].weight.numpy(), m.network[0].weight.numpy())
 
 
+def test_fit_auto_resumes_from_manager_checkpoints(tmp_path):
+    """ROADMAP PR-3 follow-up: Model.fit + manager-backed ModelCheckpoint
+    auto-resumes — a restarted fit restores the newest committed step
+    and trains only the remaining epochs."""
+    m = make_model()
+    ds = RandClsDataset()
+    cb = hapi.ModelCheckpoint(save_dir=str(tmp_path), keep_last_n=3)
+    m.fit(ds, epochs=2, batch_size=16, verbose=0, callbacks=[cb])
+    w_trained = m.network[0].weight.numpy().copy()
+    opt_step = m._optimizer._step_count
+
+    # restart: fresh model, same save_dir -> resumes at epoch 2, runs 2
+    # more; the restored weights match the step-2 checkpoint exactly
+    m2 = make_model()
+    cb2 = hapi.ModelCheckpoint(save_dir=str(tmp_path), keep_last_n=3)
+    restored = {}
+    orig = hapi.ModelCheckpoint.restore_or_initialize
+
+    def spy(self, model=None):
+        step = orig(self, model)
+        if step is not None:
+            restored["step"] = step
+            restored["w"] = model.network[0].weight.numpy().copy()
+            restored["opt_step"] = model._optimizer._step_count
+        return step
+
+    hapi.ModelCheckpoint.restore_or_initialize = spy
+    try:
+        history = m2.fit(ds, epochs=4, batch_size=16, verbose=0,
+                         callbacks=[cb2])
+    finally:
+        hapi.ModelCheckpoint.restore_or_initialize = orig
+    assert restored["step"] == 2
+    np.testing.assert_array_equal(restored["w"], w_trained)
+    assert restored["opt_step"] == opt_step  # Adam bias correction resumes
+    assert len(history) == 2  # only epochs 2 and 3 ran
+
+    # fully-trained dir: resume == epochs, zero epochs run
+    m3 = make_model()
+    h3 = m3.fit(ds, epochs=4, batch_size=16, verbose=0,
+                callbacks=[hapi.ModelCheckpoint(save_dir=str(tmp_path),
+                                                keep_last_n=3)])
+    assert h3 == []
+
+    # opt-out knob trains from scratch
+    m4 = make_model()
+    h4 = m4.fit(ds, epochs=1, batch_size=16, verbose=0,
+                callbacks=[hapi.ModelCheckpoint(save_dir=str(tmp_path),
+                                                keep_last_n=3,
+                                                auto_resume=False)])
+    assert len(h4) == 1
+
+
 def test_model_checkpoint_async_alone_keeps_everything(tmp_path):
     """async_save=True without keep_last_n must not silently enable
     retention — the legacy path kept every epoch checkpoint."""

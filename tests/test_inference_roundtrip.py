@@ -38,6 +38,26 @@ def test_jit_save_weights_only_returns_payload(tmp_path):
     assert "state_dict" in payload
 
 
+def test_jit_load_weights_only_contract(tmp_path):
+    """The documented save/load asymmetry: without input_spec, load
+    returns a WeightsOnlyPayload — usable as a dict, loadable into a
+    rebuilt Layer, and CALLING it raises a clear error naming the fix
+    (not a bare 'dict is not callable')."""
+    net = nn.Linear(4, 4)
+    path = str(tmp_path / "w")
+    paddle.jit.save(net, path)
+    payload = paddle.jit.load(path)
+    assert isinstance(payload, paddle.jit.WeightsOnlyPayload)
+    with pytest.raises(RuntimeError, match="input_spec"):
+        payload(paddle.randn([2, 4]))
+    # the supported path: rebuild + set_state_dict
+    net2 = nn.Linear(4, 4)
+    net2.set_state_dict(payload["state_dict"])
+    np.testing.assert_array_equal(net2.weight.numpy(),
+                                  net.weight.numpy())
+    assert sorted(payload.state_dict()) == sorted(net.state_dict())
+
+
 def test_trainstep_with_gradscaler_skips_on_overflow():
     paddle.seed(1)
     m = nn.Linear(4, 4)

@@ -1,0 +1,249 @@
+"""serving.BlockManager / Scheduler invariants (model-free fast tests).
+
+Pins the tentpole's allocator + scheduler contracts: exact free-block
+accounting under randomized admit/decode/free/preempt sequences, no
+double allocation, preempted requests re-admit and finish, and the
+FCFS starvation guard (waiting requests eventually run)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (
+    BlockManager, NoFreeBlocksError, Request, RequestStatus,
+    SamplingParams, Scheduler, SchedulerConfig,
+)
+
+
+def _req(rid, n_prompt, max_new=4, arrival=None):
+    r = Request(request_id=str(rid), prompt_ids=list(range(1, n_prompt + 1)),
+                sampling=SamplingParams(max_new_tokens=max_new))
+    if arrival is not None:
+        r.arrival_time = arrival
+    return r
+
+
+# ---------------------------------------------------------------------------
+# BlockManager
+# ---------------------------------------------------------------------------
+def test_block_manager_allocate_append_free_accounting():
+    bm = BlockManager(num_blocks=8, block_size=4)
+    t = bm.allocate("a", 10)             # 3 blocks
+    assert len(t) == 3 and bm.num_free_blocks == 5
+    # growth inside the last block costs nothing
+    assert bm.append_slot("a", 11) == t and bm.num_free_blocks == 5
+    assert bm.append_slot("a", 12) == t
+    # crossing a block boundary claims exactly one
+    t2 = bm.append_slot("a", 13)
+    assert len(t2) == 4 and bm.num_free_blocks == 4
+    assert bm.free("a") == 4
+    assert bm.num_free_blocks == 8
+    assert bm.free("a") == 0             # idempotent
+    bm.check_invariants()
+
+
+def test_block_manager_rejects_double_allocation():
+    bm = BlockManager(num_blocks=4, block_size=4)
+    bm.allocate("a", 4)
+    with pytest.raises(ValueError, match="already holds"):
+        bm.allocate("a", 4)
+
+
+def test_block_manager_oom_signals():
+    bm = BlockManager(num_blocks=2, block_size=4)
+    bm.allocate("a", 8)
+    assert not bm.can_allocate(1)
+    with pytest.raises(NoFreeBlocksError):
+        bm.allocate("b", 1)
+    with pytest.raises(NoFreeBlocksError):
+        bm.append_slot("a", 9)
+    bm.check_invariants()
+
+
+def test_block_manager_randomized_invariants():
+    """Randomized admit/grow/free/preempt storm; the exact-accounting
+    invariants must hold after EVERY operation."""
+    rng = np.random.default_rng(0)
+    bm = BlockManager(num_blocks=16, block_size=4)
+    lens = {}
+    for step in range(2000):
+        op = rng.integers(0, 3)
+        if op == 0:  # admit
+            rid = f"r{step}"
+            n = int(rng.integers(1, 20))
+            if bm.can_allocate(n):
+                bm.allocate(rid, n)
+                lens[rid] = n
+            else:
+                with pytest.raises(NoFreeBlocksError):
+                    bm.allocate(rid, n)
+        elif op == 1 and lens:  # grow (a decode slot)
+            rid = list(lens)[int(rng.integers(0, len(lens)))]
+            new_len = lens[rid] + 1
+            if bm.can_append(rid, new_len):
+                bm.append_slot(rid, new_len)
+                lens[rid] = new_len
+            else:
+                with pytest.raises(NoFreeBlocksError):
+                    bm.append_slot(rid, new_len)
+        elif op == 2 and lens:  # free (finish OR preempt-reclaim)
+            rid = list(lens)[int(rng.integers(0, len(lens)))]
+            got = bm.free(rid)
+            assert got == bm.blocks_needed(lens.pop(rid))
+        bm.check_invariants()
+    for rid in list(lens):
+        bm.free(rid)
+    assert bm.num_free_blocks == 16
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+def _drive(sched, max_iters=200):
+    """Minimal engine loop: run scheduled batches, append one token per
+    scheduled request per iteration, retire finished requests. Returns
+    the per-iteration batch kinds."""
+    kinds = []
+    for _ in range(max_iters):
+        if not sched.has_unfinished():
+            break
+        batch = sched.schedule()
+        kinds.append(batch.kind)
+        assert not (batch.is_empty and batch.kind != "idle")
+        for r in batch.requests:
+            r.num_cached += len(r.tokens_to_run())
+            if r.append_token(7):
+                sched.finish(r)
+        sched.block_manager.check_invariants()
+    assert not sched.has_unfinished(), "starved requests remain"
+    return kinds
+
+
+def test_scheduler_interleaves_prefill_and_decode():
+    bm = BlockManager(num_blocks=64, block_size=4)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4,
+                                      max_batched_tokens=64))
+    for i in range(3):
+        s.add(_req(i, n_prompt=5, max_new=3, arrival=float(i)))
+    kinds = _drive(s)
+    assert kinds[0] == "prefill"
+    assert "decode" in kinds
+    assert bm.num_free_blocks == 64
+
+
+def test_scheduler_token_budget_splits_prefill_batches():
+    bm = BlockManager(num_blocks=64, block_size=4)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=8,
+                                      max_batched_tokens=10))
+    for i in range(4):
+        s.add(_req(i, n_prompt=6, max_new=1, arrival=float(i)))
+    b1 = s.schedule()
+    assert b1.kind == "prefill" and len(b1.requests) == 1  # 6+6 > 10
+    b2 = s.schedule()
+    assert b2.kind == "prefill" and len(b2.requests) == 1
+
+
+def test_scheduler_overbudget_prompt_admitted_alone():
+    bm = BlockManager(num_blocks=64, block_size=4)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=8,
+                                      max_batched_tokens=8))
+    s.add(_req("big", n_prompt=20, max_new=1))
+    b = s.schedule()
+    assert b.kind == "prefill" and len(b.requests) == 1
+
+
+def test_scheduler_preempts_latest_arrival_on_oom():
+    """Two requests decoding in a cache with room for only one to grow:
+    the LATER arrival is evicted, reclaims its blocks, lands at the
+    front of the waiting queue, and its progress is preserved."""
+    bm = BlockManager(num_blocks=4, block_size=2)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4))
+    a = _req("a", n_prompt=4, max_new=8, arrival=1.0)
+    b = _req("b", n_prompt=4, max_new=8, arrival=2.0)
+    for r in (a, b):
+        s.add(r)
+    batch = s.schedule()       # both prefill: 2 blocks each, cache full
+    assert [r.request_id for r in batch.requests] == ["a", "b"]
+    for r in batch.requests:
+        r.num_cached += len(r.tokens_to_run())
+        r.append_token(7)
+    batch = s.schedule()       # both need a slot; only b's eviction frees one
+    assert batch.kind == "decode"
+    assert [r.request_id for r in batch.requests] == ["a"]
+    assert [r.request_id for r in batch.preempted] == ["b"]
+    assert b.status == RequestStatus.WAITING
+    assert b.num_cached == 0 and len(b.tokens) == 5  # progress kept
+    assert b.num_preemptions == 1
+    assert s.waiting[0] is b
+    bm.check_invariants()
+
+
+def test_scheduler_starvation_guard_all_requests_finish():
+    """More requests than max_num_seqs and a tight cache: every request
+    (including preempted ones) must still run to completion — FCFS
+    admission + evict-from-the-back guarantees forward progress."""
+    bm = BlockManager(num_blocks=8, block_size=2)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=2,
+                                      max_batched_tokens=16))
+    reqs = [_req(i, n_prompt=3 + (i % 3), max_new=4, arrival=float(i))
+            for i in range(6)]
+    for r in reqs:
+        s.add(r)
+    _drive(s)
+    assert all(r.is_finished for r in reqs)
+    assert bm.num_free_blocks == 8
+
+
+def test_scheduler_randomized_storm():
+    """Random arrivals + tight memory: preempted requests re-admit and
+    finish; block accounting stays exact throughout."""
+    rng = np.random.default_rng(1)
+    bm = BlockManager(num_blocks=10, block_size=2)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=3,
+                                      max_batched_tokens=32))
+    reqs = []
+    for it in range(400):
+        if len(reqs) < 20 and rng.random() < 0.2:
+            r = _req(f"s{len(reqs)}", n_prompt=int(rng.integers(1, 8)),
+                     max_new=int(rng.integers(1, 6)), arrival=float(it))
+            reqs.append(r)
+            s.add(r)
+        if not s.has_unfinished():
+            continue
+        batch = s.schedule()
+        for r in batch.requests:
+            r.num_cached += len(r.tokens_to_run())
+            if r.append_token(int(rng.integers(0, 100))):
+                s.finish(r)
+        bm.check_invariants()
+    _drive(s, max_iters=500)
+    assert len(reqs) == 20 and all(r.is_finished for r in reqs)
+    assert bm.num_free_blocks == 10
+
+
+def test_scheduler_abort():
+    bm = BlockManager(num_blocks=8, block_size=2)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4))
+    a, b = _req("a", 4), _req("b", 4)
+    s.add(a), s.add(b)
+    s.schedule()
+    assert s.abort("a") and not s.abort("zz")
+    assert a.status == RequestStatus.FINISHED
+    assert "a" not in [r.request_id for r in s.running]
+    bm.check_invariants()
+
+
+def test_request_and_sampling_validation():
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request(request_id="x", prompt_ids=[])
+    with pytest.raises(ValueError):
+        SamplingParams(max_new_tokens=0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=0.0)
+    r = _req("x", 3, max_new=2)
+    assert r.append_token(5) is False
+    assert r.append_token(6) is True      # max_new_tokens reached
+    assert r.is_finished and r.generated == [5, 6]
+    r2 = Request(request_id="y", prompt_ids=[1, 2],
+                 sampling=SamplingParams(max_new_tokens=9,
+                                         eos_token_id=42))
+    assert r2.append_token(41) is False
+    assert r2.append_token(42) is True    # EOS
