@@ -1,0 +1,38 @@
+"""tracecheck: static trace-safety / host-sync / donation analysis.
+
+The reference framework ships whole-program checkers over its IR (PIR
+passes, SOT guard analysis). This package is the trace-native analog:
+a dependency-free AST analyzer for the bug classes XLA tracing makes
+possible — accidental device->host syncs on hot paths, use of donated
+buffers, host state frozen at trace time — applied to this repo by the
+tier-1 self-lint gate (tests/test_lint_clean.py).
+
+CLI::
+
+    python -m paddle_tpu.analysis paddle_tpu tests/mp_scripts
+    tpulint --list-rules
+    tpulint --format=json --baseline .tpulint-baseline.json paddle_tpu
+
+Library::
+
+    from paddle_tpu.analysis import analyze_paths, analyze_source
+    findings = analyze_paths(["paddle_tpu"])
+
+Suppressions: ``# tpulint: disable=<rule> (reason)`` — the reason is
+mandatory (an empty one is itself a ``bad-suppression`` finding).
+"""
+from paddle_tpu.analysis.analyzer import (  # noqa: F401
+    ModuleContext, analyze_paths, analyze_source, iter_python_files,
+)
+from paddle_tpu.analysis.baseline import (  # noqa: F401
+    apply_baseline, load_baseline, write_baseline,
+)
+from paddle_tpu.analysis.registry import (  # noqa: F401
+    Finding, Rule, get_rule, get_rules,
+)
+
+__all__ = [
+    "ModuleContext", "analyze_paths", "analyze_source",
+    "iter_python_files", "apply_baseline", "load_baseline",
+    "write_baseline", "Finding", "Rule", "get_rule", "get_rules",
+]

@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu.analysis <paths>``."""
+import sys
+
+from paddle_tpu.analysis.cli import main
+
+sys.exit(main())

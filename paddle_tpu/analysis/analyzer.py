@@ -1,0 +1,315 @@
+"""tracecheck engine: per-module orchestration + inline suppressions.
+
+``analyze_source`` parses one module, builds the shared context every
+rule needs (parent map, import aliases, traced-scope index, jitted-
+dispatch bindings), runs the registered rules, and applies inline
+suppressions.
+
+Suppression syntax (the policy: EVERY suppression carries a reason)::
+
+    x = foo()  # tpulint: disable=host-sync-in-traced (B-sized fetch)
+
+    # tpulint: disable=use-after-donate (buffer rebound two lines down)
+    y = step(x)
+
+A same-line comment suppresses findings on that line; a standalone
+comment line suppresses the next statement line. A suppression with no
+``(reason)`` — or naming a rule that doesn't exist — is itself reported
+under the ``bad-suppression`` meta rule, so silent/typo'd disables
+can't pass the self-lint gate.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.context import (
+    ImportTable, TraceIndex, build_parent_map, dotted_name,
+)
+from paddle_tpu.analysis.registry import (
+    META_RULES, Finding, get_rules,
+)
+
+__all__ = ["ModuleContext", "analyze_source", "analyze_paths",
+           "iter_python_files"]
+
+# the reason group is GREEDY to the last ')' so reasons may contain
+# parentheses: `disable=rule (see PR (2) notes)` parses whole
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\-\s]+?)"
+    r"(?:\s*\((?P<reason>.*)\))?\s*$")
+
+
+class JitBindings:
+    """Names/attributes bound to ``jax.jit(...)`` results in a module.
+
+    Two consumers: *use-after-donate* needs the donated argument
+    positions of each binding; *host-sync-in-traced* needs to know which
+    calls are compiled dispatches so per-step host fetches of their
+    results can be flagged. ``self.<attr>`` bindings are tracked per
+    enclosing class (bound in ``__init__``, dispatched in ``step``)."""
+
+    def __init__(self, tree: ast.AST, parents, imports: ImportTable):
+        # key: ("local", id(scope), name) or ("class", id(cls), "self.x")
+        self.donate: Dict[Tuple, Set[int]] = {}
+        self.jitted: Set[Tuple] = set()
+        self._parents = parents
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not (isinstance(call, ast.Call)
+                    and imports.canonical(dotted_name(call.func))
+                    == "jax.jit"):
+                continue
+            donated = self._donated_positions(call)
+            for tgt in node.targets:
+                key = self._key_for(tgt)
+                if key is None:
+                    continue
+                self.jitted.add(key)
+                if donated:
+                    self.donate[key] = donated
+
+    @staticmethod
+    def _literal_positions(node) -> Optional[Set[int]]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = set()
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+                else:
+                    return None
+            return out
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return {node.value}
+        return None
+
+    def _donated_positions(self, call: ast.Call) -> Set[int]:
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            if isinstance(kw.value, ast.IfExp):
+                # donate_argnums=(4, 5) if donate else (): union of arms
+                a = self._literal_positions(kw.value.body)
+                b = self._literal_positions(kw.value.orelse)
+                if a is not None and b is not None:
+                    return a | b
+                return set()
+            pos = self._literal_positions(kw.value)
+            return pos or set()
+        return set()
+
+    def _enclosing(self, node, kinds):
+        cur = self._parents.get(id(node))
+        while cur is not None and not isinstance(cur, kinds):
+            cur = self._parents.get(id(cur))
+        return cur
+
+    def _key_for(self, tgt: ast.AST) -> Optional[Tuple]:
+        if isinstance(tgt, ast.Name):
+            scope = self._enclosing(
+                tgt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module))
+            return ("local", id(scope), tgt.id)
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            cls = self._enclosing(tgt, (ast.ClassDef,))
+            if cls is not None:
+                return ("class", id(cls), f"self.{tgt.attr}")
+        return None
+
+    def lookup(self, call_func: ast.AST) -> Optional[Tuple]:
+        """The binding key a call target refers to, if it's a known
+        jitted binding (resolves plain names and ``self.attr``)."""
+        if isinstance(call_func, ast.Name):
+            scope = self._enclosing(
+                call_func,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module))
+            while True:
+                key = ("local", id(scope), call_func.id)
+                if key in self.jitted:
+                    return key
+                if isinstance(scope, ast.Module) or scope is None:
+                    return None
+                scope = self._enclosing(
+                    scope,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module))
+        if isinstance(call_func, ast.Attribute) and \
+                isinstance(call_func.value, ast.Name) and \
+                call_func.value.id == "self":
+            cls = self._enclosing(call_func, (ast.ClassDef,))
+            if cls is not None:
+                key = ("class", id(cls), f"self.{call_func.attr}")
+                if key in self.jitted:
+                    return key
+        return None
+
+
+class ModuleContext:
+    """Everything a rule needs about one module."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents = build_parent_map(self.tree)
+        self.imports = ImportTable(self.tree)
+        self.traces = TraceIndex(self.tree, self.parents, self.imports)
+        self.jit_bindings = JitBindings(self.tree, self.parents,
+                                        self.imports)
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        return self.imports.canonical(dotted_name(node))
+
+    def trace_reason(self, node: ast.AST) -> Optional[str]:
+        return self.traces.trace_reason(node)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.path, line=line,
+                       col=getattr(node, "col_offset", 0),
+                       message=message, snippet=self.line_text(line),
+                       end_line=getattr(node, "end_lineno", None) or line)
+
+
+def _comment_lines(source: str) -> Dict[int, str]:
+    """line -> comment text, via tokenize — so `tpulint: disable=`
+    examples inside docstrings/string literals are NOT live
+    suppressions. Falls back to a raw line scan if tokenize chokes
+    (shouldn't happen on a file ast.parse accepted)."""
+    import io
+    import tokenize
+
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, text in enumerate(source.splitlines(), start=1):
+            if "#" in text:
+                out[i] = text
+    return out
+
+
+class _Suppressions:
+    """line -> suppressed rule set; built from COMMENT tokens only."""
+
+    def __init__(self, source: str, lines: Sequence[str], path: str,
+                 known_rules: Set[str]):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.bad: List[Finding] = []
+        comments = _comment_lines(source)
+        # standalone suppression comments accumulate (stacked disables
+        # above one statement all apply) until a statement consumes them
+        pending: Set[str] = set()
+        for i, text in enumerate(lines, start=1):
+            stripped = text.strip()
+            comment = comments.get(i)
+            m = _SUPPRESS_RE.search(comment) if comment else None
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                reason = (m.group("reason") or "").strip() or None
+                if reason is None:
+                    self.bad.append(Finding(
+                        rule="bad-suppression", path=path, line=i, col=0,
+                        message="suppression without a reason — policy "
+                                "is '# tpulint: disable=<rule> "
+                                "(reason)'", snippet=text))
+                unknown = rules - known_rules - {"all"}
+                if unknown:
+                    self.bad.append(Finding(
+                        rule="bad-suppression", path=path, line=i, col=0,
+                        message=f"suppression names unknown rule(s): "
+                                f"{', '.join(sorted(unknown))}",
+                        snippet=text))
+                if stripped.startswith("#"):
+                    pending |= rules  # applies to the next statement
+                else:
+                    self.by_line[i] = rules | pending
+                    pending = set()
+                continue
+            if pending and stripped and not stripped.startswith("#"):
+                self.by_line[i] = set(pending)
+                pending = set()
+
+    def covers(self, finding: Finding) -> bool:
+        # any suppression line within the flagged node's span counts —
+        # a wrapped statement's trailing comment sits on its LAST line
+        for line in range(finding.line, finding.end_line + 1):
+            rules = self.by_line.get(line)
+            if rules is not None and (finding.rule in rules
+                                      or "all" in rules):
+                return True
+        return False
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   disabled: Sequence[str] = ()) -> List[Finding]:
+    """Run every registered rule over one module's source. Returns
+    unsuppressed findings (plus ``bad-suppression`` meta findings),
+    sorted by position."""
+    rules = get_rules()
+    known = set(rules) | set(META_RULES)
+    try:
+        module = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=path,
+                        line=e.lineno or 1, col=(e.offset or 1) - 1,
+                        message=f"cannot analyze: {e.msg}")]
+    sup = _Suppressions(source, module.lines, path, known)
+    findings: List[Finding] = []
+    for name, rule in rules.items():
+        if name in disabled:
+            continue
+        findings.extend(rule.check(module))
+    findings = [f for f in findings if not sup.covers(f)]
+    if "bad-suppression" not in disabled:
+        findings.extend(sup.bad)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  disabled: Sequence[str] = ()) -> List[Finding]:
+    """Analyze every ``.py`` under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            # one unreadable/latin-1 vendored file must not kill the
+            # whole run — report it like a syntax error and move on
+            findings.append(Finding(
+                rule="parse-error", path=path, line=1, col=0,
+                message=f"cannot read: {e}"))
+            continue
+        findings.extend(analyze_source(src, path=path, disabled=disabled))
+    return findings

@@ -1,0 +1,72 @@
+"""Baseline files: adopt a new rule without blocking unrelated PRs.
+
+A baseline is a JSON snapshot of currently-accepted findings. The CLI
+with ``--baseline FILE`` subtracts it from the report (exit code stays
+0 if everything found is baselined); ``--write-baseline`` (re)generates
+it from the current tree. Fingerprints hash the rule + path +
+normalized source LINE TEXT — not line numbers — so edits elsewhere in
+a file don't invalidate entries, and a baselined line that moves
+untouched stays baselined.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from paddle_tpu.analysis.registry import Finding
+
+__all__ = ["fingerprints", "load_baseline", "write_baseline",
+           "apply_baseline"]
+
+_VERSION = 1
+
+
+def fingerprints(findings: List[Finding]) -> List[str]:
+    """Per-finding fingerprints, disambiguating identical lines by
+    occurrence order (stable under unrelated edits)."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, " ".join(f.snippet.split()))
+        occ = counts.get(key, 0)
+        counts[key] = occ + 1
+        out.append(f.fingerprint(occurrence=occ))
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {path!r} has version {data.get('version')!r}, "
+            f"expected {_VERSION}")
+    return dict(data.get("fingerprints", {}))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> int:
+    entries = {
+        fp: f"{f.rule} {f.path}:{f.line} {f.message[:80]}"
+        for fp, f in zip(fingerprints(findings), findings)}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"version": _VERSION, "fingerprints": entries}, fh,
+                  indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, str]) -> Tuple[List[Finding], int]:
+    """(new findings, number suppressed by the baseline)."""
+    fresh: List[Finding] = []
+    hits = 0
+    for fp, f in zip(fingerprints(findings), findings):
+        if fp in baseline:
+            f.baselined = True
+            hits += 1
+        else:
+            fresh.append(f)
+    return fresh, hits

@@ -1,0 +1,109 @@
+"""tpulint CLI — ``python -m paddle_tpu.analysis`` / ``tpulint``.
+
+Exit codes: 0 clean (or everything baselined), 1 findings, 2 usage
+error. ``--format=json`` emits one machine-readable object for CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from paddle_tpu.analysis.analyzer import analyze_paths
+from paddle_tpu.analysis.baseline import (
+    apply_baseline, load_baseline, write_baseline,
+)
+from paddle_tpu.analysis.registry import META_RULES, get_rules
+
+__all__ = ["main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="tracecheck: trace-safety / host-sync / donation "
+                    "linter for paddle_tpu code",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to analyze")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="JSON baseline of accepted findings to subtract")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to --baseline and "
+                        "exit 0")
+    p.add_argument("--disable", metavar="RULES", default="",
+                   help="comma-separated rule names to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _list_rules() -> str:
+    lines = []
+    for name, rule in sorted(get_rules().items()):
+        lines.append(f"{name}")
+        lines.append(f"    {rule.summary}")
+    lines.append("meta: " + ", ".join(META_RULES) +
+                 " (emitted by the engine itself)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        print("tpulint: no paths given (see --help)", file=sys.stderr)
+        return 2
+    disabled = [r.strip() for r in args.disable.split(",") if r.strip()]
+    known = set(get_rules()) | set(META_RULES)
+    unknown = [r for r in disabled if r not in known]
+    if unknown:
+        print(f"tpulint: --disable names unknown rule(s): "
+              f"{', '.join(unknown)}", file=sys.stderr)
+        return 2
+    try:
+        findings = analyze_paths(args.paths, disabled=disabled)
+    except FileNotFoundError as e:
+        print(f"tpulint: no such path: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("tpulint: --write-baseline needs --baseline FILE",
+                  file=sys.stderr)
+            return 2
+        n = write_baseline(args.baseline, findings)
+        print(f"tpulint: wrote {n} fingerprint(s) to {args.baseline}")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            base = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tpulint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        findings, baselined = apply_baseline(findings, base)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "count": len(findings),
+            "baselined": baselined,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = f"tpulint: {len(findings)} finding(s)"
+        if baselined:
+            tail += f" ({baselined} more suppressed by baseline)"
+        print(tail)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

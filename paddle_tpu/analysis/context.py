@@ -1,0 +1,321 @@
+"""Module-level AST context: name resolution + traced-scope discovery.
+
+The heart of tracecheck. The reference framework's SOT analyses walk
+bytecode with full guard state; here one cheap pass over a module's AST
+answers the question every rule asks: *does this statement execute at
+trace time?* A function body is a traced region when the function is
+
+* decorated with a tracing transform (``@jax.jit``, ``@jax.checkpoint``,
+  ``@to_static``, ...),
+* passed to one (``jax.jit(fn)``, ``functionalize(fn)``,
+  ``jax.lax.scan(body, ...)``, ``jax.value_and_grad(f)``, ...) — either
+  as a name, a lambda, or via ONE level of factory indirection
+  (``jax.jit(make_step(...))`` marks the function ``make_step``
+  returns), or
+* lexically nested inside a traced function.
+
+On top of that, a lightweight call graph follows ONE level of plain-name
+helper calls out of each traced body (``step_fn`` calling module-level
+``_merge`` marks ``_merge`` traced-reachable) — the documented depth
+limit; attribute calls (``self._apply(...)``) and deeper chains are not
+chased.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["build_parent_map", "ImportTable", "TraceIndex", "dotted_name",
+           "FunctionIndex", "TRACE_WRAPPERS", "TRACE_SUFFIXES",
+           "FUNC_NODES", "STATIC_TENSOR_ATTRS", "walk_own"]
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_FUNC_NODES = FUNC_NODES
+
+# attribute reads that return host metadata, never a tracer — shared by
+# the host-sync and tensor-bool rules so the exemption list can't drift
+STATIC_TENSOR_ATTRS = ("shape", "ndim", "dtype", "size", "itemsize",
+                       "sharding", "nbytes")
+
+
+def walk_own(fdef: ast.AST):
+    """Walk a function's body WITHOUT descending into nested function
+    defs — their names and statements belong to their own scope and
+    get their own analysis pass."""
+    stack = list(ast.iter_child_nodes(fdef))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FUNC_NODES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def build_parent_map(tree: ast.AST) -> Dict[int, ast.AST]:
+    """id(node) -> parent node, for the whole tree."""
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportTable:
+    """alias -> canonical dotted module/object path for one module."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def canonical(self, dotted: Optional[str]) -> Optional[str]:
+        """Expand the leading alias: ``jnp.sum`` -> ``jax.numpy.sum``."""
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+# canonical callable -> positions of its function-valued arguments
+TRACE_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.named_call": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.shard_map": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+}
+
+# matched by final path segment, wherever they're imported from: the
+# repo's own tracing entry points
+TRACE_SUFFIXES: Dict[str, Tuple[int, ...]] = {
+    "functionalize": (0,),
+    "to_static": (0,),
+    "shard_map": (0,),
+}
+
+
+def _wrapper_positions(canon: Optional[str]) -> Optional[Tuple[int, ...]]:
+    if canon is None:
+        return None
+    if canon in TRACE_WRAPPERS:
+        return TRACE_WRAPPERS[canon]
+    return TRACE_SUFFIXES.get(canon.rsplit(".", 1)[-1])
+
+
+class FunctionIndex:
+    """Every function/lambda in a module, with its enclosing-scope chain
+    (used to resolve a bare name to the nearest visible def)."""
+
+    def __init__(self, tree: ast.AST, parents: Dict[int, ast.AST]):
+        self.parents = parents
+        self.defs: List[ast.AST] = [
+            n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        for d in self.defs:
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_name.setdefault(d.name, []).append(d)
+
+    def scope_chain(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing function defs of ``node``, innermost first."""
+        chain = []
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                chain.append(cur)
+            cur = self.parents.get(id(cur))
+        return chain
+
+    def resolve(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        """The def a bare ``name`` most plausibly refers to at ``at``:
+        prefers candidates sharing the deepest enclosing scope."""
+        cands = self.by_name.get(name)
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        here = self.scope_chain(at)
+        best, best_depth = cands[0], -1
+        for c in cands:
+            chain = self.scope_chain(c)
+            # depth of the deepest shared enclosing function
+            d = -1
+            for i, anc in enumerate(chain):
+                if any(anc is h for h in here):
+                    d = len(chain) - i
+                    break
+            if d > best_depth:
+                best, best_depth = c, d
+        return best
+
+
+class TraceIndex:
+    """Which functions in a module are traced / traced-reachable."""
+
+    def __init__(self, tree: ast.AST, parents: Dict[int, ast.AST],
+                 imports: ImportTable):
+        self.parents = parents
+        self.imports = imports
+        self.functions = FunctionIndex(tree, parents)
+        # id(def node) -> human reason it's considered traced
+        self.traced: Dict[int, str] = {}
+        self.reachable: Dict[int, str] = {}
+        # "self.attr" -> the Name it was assigned from (one level: the
+        # `self._step_fn = step_fn; jax.jit(self._step_fn)` idiom)
+        self._self_attr_names: Dict[str, ast.Name] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        self._self_attr_names[f"self.{tgt.attr}"] = \
+                            node.value
+        self._discover(tree)
+        self._follow_helpers()
+
+    # -- discovery ------------------------------------------------------
+    def _mark(self, node: Optional[ast.AST], reason: str):
+        if node is not None and id(node) not in self.traced:
+            self.traced[id(node)] = reason
+
+    def _mark_arg(self, arg: ast.AST, reason: str):
+        """A function-valued argument of a trace wrapper: name, lambda,
+        or one level of factory call."""
+        if isinstance(arg, ast.Lambda):
+            self._mark(arg, reason)
+        elif isinstance(arg, ast.Name):
+            self._mark(self.functions.resolve(arg.id, arg), reason)
+        elif isinstance(arg, ast.Attribute):
+            # jax.jit(self._step_fn): chase the attr to its Name binding
+            src = self._self_attr_names.get(dotted_name(arg) or "")
+            if src is not None:
+                self._mark(self.functions.resolve(src.id, src), reason)
+        elif isinstance(arg, ast.Call):
+            # jax.jit(partial(fn, ...)): unwrap partial to fn
+            if self.imports.canonical(dotted_name(arg.func)) in (
+                    "functools.partial", "partial") and arg.args:
+                self._mark_arg(arg.args[0], reason)
+                return
+            # jax.jit(make_step(...)): mark what the factory returns
+            fname = dotted_name(arg.func)
+            if fname and "." not in fname:
+                factory = self.functions.resolve(fname, arg)
+                if factory is not None and not isinstance(factory,
+                                                          ast.Lambda):
+                    for n in ast.walk(factory):
+                        if isinstance(n, ast.Return) and \
+                                isinstance(n.value, ast.Name):
+                            self._mark(
+                                self.functions.resolve(n.value.id, n),
+                                f"{reason} (returned by factory "
+                                f"'{fname}')")
+
+    def _discover(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    canon = self.imports.canonical(dotted_name(target))
+                    # @partial(jax.jit, static_argnums=...) — the idiom
+                    # for jit-with-options: unwrap to the real wrapper
+                    if canon in ("functools.partial", "partial") and \
+                            isinstance(dec, ast.Call) and dec.args:
+                        canon = self.imports.canonical(
+                            dotted_name(dec.args[0]))
+                    if _wrapper_positions(canon) is not None:
+                        self._mark(node, f"decorated with @{canon}")
+            elif isinstance(node, ast.Call):
+                canon = self.imports.canonical(dotted_name(node.func))
+                positions = _wrapper_positions(canon)
+                if positions is None:
+                    continue
+                for pos in positions:
+                    if pos < len(node.args):
+                        self._mark_arg(
+                            node.args[pos],
+                            f"passed to {canon} at line {node.lineno}")
+                for kw in node.keywords:
+                    if kw.arg in ("fun", "f", "body_fun", "cond_fun"):
+                        self._mark_arg(
+                            kw.value,
+                            f"passed to {canon} at line {node.lineno}")
+
+    def _follow_helpers(self):
+        """ONE level of plain-name helper calls out of traced bodies."""
+        for fdef in list(self.functions.defs):
+            if not self._lexically_traced(fdef):
+                continue
+            body = fdef.body if not isinstance(fdef, ast.Lambda) \
+                else [fdef.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)):
+                        continue
+                    helper = self.functions.resolve(node.func.id, node)
+                    if helper is None or id(helper) in self.traced:
+                        continue
+                    if self._lexically_traced(helper):
+                        continue
+                    self.reachable.setdefault(
+                        id(helper),
+                        f"called from traced "
+                        f"'{getattr(fdef, 'name', '<lambda>')}' at line "
+                        f"{node.lineno}")
+
+    # -- queries --------------------------------------------------------
+    def _lexically_traced(self, fdef: ast.AST) -> bool:
+        if id(fdef) in self.traced:
+            return True
+        return any(id(anc) in self.traced
+                   for anc in self.functions.scope_chain(fdef))
+
+    def trace_reason(self, node: ast.AST) -> Optional[str]:
+        """Why the innermost relevant scope of ``node`` is traced (or
+        traced-reachable), else None. This is THE rule-facing query."""
+        chain = [node] if isinstance(node, _FUNC_NODES) else []
+        chain += self.functions.scope_chain(node)
+        for f in chain:
+            if id(f) in self.traced:
+                return self.traced[id(f)]
+        for f in chain:
+            if id(f) in self.reachable:
+                return self.reachable[id(f)]
+        return None
+
+    def traced_functions(self) -> Iterable[ast.AST]:
+        for f in self.functions.defs:
+            if self._lexically_traced(f) or id(f) in self.reachable:
+                yield f

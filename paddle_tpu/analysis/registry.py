@@ -1,0 +1,109 @@
+"""Rule registry: every tracecheck rule self-registers here.
+
+The reference framework runs whole-program checks as registered IR
+passes (PIR's PassRegistry, paddle/pir/pass/); the trace-boundary
+analog is a registry of AST rules, each owning a name, a one-paragraph
+doc (the ``--list-rules`` catalog), and a ``check(module)`` hook that
+returns findings. Registration happens at import of
+``paddle_tpu.analysis.rules``.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+__all__ = ["Finding", "Rule", "register", "get_rules", "get_rule",
+           "META_RULES"]
+
+# rules the engine itself emits (not registered checks): suppression
+# hygiene and unparseable files are handled by the analyzer, not a
+# visitor
+META_RULES = ("bad-suppression", "parse-error")
+
+
+@dataclass
+class Finding:
+    """One violation. ``line``/``col`` are 1-based/0-based like ast."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    # last physical line of the flagged node: a same-line suppression
+    # anywhere in a multi-line statement's span covers the finding
+    end_line: int = 0
+    suppressed: bool = False
+    baselined: bool = False
+
+    def __post_init__(self):
+        if self.end_line < self.line:
+            self.end_line = self.line
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+    def fingerprint(self, occurrence: int = 0) -> str:
+        """Content-addressed id for ``--baseline`` files: hashes the rule,
+        the path and the NORMALIZED source line (not the line number), so
+        edits elsewhere in the file don't churn the baseline.
+        ``occurrence`` disambiguates identical lines."""
+        norm = " ".join(self.snippet.split())
+        raw = f"{self.rule}|{self.path}|{norm}|{occurrence}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def render(self) -> str:
+        out = f"{self.location()}: [{self.rule}] {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet.strip()}"
+        return out
+
+
+@dataclass
+class Rule:
+    """A registered check. ``check`` receives a
+    :class:`~paddle_tpu.analysis.analyzer.ModuleContext` and returns a
+    list of :class:`Finding`."""
+
+    name: str
+    summary: str
+    doc: str
+    check: object = field(repr=False, default=None)
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, summary: str, doc: str):
+    """Decorator: ``@register("rule-name", "one-liner", "full doc")``
+    on a ``check(module) -> List[Finding]`` function."""
+
+    def deco(fn):
+        if name in _RULES:
+            raise ValueError(f"duplicate rule registration: {name!r}")
+        _RULES[name] = Rule(name=name, summary=summary, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
+def get_rules() -> Dict[str, Rule]:
+    # import for side effect: rule modules self-register on first use
+    from paddle_tpu.analysis import rules as _rules  # noqa: F401
+
+    return dict(_RULES)
+
+
+def get_rule(name: str) -> Rule:
+    rules = get_rules()
+    if name not in rules:
+        known = ", ".join(sorted(rules) + list(META_RULES))
+        raise KeyError(f"unknown rule {name!r} (known: {known})")
+    return rules[name]

@@ -1,0 +1,9 @@
+"""tracecheck launch rules. Importing this package registers them all
+(the registry imports it lazily from ``get_rules``)."""
+from paddle_tpu.analysis.rules import (  # noqa: F401
+    counter_leak,
+    host_sync,
+    tensor_bool,
+    trace_impurity,
+    use_after_donate,
+)

@@ -1,0 +1,69 @@
+"""counter-provider-leak: observability counters with no release path.
+
+``profiler.register_counter_provider`` installs a process-global
+callable. A provider registered per object (per TrainStep, per serving
+engine) with no matching ``unregister_counter_provider`` — direct, or
+deferred via ``weakref.finalize`` — accumulates one dead entry per
+construction; ``profiler.counters()`` drops providers lazily, but only
+when something actually reads counters, so a train loop that never
+polls leaks a closure (and whatever it captures) per instance.
+
+Granularity is the module: a register call is flagged when NOTHING in
+the same module references ``unregister_counter_provider``. The
+matched idiom is the one ``jit.TrainStep`` ships::
+
+    _prof.register_counter_provider(name, provider)
+    weakref.finalize(owner, _prof.unregister_counter_provider, name)
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddle_tpu.analysis.context import dotted_name
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _refs_suffix(module, suffix: str) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted_name(node)
+            if name is not None and name.split(".")[-1] == suffix:
+                out.append(node)
+    return out
+
+
+@register(
+    "counter-provider-leak",
+    "register_counter_provider with no unregister path in the module",
+    _DOC)
+def check(module) -> List[Finding]:
+    registers = [
+        node for node in ast.walk(module.tree)
+        if isinstance(node, ast.Call)
+        and (dotted_name(node.func) or "").split(".")[-1]
+        == "register_counter_provider"]
+    if not registers:
+        return []
+    # the defining module (and re-exports) declare, not leak
+    defines = any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name == "register_counter_provider"
+        for n in ast.walk(module.tree))
+    if defines:
+        return []
+    has_unregister = any(
+        not isinstance(module.parents.get(id(n)), ast.Attribute)
+        for n in _refs_suffix(module, "unregister_counter_provider"))
+    if has_unregister:
+        return []
+    return [module.finding(
+        "counter-provider-leak", node,
+        "register_counter_provider with no unregister_counter_provider "
+        "reference anywhere in this module — pair it with a direct "
+        "unregister or weakref.finalize(owner, "
+        "unregister_counter_provider, name), or every constructed "
+        "owner leaks a provider entry") for node in registers]

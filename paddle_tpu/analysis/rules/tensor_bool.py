@@ -1,0 +1,157 @@
+"""tensor-bool-branch: Python control flow on a traced tensor.
+
+``if``/``while`` on a tensor value inside a traced function either
+raises TracerBoolConversionError at trace time or — through the SOT
+fallback — silently specializes the graph on one branch. The in-graph
+spellings (``jnp.where``, ``lax.cond``, ``lax.select``) keep the branch
+on device.
+
+Detection is a per-function forward taint pass: a name is
+tensor-tainted when assigned from a ``jnp.*`` / ``jax.random.*`` /
+``jax.lax.*`` call, from arithmetic/comparison/indexing over a tainted
+value, or from a method call on one. ``if``/``while`` tests referencing
+a tainted value are flagged. Deliberately NOT tainted: function
+parameters (host flags are too common), ``is``/``is not`` tests
+(identity is host-safe even on tracers), and static attributes
+(``.shape``, ``.ndim``, ``.dtype``, ``.size``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from paddle_tpu.analysis.context import STATIC_TENSOR_ATTRS
+from paddle_tpu.analysis.registry import Finding, register
+
+_TENSOR_NAMESPACES = ("jax.numpy.", "jax.random.", "jax.lax.",
+                      "jax.nn.")
+# jnp calls that return HOST values (python bools/dtypes), not tracers
+_HOST_RESULT_CALLS = {
+    "jax.numpy.issubdtype", "jax.numpy.isdtype", "jax.numpy.dtype",
+    "jax.numpy.shape", "jax.numpy.ndim", "jax.numpy.size",
+    "jax.numpy.result_type", "jax.numpy.promote_types",
+    "jax.numpy.can_cast", "jax.numpy.iinfo", "jax.numpy.finfo",
+}
+
+_DOC = __doc__
+
+
+def _is_tensor_call(module, call: ast.Call) -> bool:
+    canon = module.canonical(call.func)
+    return canon is not None and canon not in _HOST_RESULT_CALLS and \
+        any(canon.startswith(ns) for ns in _TENSOR_NAMESPACES)
+
+
+class _Taint:
+    def __init__(self, module):
+        self.module = module
+        self.tainted: Set[str] = set()
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            if _is_tensor_call(self.module, node):
+                return True
+            # method on a tainted value: t.sum()
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                return self.expr_tainted(f.value)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_TENSOR_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr_tainted(node.left) or \
+                self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self.expr_tainted(node.left) or \
+                any(self.expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.expr_tainted(node.body) or \
+                self.expr_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        return False
+
+    def absorb(self, stmt: ast.stmt):
+        """Track assignments (in statement order within the body)."""
+        if isinstance(stmt, ast.Assign) and \
+                self.expr_tainted(stmt.value):
+            for tgt in stmt.targets:
+                self._taint_target(tgt)
+        elif isinstance(stmt, ast.AugAssign) and (
+                self.expr_tainted(stmt.value)
+                or self.expr_tainted(stmt.target)):
+            self._taint_target(stmt.target)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and self.expr_tainted(stmt.value):
+            self._taint_target(stmt.target)
+        elif isinstance(stmt, ast.For) and self.expr_tainted(stmt.iter):
+            # iterating a tainted value taints the loop variable
+            # (`for g in grads: if g.sum() > 0` is the classic shape)
+            self._taint_target(stmt.target)
+        elif isinstance(stmt, ast.Assign):
+            # reassignment from an untainted value clears the taint
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.tainted.discard(tgt.id)
+
+    def _taint_target(self, tgt: ast.AST):
+        if isinstance(tgt, ast.Name):
+            self.tainted.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._taint_target(e)
+
+
+def _walk_body(module, body, taint: _Taint, out: List[Finding]):
+    for stmt in body:
+        taint.absorb(stmt)
+        if isinstance(stmt, (ast.If, ast.While)) and \
+                taint.expr_tainted(stmt.test):
+            kw = "while" if isinstance(stmt, ast.While) else "if"
+            out.append(module.finding(
+                "tensor-bool-branch", stmt,
+                f"`{kw}` on a traced tensor value — this either raises "
+                f"at trace time or bakes one branch into the graph; "
+                f"use jnp.where / lax.cond / lax.select instead"))
+        # recurse into nested statement blocks with the same taint state
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub and not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                _walk_body(module, sub, taint, out)
+        for h in getattr(stmt, "handlers", []) or []:
+            _walk_body(module, h.body, taint, out)
+
+
+@register(
+    "tensor-bool-branch",
+    "if/while on a tensor value under trace",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+    for fdef in module.traces.traced_functions():
+        if isinstance(fdef, ast.Lambda):
+            continue
+        taint = _Taint(module)
+        _walk_body(module, fdef.body, taint, out)
+    # dedupe: nested traced defs are visited via their parents too
+    seen, uniq = set(), []
+    for f in out:
+        key = (f.line, f.col)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq

@@ -1,0 +1,182 @@
+"""use-after-donate: reading a buffer after XLA consumed it.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument buffers to XLA
+for in-place reuse — the single biggest copy_frac lever (PR 2) — but
+the Python reference left behind is DEAD: touching it raises jax's
+"Array has been deleted" at some arbitrary later point, far from the
+donation site. This rule tracks, per function, names and ``self.attr``s
+passed at donated positions of a known jitted binding and flags any
+later read that happens before a reassignment.
+
+Bindings are collected module-wide: ``step = jax.jit(f,
+donate_argnums=(0,))`` locally, and ``self._step = jax.jit(...)``
+per class (bound in ``__init__``, dispatched elsewhere). Donated
+positions must be literal ints/tuples (a ``(4, 5) if donate else ()``
+conditional counts as its union); computed positions are skipped
+rather than guessed. Statement order is source order with forked
+``if``/``else`` branches (a donation in one branch doesn't poison the
+other); loops are not re-entered, so a donation consumed on iteration
+2 needs a human eye (and the runtime guard in
+``jit.TrainStep._check_donated_state``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _ref_of(node: ast.AST) -> Optional[str]:
+    """'x' for Name, 'self.x' for self attributes, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+class _FnState:
+    def __init__(self, module, fdef, dead=None, findings=None):
+        self.module = module
+        self.fdef = fdef
+        self.dead: Dict[str, int] = dict(dead or {})  # ref -> donate line
+        self.findings: List[Finding] = findings if findings is not None \
+            else []
+
+    def fork(self) -> "_FnState":
+        """Branch copy: own dead-set, SHARED findings list."""
+        return _FnState(self.module, self.fdef, dead=self.dead,
+                        findings=self.findings)
+
+    def merge(self, branches: List["_FnState"]):
+        """After mutually-exclusive branches: a ref donated in ANY
+        branch is conservatively dead afterwards; one revived in every
+        branch is alive."""
+        merged: Dict[str, int] = {}
+        for b in branches:
+            merged.update(b.dead)
+        self.dead = merged
+
+    def run_stmt(self, stmt: ast.stmt):
+        """The three phases over one simple statement (order matters:
+        loads are checked BEFORE this statement's donation takes
+        effect, and stores revive last, so `x = step(x)` is clean
+        while `y = step(x); use(x)` is not)."""
+        self.check_loads(stmt)
+        self.mark_donations(stmt)
+        self.revive_stores(stmt)
+
+    def check_loads(self, stmt: ast.AST):
+        # loads run BEFORE this statement's donation takes effect, so
+        # the donating call's own arguments are never falsely flagged —
+        # and a name ALREADY dead here is a bug wherever it appears,
+        # including as an argument of another compiled dispatch
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                ref = _ref_of(node)
+                if ref in self.dead:
+                    self.findings.append(self.module.finding(
+                        "use-after-donate", node,
+                        f"'{ref}' was donated to the compiled dispatch "
+                        f"at line {self.dead[ref]} and never "
+                        f"reassigned — its buffer is dead (jax will "
+                        f"raise 'Array has been deleted'); rebind it "
+                        f"from the dispatch outputs first"))
+
+    def mark_donations(self, stmt: ast.stmt):
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            key = self.module.jit_bindings.lookup(call.func)
+            if key is None:
+                continue
+            positions = self.module.jit_bindings.donate.get(key)
+            if not positions:
+                continue
+            for pos in positions:
+                if pos < len(call.args):
+                    ref = _ref_of(call.args[pos])
+                    if ref is not None:
+                        self.dead[ref] = call.lineno
+
+    def revive_stores(self, stmt: ast.stmt):
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None),
+                               (ast.Store, ast.Del)):
+                ref = _ref_of(node)
+                if ref is not None:
+                    self.dead.pop(ref, None)
+
+
+def _run_block(state: _FnState, body) -> None:
+    """Statements in source order; ``if``/``else`` branches run on
+    FORKED dead-sets and merge after (a donation in one branch must not
+    poison the mutually-exclusive other). Loop bodies run once in line
+    order — a donation consumed on iteration 2 needs a human eye (and
+    the runtime guard in ``jit.TrainStep._check_donated_state``)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested defs get their own pass
+        if isinstance(stmt, ast.If):
+            state.run_stmt(stmt.test)
+            branches = []
+            for sub in (stmt.body, stmt.orelse):
+                b = state.fork()
+                _run_block(b, sub)
+                branches.append(b)
+            state.merge(branches)
+        elif isinstance(stmt, (ast.While,)):
+            state.run_stmt(stmt.test)
+            _run_block(state, stmt.body)
+            _run_block(state, stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            state.run_stmt(stmt.iter)
+            state.revive_stores(stmt.target)
+            _run_block(state, stmt.body)
+            _run_block(state, stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            _run_block(state, stmt.body)
+            for h in stmt.handlers:
+                _run_block(state, h.body)
+            _run_block(state, stmt.orelse)
+            _run_block(state, stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                state.run_stmt(item.context_expr)
+                if item.optional_vars is not None:
+                    state.revive_stores(item.optional_vars)
+            _run_block(state, stmt.body)
+        else:
+            state.run_stmt(stmt)
+
+
+@register(
+    "use-after-donate",
+    "a name passed at a donated position is read again unreassigned",
+    _DOC)
+def check(module) -> List[Finding]:
+    if not module.jit_bindings.donate:
+        return []
+    out: List[Finding] = []
+    for fdef in module.traces.functions.defs:
+        if isinstance(fdef, ast.Lambda):
+            continue
+        state = _FnState(module, fdef)
+        _run_block(state, fdef.body)
+        out.extend(state.findings)
+    # nested defs are walked by their own pass AND skipped by parents,
+    # so no dedupe needed beyond unique (line, col)
+    uniq, keys = [], set()
+    for f in out:
+        k = (f.line, f.col)
+        if k not in keys:
+            keys.add(k)
+            uniq.append(f)
+    return uniq

@@ -135,7 +135,8 @@ class ParallelTrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  mesh: ProcessMesh, config: Optional[ParallelConfig] = None,
-                 n_model_inputs: int = 1, scaler=None):
+                 n_model_inputs: int = 1, scaler=None,
+                 skip_nonfinite: bool = False):
         from paddle_tpu import amp as _amp
 
         self._model = model
@@ -147,6 +148,12 @@ class ParallelTrainStep:
         self._scaler = scaler if scaler is not None and scaler.is_enable() \
             else None
         self._scaler_state = _amp.scaler_init_state(scaler)
+        # in-graph NaN/Inf guard, same contract as
+        # jit.TrainStep(skip_nonfinite=True): a non-finite loss or grad
+        # makes the step an identity update (params/slots/buffers/step
+        # bit-identical; only the RNG chain advances), counted on device
+        # and surfaced via ``skipped_steps`` / profiler.counters()
+        self._skip_nonfinite = bool(skip_nonfinite)
         cfg = self._config
 
         shard_model_parameters(model, mesh, cfg)
@@ -199,9 +206,10 @@ class ParallelTrainStep:
         def step_fn(carry, param_datas, slot_list, buffer_datas, lr,
                     scaler_state, *batch):
             set_current_mesh(mesh)
-            # device-carried (step, rng chain) — committed-args fast path,
-            # no per-step host scalar transfer (see jit/train.py)
-            step, chain = carry
+            # device-carried (step, rng chain, nonfinite-skip count) —
+            # committed-args fast path, no per-step host scalar
+            # transfer (see jit/train.py)
+            step, chain, nskip = carry
             step = step + 1.0
             chain, key = jax.random.split(chain)
             scaling = scaler_state is not None
@@ -245,9 +253,19 @@ class ParallelTrainStep:
                 new_scaler_state = _amp.scaler_update_state(
                     self._scaler, scaler_state, found_inf)
 
+            nonfinite = None
+            if self._skip_nonfinite:
+                from paddle_tpu.jit.train import nonfinite_any
+
+                nonfinite = nonfinite_any(loss, grads)
+
             clip_fn = getattr(optimizer._grad_clip, "clip_fn", None)
             if clip_fn is not None:
                 grads = clip_fn(list(grads))
+
+            skip = found_inf
+            if nonfinite is not None:
+                skip = nonfinite if skip is None else (skip | nonfinite)
 
             new_params = list(param_datas)
             new_slots = list(slot_list)
@@ -265,14 +283,23 @@ class ParallelTrainStep:
                                              slot_list[i], lr, step)
                 optimizer._current_decay_enabled = True
                 optimizer._current_mask = None
-                if found_inf is not None:
-                    np_ = jnp.where(found_inf, param_datas[i], np_)
-                    ns = {k: jnp.where(found_inf, slot_list[i][k], v)
+                if skip is not None:
+                    np_ = jnp.where(skip, param_datas[i], np_)
+                    ns = {k: jnp.where(skip, slot_list[i][k], v)
                           for k, v in ns.items()}
                 new_params[i] = np_
                 new_slots[i] = ns
+            if nonfinite is not None:
+                # identity update: buffers and the step counter roll
+                # back too (the scaler state must NOT — the dynamic
+                # loss-scale schedule has to see its overflow)
+                nskip = nskip + jnp.where(nonfinite, 1.0, 0.0)
+                keep = ~nonfinite
+                new_buffers = [jnp.where(keep, nb, ob) for nb, ob in
+                               zip(new_buffers, buffer_datas)]
+                step = jnp.where(keep, step, step - 1.0)
             set_current_mesh(None)
-            return loss, (step, chain), new_params, new_slots, \
+            return loss, (step, chain, nskip), new_params, new_slots, \
                 new_buffers, new_scaler_state
 
         self._step_fn = step_fn
@@ -281,15 +308,27 @@ class ParallelTrainStep:
         # bias correction right (see jit/train.py _sync_step_carry)
         self._carry = (jnp.asarray(float(optimizer._step_count),
                                    jnp.float32),
-                       gen.default_generator.next_key())
+                       gen.default_generator.next_key(),
+                       jnp.zeros((), jnp.float32))  # nonfinite skips
         self._host_step_mirror = optimizer._step_count
+        if self._skip_nonfinite:
+            from paddle_tpu.jit.train import install_nonfinite_observability
+
+            install_nonfinite_observability(self, optimizer)
         self._lr_val = None
         self._lr_arr = None
         self._wd_warm = None  # last batch shapes (compile detection)
 
+    @property
+    def skipped_steps(self) -> int:
+        """Steps the ``skip_nonfinite`` guard turned into identity
+        updates. Carried on device (no per-step sync); reading blocks
+        on the last dispatched step."""
+        return int(np.asarray(self._carry[2]))
+
     def _build_jit(self, batch_datas):
         scaler_sh = self._repl if self._scaler_state is not None else None
-        carry_sh = (self._repl, self._repl)
+        carry_sh = (self._repl, self._repl, self._repl)
         in_shardings = (
             carry_sh,
             self._param_sh,
@@ -330,7 +369,8 @@ class ParallelTrainStep:
         if self._opt._step_count != self._host_step_mirror:
             # optimizer counter changed externally (checkpoint resume)
             self._carry = (jnp.asarray(float(self._opt._step_count),
-                                       jnp.float32), self._carry[1])
+                                       jnp.float32), self._carry[1],
+                           self._carry[2])
         self._opt._step_count += 1  # host mirror (schedulers, state_dict)
         self._host_step_mirror = self._opt._step_count
         lr_val = float(self._opt.get_lr())

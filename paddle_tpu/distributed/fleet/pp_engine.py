@@ -62,7 +62,8 @@ class PipelineTrainStep:
                  pp_axis: str = "pp", dp_axis: str = "dp",
                  remat_body: bool = True, scaler=None,
                  shard_pre_post: bool = True, schedule: str = "1f1b",
-                 interleave_degree: int = 2):
+                 interleave_degree: int = 2,
+                 skip_nonfinite: bool = False):
         """``schedule`` selects the microbatch schedule (reference ships
         FThenB/1F1B/VPP/zero-bubble as pipeline_scheduler passes,
         distributed/passes/pipeline_scheduler_pass/):
@@ -126,6 +127,12 @@ class PipelineTrainStep:
         self._scaler = scaler if scaler is not None and scaler.is_enable() \
             else None
         self._scaler_state = _amp.scaler_init_state(self._scaler)
+        # in-graph NaN/Inf guard, same contract as
+        # jit.TrainStep(skip_nonfinite=True): a non-finite loss or any
+        # non-finite accumulated grad (pre/body/post) turns the step
+        # into the identity update, counted on device and surfaced via
+        # ``skipped_steps`` / profiler.counters()
+        self._skip_nonfinite = bool(skip_nonfinite)
 
         # ---- functionalize the three sections --------------------------
         self._pre_apply, (_, self._pre_params), (_, self._pre_buffers) = \
@@ -233,11 +240,23 @@ class PipelineTrainStep:
         # bias correction right (see jit/train.py _sync_step_carry)
         self._carry = (jnp.asarray(float(optimizer._step_count),
                                    jnp.float32),
-                       gen.default_generator.next_key())
+                       gen.default_generator.next_key(),
+                       jnp.zeros((), jnp.float32))  # nonfinite skips
         self._host_step_mirror = optimizer._step_count
+        if self._skip_nonfinite:
+            from paddle_tpu.jit.train import install_nonfinite_observability
+
+            install_nonfinite_observability(self, optimizer)
         self._lr_val = None
         self._lr_arr = None
         self._wd_warm = None  # last batch shapes (compile detection)
+
+    @property
+    def skipped_steps(self) -> int:
+        """Steps the ``skip_nonfinite`` guard turned into identity
+        updates. Carried on device (no per-step sync); reading blocks
+        on the last dispatched step."""
+        return int(np.asarray(self._carry[2]))
 
     # ------------------------------------------------------------------
     def _rotated_forward(self, body_pd, h_mbs, key, remat):
@@ -313,9 +332,10 @@ class PipelineTrainStep:
         def step_fn(carry, pre_p, body_p, post_p, pre_s, body_s, post_s,
                     pre_b, post_b, lr, scaler_state, x, y):
             set_current_mesh(mesh)
-            # device-carried (step, rng chain): committed-args fast path,
-            # no per-step host scalar transfer (see jit/train.py)
-            step, chain = carry
+            # device-carried (step, rng chain, nonfinite-skip count):
+            # committed-args fast path, no per-step host scalar
+            # transfer (see jit/train.py)
+            step, chain, nskip = carry
             step = step + 1.0
             chain, key = jax.random.split(chain)
             from paddle_tpu import amp as _amp
@@ -394,6 +414,13 @@ class PipelineTrainStep:
                 g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
                 g_post = flat[len(g_pre) + len(g_body):]
 
+            nonfinite = None
+            if self._skip_nonfinite:
+                from paddle_tpu.jit.train import nonfinite_any
+
+                nonfinite = nonfinite_any(
+                    loss, list(g_pre) + list(g_body) + list(g_post))
+
             clip_fn = getattr(opt._grad_clip, "clip_fn", None)
             if clip_fn is not None:
                 flat = list(g_pre) + list(g_body) + list(g_post)
@@ -401,6 +428,11 @@ class PipelineTrainStep:
                 g_pre = flat[:len(g_pre)]
                 g_body = flat[len(g_pre):len(g_pre) + len(g_body)]
                 g_post = flat[len(g_pre) + len(g_body):]
+
+            skip_mask = found_inf
+            if nonfinite is not None:
+                skip_mask = nonfinite if skip_mask is None \
+                    else (skip_mask | nonfinite)
 
             def upd(ps, gs, ss, param_refs, skip=()):
                 nps, nss = [], []
@@ -418,9 +450,9 @@ class PipelineTrainStep:
                     np_, ns = opt._rule_mp(p, g, s, lr, step)
                     opt._current_decay_enabled = True
                     opt._current_mask = None
-                    if found_inf is not None:
-                        np_ = jnp.where(found_inf, p, np_)
-                        ns = {k: jnp.where(found_inf, s[k], v)
+                    if skip_mask is not None:
+                        np_ = jnp.where(skip_mask, p, np_)
+                        ns = {k: jnp.where(skip_mask, s[k], v)
                               for k, v in ns.items()}
                     nps.append(np_)
                     nss.append(ns)
@@ -434,8 +466,19 @@ class PipelineTrainStep:
                                  skip=set(shared_post))
             for j, i in shared_post.items():
                 npost[j] = npre[i]
+            if nonfinite is not None:
+                # identity update: buffers and the step counter roll
+                # back too (the scaler state must NOT — the dynamic
+                # loss-scale schedule has to see its overflow)
+                nskip = nskip + jnp.where(nonfinite, 1.0, 0.0)
+                keep = ~nonfinite
+                new_pre_b = [jnp.where(keep, nb, ob) for nb, ob in
+                             zip(new_pre_b, pre_b)]
+                new_post_b = [jnp.where(keep, nb, ob) for nb, ob in
+                              zip(new_post_b, post_b)]
+                step = jnp.where(keep, step, step - 1.0)
             set_current_mesh(None)
-            return (loss, (step, chain), npre, nbody, npost,
+            return (loss, (step, chain, nskip), npre, nbody, npost,
                     npre_s, nbody_s, npost_s,
                     new_pre_b, new_post_b, new_scaler_state)
 
@@ -469,7 +512,7 @@ class PipelineTrainStep:
             scaler_sh = None if self._scaler_state is None else self._repl
             self._jitted = jax.jit(
                 step_fn,
-                in_shardings=((self._repl, self._repl),
+                in_shardings=((self._repl, self._repl, self._repl),
                               self._pre_sh, self._body_sh, self._post_sh,
                               slot_sh(self._pre_sh, self._pre_slots),
                               slot_sh(self._body_sh, self._body_slots),
@@ -479,7 +522,8 @@ class PipelineTrainStep:
                               self._repl,
                               scaler_sh,
                               bsh(xd.ndim), bsh(yd.ndim)),
-                out_shardings=(self._repl, (self._repl, self._repl),
+                out_shardings=(self._repl,
+                               (self._repl, self._repl, self._repl),
                                self._pre_sh, self._body_sh,
                                self._post_sh,
                                slot_sh(self._pre_sh, self._pre_slots),
@@ -492,7 +536,8 @@ class PipelineTrainStep:
         if self._opt._step_count != self._host_step_mirror:
             # optimizer counter changed externally (checkpoint resume)
             self._carry = (jnp.asarray(float(self._opt._step_count),
-                                       jnp.float32), self._carry[1])
+                                       jnp.float32), self._carry[1],
+                           self._carry[2])
         self._opt._step_count += 1  # host mirror (schedulers, state_dict)
         self._host_step_mirror = self._opt._step_count
         lr_val = float(self._opt.get_lr())

@@ -36,6 +36,49 @@ from paddle_tpu.nn.clip import ClipGradByGlobalNorm
 __all__ = ["TrainStep"]
 
 
+def nonfinite_any(loss, grads):
+    """In-graph reduction the ``skip_nonfinite`` guard gates on: True
+    when the loss or ANY gradient holds a NaN/Inf. Shared by TrainStep,
+    ParallelTrainStep and PipelineTrainStep so the guard semantics
+    (checked after unscaling — scaled-inf vs true inf — and BEFORE
+    clipping, where a global-norm clip of a NaN grad would smear it
+    into NaN-everywhere) live in one place."""
+    nf = jnp.any(~jnp.isfinite(loss))
+    for g in grads:
+        nf = nf | jnp.any(~jnp.isfinite(g))
+    return nf
+
+
+def install_nonfinite_observability(step, optimizer) -> str:
+    """Wire a ``skip_nonfinite`` train step into the observability and
+    checkpoint machinery (shared by TrainStep, ParallelTrainStep and
+    PipelineTrainStep — one place to fix, three engines):
+
+    * a ``train_step/nonfinite_skipped#<id>`` counter provider over the
+      step's ``skipped_steps`` (weakref'd: counters() drops it when the
+      step dies, and a finalizer unregisters it even if counters() is
+      never read — no per-instance leak);
+    * ``optimizer._applied_step_provider`` returning the device-APPLIED
+      step from the carry (a skipped step rolls the device counter
+      back, and a checkpoint restore must not jump bias-corrected
+      rules ahead by the skips).
+
+    Returns the counter name."""
+    import weakref
+
+    from paddle_tpu import profiler as _prof
+
+    ref = weakref.ref(step)
+    cname = f"train_step/nonfinite_skipped#{id(step)}"
+    _prof.register_counter_provider(
+        cname, lambda: (None if ref() is None else ref().skipped_steps))
+    weakref.finalize(step, _prof.unregister_counter_provider, cname)
+    optimizer._applied_step_provider = (
+        lambda: (None if ref() is None
+                 else int(np.asarray(ref()._carry[0]))))
+    return cname
+
+
 class TrainStep:
     """``donate=True`` (default) hands params/optimizer slots/buffers to
     XLA as donated inputs: the compiled step updates state in place in
@@ -140,13 +183,7 @@ class TrainStep:
 
                 nonfinite = None
                 if self._skip_nonfinite:
-                    # checked after unscaling (scaled-inf vs true inf)
-                    # and before clipping (global-norm clip of a NaN
-                    # grad would mask it as NaN-everywhere)
-                    nf = jnp.any(~jnp.isfinite(loss))
-                    for g in grads:
-                        nf = nf | jnp.any(~jnp.isfinite(g))
-                    nonfinite = nf
+                    nonfinite = nonfinite_any(loss, grads)
 
                 clip = optimizer._grad_clip
                 clip_fn = getattr(clip, "clip_fn", None)
@@ -242,26 +279,7 @@ class TrainStep:
                        jnp.zeros((), jnp.float32))  # nonfinite skips
         self._host_step_mirror = optimizer._step_count
         if self._skip_nonfinite:
-            import weakref
-
-            from paddle_tpu import profiler as _prof
-
-            ref = weakref.ref(self)
-            cname = f"train_step/nonfinite_skipped#{id(self)}"
-            _prof.register_counter_provider(
-                cname,
-                lambda: (None if ref() is None else ref().skipped_steps))
-            # counters() drops dead providers lazily, but an app that
-            # never reads counters must not leak one entry per TrainStep
-            weakref.finalize(self, _prof.unregister_counter_provider,
-                             cname)
-            # the host _step_count advances once per DISPATCH (schedulers
-            # need it eagerly), but a skipped step rolls the device step
-            # back — persist the applied count, or a checkpoint restore
-            # would jump bias-corrected rules ahead by the skips
-            optimizer._applied_step_provider = (
-                lambda: (None if ref() is None
-                         else int(np.asarray(ref()._carry[0]))))
+            install_nonfinite_observability(self, optimizer)
         self._lr_val = None
         self._lr_arr = None
         self._wd_warm: dict = {}  # id(jitted) -> last batch shapes

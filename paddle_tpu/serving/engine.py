@@ -144,7 +144,14 @@ class LLMEngine:
                      enc, dec, now):
             (logits, k2, v2), _ = apply(param_datas, buffer_datas, key,
                                         ids, kcs, vcs, bt, enc, dec, now)
-            return logits, k2, v2
+            # in-graph greedy sampling (the ROADMAP PR-4 follow-up):
+            # argmax runs on device so an all-greedy step ships B int32s
+            # to host instead of B×vocab logits. jnp.argmax and
+            # np.argmax share first-occurrence tie-breaking, so the two
+            # paths stay token-identical (pinned by
+            # tests/test_serving_engine.py).
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return logits, greedy, k2, v2
 
         donate = self.cfg.donate_cache
         if donate is None:
@@ -155,6 +162,10 @@ class LLMEngine:
 
         self._requests: Dict[str, Request] = {}
         self._auto_id = itertools.count()
+        # steps that pulled the full B×vocab logits to host (sampled
+        # decode only; greedy steps ship B in-graph-argmax'd ints) —
+        # the observable tests/test_serving_engine.py pins
+        self.num_logits_fetches = 0
         self.metrics = ServingMetrics(self)
 
     # -- request lifecycle ----------------------------------------------
@@ -273,18 +284,30 @@ class LLMEngine:
             table = self.block_manager.block_table(r.request_id)
             bt[i, :len(table)] = table
 
-        logits, self._kcs, self._vcs = self._jstep(
+        logits, greedy, self._kcs, self._vcs = self._jstep(
             [p._data for p in self._params],
             [b._data for b in self._buffers],
             self._key, ids, self._kcs, self._vcs, bt, enc, dec, now)
-        logits_np = np.asarray(logits)[:len(reqs)]
+        if all(r.sampling.temperature <= 0.0 for r in reqs):
+            # all-greedy step: the token ids were computed in-graph —
+            # fetch B int32s, never the B×vocab logits
+            logits_np = None
+            tokens_np = np.asarray(greedy)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized int fetch IS the engine's host boundary)
+        else:
+            # sampled decode still samples host-side per request;
+            # in-graph top-k/top-p is the remaining ROADMAP "in-graph
+            # sampling" follow-up
+            self.num_logits_fetches += 1
+            tokens_np = None
+            logits_np = np.asarray(logits)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B×vocab fetch only on the sampled-decode path; ROADMAP serving follow-up: in-graph sampling)
 
         self.metrics.record_step(batch.kind, len(reqs), int(sum(n_run)),
                                  self.cfg.max_num_seqs)
         outputs: List[RequestOutput] = []
         for i, r in enumerate(reqs):
             r.num_cached += n_run[i]
-            token = self._sample(r, logits_np[i])
+            token = int(tokens_np[i]) if logits_np is None \
+                else self._sample(r, logits_np[i])
             finished = r.append_token(token)
             self.metrics.record_token()
             if finished:

@@ -1,0 +1,773 @@
+"""tracecheck rule tests: every launch rule catches its seeded
+violations (zero false negatives on the fixtures) and stays quiet on
+the near-miss set (no false positives). Plus suppressions, the
+baseline machinery, and the CLI contract (exit codes, --format=json)."""
+import json
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (
+    analyze_paths, analyze_source, get_rules, load_baseline,
+    write_baseline,
+)
+from paddle_tpu.analysis.cli import main as cli_main
+
+
+def run(src):
+    return analyze_source(textwrap.dedent(src), path="fixture.py")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def test_rule_catalog_has_all_launch_rules():
+    names = set(get_rules())
+    assert {"host-sync-in-traced", "use-after-donate",
+            "trace-time-impurity", "tensor-bool-branch",
+            "counter-provider-leak"} <= names
+    for r in get_rules().values():
+        assert r.summary and r.doc  # per-rule docs are part of the API
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-traced
+# ---------------------------------------------------------------------------
+class TestHostSync:
+    def test_numpy_item_float_inside_jit(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                a = np.asarray(x)
+                b = x.numpy()
+                c = float(x)
+                d = x.item()
+                return a, b, c, d
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"] * 4
+
+    def test_reachable_through_one_helper_call(self):
+        fs = run("""
+            import jax
+
+            def helper(t):
+                return t.item()
+
+            def entry(x):
+                return helper(x)
+
+            g = jax.jit(entry)
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+        # the finding lands in helper's body, attributed to the traced
+        # caller the call graph followed
+        assert fs[0].line == 5
+        assert "entry" in fs[0].message
+
+    def test_partial_jit_decorator_is_traced(self):
+        # @partial(jax.jit, static_argnums=...) is THE jit-with-options
+        # idiom and must get the same analysis
+        fs = run("""
+            from functools import partial
+
+            import jax
+            import numpy as np
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, n):
+                return np.asarray(x)
+
+            g = jax.jit(partial(f, n=2))  # partial as wrapper arg too
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+
+    def test_annotated_dispatch_result_fetch_flagged(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            def go(f, x):
+                step = jax.jit(f)
+                out: jax.Array = step(x)
+                return np.asarray(out)
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+
+    def test_factory_returned_step_fn_is_traced(self):
+        fs = run("""
+            import jax
+
+            def make_step(flag):
+                def step_fn(x):
+                    return float(x)
+                return step_fn
+
+            jitted = jax.jit(make_step(True), static_argnums=())
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+
+    def test_dispatch_result_fetch_flagged(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+
+                def step(self, ids):
+                    logits, cache = self._jstep(ids)
+                    return np.asarray(logits)
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+        assert "compiled dispatch" in fs[0].message
+
+    def test_near_miss_host_side_numpy_clean(self):
+        fs = run("""
+            import numpy as np
+
+            def host_fn(t):
+                return np.asarray(t)  # no traced scope anywhere
+
+            def loader(batch):
+                return [float(x) for x in batch]
+        """)
+        assert fs == []
+
+    def test_near_miss_float_of_literal_clean(self):
+        fs = run("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * float(2)  # constant, not a tensor sync
+        """)
+        assert fs == []
+
+    def test_near_miss_trace_time_constants_clean(self):
+        # literal lookup tables and static shape reads are host-safe
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                table = np.asarray([0.0, 1.0, 2.0])
+                n = int(x.shape[0])
+                d = x.ndim
+                return x * table[0] * n * d
+        """)
+        assert fs == []
+
+    def test_dispatch_result_method_fetch_flagged(self):
+        # .item()/.numpy() ARE the headline spellings — method calls
+        # have no positional args, so the receiver is the fetched value
+        fs = run("""
+            import jax
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+
+                def step(self, ids):
+                    out = self._jstep(ids)
+                    return out.item(), out.numpy()
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"] * 2
+
+    def test_near_miss_nested_def_binds_stay_scoped(self):
+        # a closure's dispatch result must not taint the enclosing
+        # function's same-named host variable
+        fs = run("""
+            import jax
+            import numpy as np
+
+            def outer(step_fn, data):
+                out = list(data)
+                step = jax.jit(step_fn)
+
+                def inner(x):
+                    out = step(x)
+                    return out
+
+                return np.asarray(out), inner
+        """)
+        assert fs == []
+
+    def test_near_miss_dispatch_result_rebound_clean(self):
+        # a reassigned name no longer aliases the dispatch output
+        fs = run("""
+            import jax
+            import numpy as np
+
+            def go(f, x):
+                step = jax.jit(f)
+                out = step(x)
+                out = [1, 2, 3]
+                return np.asarray(out)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+class TestUseAfterDonate:
+    def test_read_after_donation_flagged(self):
+        fs = run("""
+            import jax
+
+            def go(f, x, y):
+                step = jax.jit(f, donate_argnums=(0,))
+                out = step(x, y)
+                return x.sum()
+        """)
+        assert rules_of(fs) == ["use-after-donate"]
+        assert "'x'" in fs[0].message
+
+    def test_self_attr_binding_cross_method(self):
+        fs = run("""
+            import jax
+
+            class Eng:
+                def __init__(self, f, cache):
+                    self._step = jax.jit(f, donate_argnums=(1,))
+                    self._cache = cache
+
+                def run(self, a):
+                    out = self._step(a, self._cache)
+                    return self._cache
+        """)
+        assert rules_of(fs) == ["use-after-donate"]
+        assert "self._cache" in fs[0].message
+
+    def test_conditional_donate_argnums_union(self):
+        fs = run("""
+            import jax
+
+            def go(f, x, donate):
+                step = jax.jit(f, donate_argnums=(0,) if donate else ())
+                out = step(x)
+                return x + 1
+        """)
+        assert rules_of(fs) == ["use-after-donate"]
+
+    def test_near_miss_reassigned_before_reuse_clean(self):
+        fs = run("""
+            import jax
+
+            def go(f, x):
+                step = jax.jit(f, donate_argnums=(0,))
+                x = step(x)
+                return x + 1
+        """)
+        assert fs == []
+
+    def test_near_miss_same_statement_rebind_clean(self):
+        # the engine.py idiom: donated caches rebound by the same stmt
+        fs = run("""
+            import jax
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f, donate_argnums=(0, 1))
+
+                def step(self):
+                    self._k, self._v = self._jstep(self._k, self._v)
+                    return self._k
+        """)
+        assert fs == []
+
+    def test_near_miss_else_branch_not_poisoned(self):
+        # if/else are mutually exclusive: a donation in the `if` arm
+        # must not kill the name for the `else` arm
+        fs = run("""
+            import jax
+
+            def go(f, x, fast):
+                step = jax.jit(f, donate_argnums=(0,))
+                if fast:
+                    y = step(x)
+                else:
+                    y = x + 1
+                    z = x * 2
+                return y
+        """)
+        assert fs == []
+
+    def test_use_after_either_branch_donation_flagged(self):
+        fs = run("""
+            import jax
+
+            def go(f, x, fast):
+                step = jax.jit(f, donate_argnums=(0,))
+                if fast:
+                    y = step(x)
+                else:
+                    y = x + 1
+                return x.sum()
+        """)
+        assert rules_of(fs) == ["use-after-donate"]
+
+    def test_dead_name_passed_to_another_dispatch_flagged(self):
+        # jax raises 'Array has been deleted' when a dead buffer feeds
+        # ANY later dispatch, not just host code
+        fs = run("""
+            import jax
+
+            def go(f, g, x):
+                step = jax.jit(f, donate_argnums=(0,))
+                other = jax.jit(g)
+                y = step(x)
+                return other(x)
+        """)
+        assert rules_of(fs) == ["use-after-donate"]
+
+    def test_near_miss_undonated_jit_clean(self):
+        fs = run("""
+            import jax
+
+            def go(f, x):
+                step = jax.jit(f)
+                out = step(x)
+                return x + 1
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# trace-time-impurity
+# ---------------------------------------------------------------------------
+class TestTraceImpurity:
+    def test_time_random_environ_in_traced(self):
+        fs = run("""
+            import jax
+            import os
+            import time
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                t = time.time()
+                r = np.random.randn(3)
+                e = os.environ["SEED"]
+                g = os.environ.get("SEED2")
+                return x * t
+        """)
+        assert rules_of(fs) == ["trace-time-impurity"] * 4
+
+    def test_closure_mutation_in_traced(self):
+        fs = run("""
+            import jax
+
+            losses = []
+            cache = {}
+
+            @jax.jit
+            def f(x):
+                losses.append(x)
+                cache["last"] = x
+                return x
+        """)
+        assert rules_of(fs) == ["trace-time-impurity"] * 2
+
+    def test_scan_body_is_traced(self):
+        fs = run("""
+            import time
+
+            import jax
+
+            def body(carry, x):
+                return carry + time.time(), None
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """)
+        assert rules_of(fs) == ["trace-time-impurity"]
+
+    def test_near_miss_host_side_impurity_clean(self):
+        fs = run("""
+            import time
+            import numpy as np
+
+            def profile_step(fn):
+                t0 = time.time()
+                events = []
+                events.append(fn())
+                return time.time() - t0, np.random.rand()
+        """)
+        assert fs == []
+
+    def test_nested_helper_local_does_not_mask_closure_mutation(self):
+        # `hits` is bound only inside the nested helper: the OUTER
+        # body's append is still a closure mutation
+        fs = run("""
+            import jax
+
+            hits = []
+
+            @jax.jit
+            def step(x):
+                def helper(y):
+                    hits = [y]
+                    return hits
+                hits.append(x)
+                return helper(x)
+        """)
+        assert rules_of(fs) == ["trace-time-impurity"]
+        assert "hits.append" in fs[0].snippet
+
+    def test_near_miss_local_list_in_traced_clean(self):
+        fs = run("""
+            import jax
+
+            @jax.jit
+            def f(xs):
+                acc = []
+                for x in xs:
+                    acc.append(x * 2)  # local: trace-time unrolling, fine
+                return acc
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# tensor-bool-branch
+# ---------------------------------------------------------------------------
+class TestTensorBool:
+    def test_if_and_while_on_tensor(self):
+        fs = run("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    y = y * 2
+                while y < 10:
+                    y = y + 1
+                return y
+        """)
+        assert rules_of(fs) == ["tensor-bool-branch"] * 2
+
+    def test_taint_through_arithmetic_and_methods(self):
+        fs = run("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                nf = jnp.any(jnp.isnan(x))
+                flag = nf | jnp.any(jnp.isinf(x))
+                if flag:
+                    return x * 0
+                return x
+        """)
+        assert rules_of(fs) == ["tensor-bool-branch"]
+
+    def test_near_miss_host_flag_clean(self):
+        fs = run("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, training):
+                if training:          # host param: static under jit
+                    x = x * 2
+                y = jnp.sum(x)
+                if y is None:         # identity test is host-safe
+                    return x
+                if x.ndim > 1:        # static attr, not a tracer
+                    return y
+                return y
+        """)
+        assert fs == []
+
+    def test_for_loop_target_inherits_taint(self):
+        fs = run("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(xs):
+                grads = jnp.split(xs, 2)
+                for g in grads:
+                    if g.sum() > 0:
+                        return g
+                return xs
+        """)
+        assert rules_of(fs) == ["tensor-bool-branch"]
+
+    def test_near_miss_untraced_function_clean(self):
+        fs = run("""
+            import jax.numpy as jnp
+
+            def host_filter(x):
+                y = jnp.sum(x)
+                if y > 0:   # eager host code: legal (blocking) sync
+                    return y
+                return -y
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# counter-provider-leak
+# ---------------------------------------------------------------------------
+class TestCounterLeak:
+    def test_register_without_unregister_flagged(self):
+        fs = run("""
+            from paddle_tpu import profiler
+
+            class Metrics:
+                def __init__(self):
+                    profiler.register_counter_provider("m/x", lambda: 1)
+        """)
+        assert rules_of(fs) == ["counter-provider-leak"]
+
+    def test_near_miss_weakref_finalize_clean(self):
+        fs = run("""
+            import weakref
+
+            from paddle_tpu import profiler
+
+            class Metrics:
+                def __init__(self, owner):
+                    profiler.register_counter_provider("m/x", lambda: 1)
+                    weakref.finalize(
+                        owner, profiler.unregister_counter_provider,
+                        "m/x")
+        """)
+        assert fs == []
+
+    def test_near_miss_direct_unregister_clean(self):
+        fs = run("""
+            from paddle_tpu.profiler import (
+                register_counter_provider, unregister_counter_provider,
+            )
+
+            def attach(name):
+                register_counter_provider(name, lambda: 0)
+
+            def detach(name):
+                unregister_counter_provider(name)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+class TestSuppressions:
+    def test_inline_with_reason_silences(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)  # tpulint: disable=host-sync-in-traced (fixture: testing the suppression path)
+        """)
+        assert fs == []
+
+    def test_standalone_comment_covers_next_line(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                # tpulint: disable=host-sync-in-traced (fixture reason)
+                return np.asarray(x)
+        """)
+        assert fs == []
+
+    def test_suppression_on_last_line_of_wrapped_statement(self):
+        # auto-formatters wrap long lines: a trailing comment lands on
+        # the statement's LAST physical line, which must still cover
+        # the finding anchored at its first
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(out):
+                host = np.asarray(
+                    out)  # tpulint: disable=host-sync-in-traced (fixture: wrapped stmt)
+                return host
+        """)
+        assert fs == []
+
+    def test_missing_reason_is_bad_suppression(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)  # tpulint: disable=host-sync-in-traced
+        """)
+        assert rules_of(fs) == ["bad-suppression"]
+        assert "reason" in fs[0].message
+
+    def test_unknown_rule_is_bad_suppression(self):
+        fs = run("""
+            x = 1  # tpulint: disable=no-such-rule (whatever)
+        """)
+        assert rules_of(fs) == ["bad-suppression"]
+        assert "no-such-rule" in fs[0].message
+
+    def test_reason_may_contain_parentheses(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)  # tpulint: disable=host-sync-in-traced (see PR (2) notes)
+        """)
+        assert fs == []
+
+    def test_docstring_mention_is_not_a_live_suppression(self):
+        # documentation of the syntax inside a string literal must not
+        # register (nor report bad-suppression for a reasonless example)
+        fs = run('''
+            def helper():
+                """Docs: silence with  # tpulint: disable=host-sync-in-traced
+                on the offending line."""
+                return 1
+        ''')
+        assert fs == []
+
+    def test_stacked_standalone_suppressions_all_apply(self):
+        body = """
+            import jax
+            import numpy as np
+
+            def go(f, x):
+                step = jax.jit(f, donate_argnums=(0,))
+                y = step(x)
+                {s1}
+                {s2}
+                return np.asarray(y) + x.sum()
+        """
+        # unsuppressed: one finding per rule on the return line
+        fs = run(body.format(s1="pass", s2="pass"))
+        assert sorted(rules_of(fs)) == ["host-sync-in-traced",
+                                        "use-after-donate"]
+        # two stacked standalone disables both apply to the statement
+        fs = run(body.format(
+            s1="# tpulint: disable=use-after-donate (fixture: stack 1)",
+            s2="# tpulint: disable=host-sync-in-traced (fixture: stack "
+               "2)"))
+        assert fs == []
+
+    def test_wrong_rule_does_not_silence(self):
+        fs = run("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)  # tpulint: disable=use-after-donate (wrong rule on purpose)
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+
+
+# ---------------------------------------------------------------------------
+# baseline + CLI
+# ---------------------------------------------------------------------------
+VIOLATING = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.asarray(x)
+"""
+
+
+class TestBaselineAndCli:
+    def _write(self, tmp_path, name="bad.py", body=VIOLATING):
+        p = tmp_path / name
+        p.write_text(body)
+        return str(p)
+
+    def test_exit_codes_and_text_output(self, tmp_path, capsys):
+        path = self._write(tmp_path)
+        assert cli_main([path]) == 1
+        out = capsys.readouterr().out
+        assert "host-sync-in-traced" in out
+        clean = self._write(tmp_path, "clean.py", "x = 1\n")
+        assert cli_main([clean]) == 0
+        assert cli_main([]) == 2
+        assert cli_main([str(tmp_path / "missing.py")]) == 2
+        assert cli_main([path, "--disable", "typo-rule"]) == 2
+
+    def test_json_format(self, tmp_path, capsys):
+        path = self._write(tmp_path)
+        assert cli_main([path, "--format=json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["count"] == 1
+        f = data["findings"][0]
+        assert f["rule"] == "host-sync-in-traced"
+        assert f["path"] == path
+        assert f["line"] == 7
+
+    def test_baseline_roundtrip(self, tmp_path, capsys):
+        path = self._write(tmp_path)
+        base = str(tmp_path / "baseline.json")
+        assert cli_main([path, "--baseline", base,
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        # existing violation is baselined -> clean exit
+        assert cli_main([path, "--baseline", base]) == 0
+        out = capsys.readouterr().out
+        assert "suppressed by baseline" in out
+        # a NEW violation still fails even with the baseline
+        with open(path, "a") as fh:
+            fh.write("\n\n@jax.jit\ndef g(x):\n    return x.item()\n")
+        assert cli_main([path, "--baseline", base]) == 1
+
+    def test_baseline_survives_line_shifts(self, tmp_path):
+        path = self._write(tmp_path)
+        base = str(tmp_path / "baseline.json")
+        findings = analyze_paths([path])
+        write_baseline(base, findings)
+        # prepend unrelated lines: fingerprints hash line TEXT, not
+        # numbers
+        body = open(path).read()
+        with open(path, "w") as fh:
+            fh.write("# a new header comment\nimport os  # noqa\n" + body)
+        assert cli_main([path, "--baseline", base]) == 0
+        assert len(load_baseline(base)) == 1
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for name in get_rules():
+            assert name in out
+
+    def test_disable_rule(self, tmp_path):
+        path = self._write(tmp_path)
+        assert cli_main([path, "--disable",
+                         "host-sync-in-traced"]) == 0
+
+    def test_parse_error_reported_not_raised(self, tmp_path):
+        path = self._write(tmp_path, "broken.py", "def f(:\n")
+        fs = analyze_paths([path])
+        assert rules_of(fs) == ["parse-error"]
+
+    def test_non_utf8_file_reported_not_raised(self, tmp_path):
+        bad = tmp_path / "latin1.py"
+        bad.write_bytes("x = '\xe9'\n".encode("latin-1"))
+        good = self._write(tmp_path, "ok.py", "x = 1\n")
+        fs = analyze_paths([str(bad), good])
+        assert rules_of(fs) == ["parse-error"]
+        assert "cannot read" in fs[0].message
+        assert cli_main([str(tmp_path)]) == 1  # reported, not crashed

@@ -1,0 +1,71 @@
+"""Tier-1 self-lint gate: the repo stays tracecheck-clean.
+
+``python -m paddle_tpu.analysis paddle_tpu tests/mp_scripts`` must exit
+0 — every true positive fixed, every accepted violation suppressed
+inline WITH a reason (a reasonless suppression is itself a
+``bad-suppression`` finding, so the policy is self-enforcing)."""
+import os
+import re
+
+from paddle_tpu.analysis import analyze_paths, iter_python_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINTED = [os.path.join(REPO, "paddle_tpu"),
+          os.path.join(REPO, "tests", "mp_scripts")]
+
+
+def test_repo_is_lint_clean():
+    findings = analyze_paths(LINTED)
+    assert findings == [], "tracecheck found new violations:\n" + \
+        "\n".join(f.render() for f in findings)
+
+
+def test_lint_covers_a_real_file_set():
+    """The gate must actually be looking at the tree (guard against a
+    silently-empty walk making the clean assertion vacuous)."""
+    files = iter_python_files(LINTED)
+    assert len(files) > 150
+    assert any(f.endswith("serving/engine.py") for f in files)
+
+
+def _audited_files():
+    """Everything linted except the analyzer package itself, whose
+    docstrings/messages legitimately spell out the suppression syntax."""
+    marker = os.path.join("paddle_tpu", "analysis") + os.sep
+    return [f for f in iter_python_files(LINTED) if marker not in f]
+
+
+def test_every_suppression_in_tree_names_its_rule_and_reason():
+    """Grep-level audit, independent of the analyzer's own parsing:
+    each `tpulint: disable=` carries (reason) text."""
+    pat = re.compile(r"tpulint:\s*disable=([\w\-,\s]+?)\s*\(([^)]+)\)")
+    bare = re.compile(r"tpulint:\s*disable=")
+    for path in _audited_files():
+        with open(path, encoding="utf-8") as fh:
+            for i, line in enumerate(fh, 1):
+                if bare.search(line):
+                    assert pat.search(line), \
+                        f"{path}:{i}: suppression without a reason"
+
+
+def test_suppression_inventory_is_intentional():
+    """Every suppression in the linted tree is one we wrote on purpose;
+    new ones should be added consciously (update this list with a
+    justification, mirroring the inline reason)."""
+    expected = {
+        # serving/engine.py: the two host boundaries of the serving
+        # step — B ints for greedy (in-graph argmax), B×vocab only for
+        # sampled decode (ROADMAP follow-up: full in-graph sampling)
+        "paddle_tpu/serving/engine.py": 2,
+    }
+    found = {}
+    bare = re.compile(r"tpulint:\s*disable=")
+    for path in _audited_files():
+        rel = os.path.relpath(path, REPO)
+        with open(path, encoding="utf-8") as fh:
+            n = sum(1 for line in fh if bare.search(line))
+        if n:
+            found[rel] = n
+    assert found == expected, (
+        f"suppression inventory changed: {found} != {expected} — if "
+        f"intentional, update test_lint_clean.py with the reason")
