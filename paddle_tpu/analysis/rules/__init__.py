@@ -1,6 +1,7 @@
 """tracecheck launch rules. Importing this package registers them all
 (the registry imports it lazily from ``get_rules``)."""
 from paddle_tpu.analysis.rules import (  # noqa: F401
+    block_sync,
     counter_leak,
     host_sync,
     tensor_bool,

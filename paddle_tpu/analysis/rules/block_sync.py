@@ -1,0 +1,94 @@
+"""block-until-ready-in-loop: a per-iteration device sync in library
+hot loops.
+
+``jax.block_until_ready`` (or the array method of the same name) parks
+the host until the device drains. Called once, after a loop, it is the
+correct way to time or hand off a result; called INSIDE a loop it
+re-serializes host and device every iteration — each dispatch must
+fully retire before the next is even issued, so the async dispatch
+queue (the entire reason the PR-2 input pipeline overlaps at all)
+degenerates to lock-step execution. The ROADMAP named this bug class
+after the PR-2 copy_frac hunt: the symptom is a "fast" loop whose
+device idles between tiny bursts.
+
+Flagged: any ``block_until_ready`` call (function or method spelling)
+lexically inside a ``for``/``while``/comprehension, up to the enclosing
+function boundary (a ``def`` inside a loop is a definition, not a
+per-iteration execution). Legitimate per-iteration blocking — a
+watchdog prober whose JOB is to park on each step, a trace-window
+drain — gets an inline suppression with a written reason, per the
+standing policy.
+
+Fix pattern::
+
+    for batch in data:
+        out = step(out, batch)
+        jax.block_until_ready(out)     # BAD: serializes every step
+    ...
+    for batch in data:
+        out = step(out, batch)
+    jax.block_until_ready(out)         # GOOD: one sync on the result
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+_BOUNDARIES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _enclosing_loop(module, node):
+    """The nearest loop ancestor within the same function scope, or
+    None (function/lambda boundaries stop the walk: code in a nested
+    def merely DEFINED under a loop does not run per iteration)."""
+    cur = module.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, _LOOPS):
+            return cur
+        if isinstance(cur, _BOUNDARIES):
+            return None
+        cur = module.parents.get(id(cur))
+    return None
+
+
+def _is_block_until_ready(module, call: ast.Call):
+    """None, or the spelling of the block_until_ready this call is."""
+    func = call.func
+    if module.canonical(func) == "jax.block_until_ready":
+        return "jax.block_until_ready()"
+    if isinstance(func, ast.Attribute) and \
+            func.attr == "block_until_ready":
+        return ".block_until_ready()"
+    return None
+
+
+@register(
+    "block-until-ready-in-loop",
+    "per-iteration block_until_ready in a loop serializes host+device",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _is_block_until_ready(module, node)
+        if kind is None:
+            continue
+        loop = _enclosing_loop(module, node)
+        if loop is None:
+            continue
+        out.append(module.finding(
+            "block-until-ready-in-loop", node,
+            f"{kind} inside the loop at line {loop.lineno} blocks the "
+            f"host on the device EVERY iteration, collapsing the async "
+            f"dispatch queue to lock-step — hoist the sync out of the "
+            f"loop (one block_until_ready on the final value), or "
+            f"suppress with a reason if per-step blocking is the "
+            f"point (watchdog probers, trace-window drains)"))
+    return out

@@ -130,10 +130,17 @@ class _StoreChannel:
 class StepWatchdog:
     def __init__(self, timeout: Optional[float] = None,
                  on_timeout: Optional[Callable] = None,
-                 on_remote_abort: Optional[Callable] = None):
+                 on_remote_abort: Optional[Callable] = None,
+                 broadcast_abort: bool = True):
+        """``broadcast_abort=False`` makes this a PROCESS-LOCAL watchdog:
+        a timeout neither posts to the gang store nor reacts to peers'
+        abort records. The serving engine uses this — a hung serving
+        step must drain that engine, not take down a training gang that
+        happens to share the store."""
         self._timeout = timeout
         self._on_timeout = on_timeout
         self._on_remote_abort = on_remote_abort
+        self.broadcast_abort = broadcast_abort
         self._entries: Dict[int, tuple] = {}  # id -> (tag, deadline)
         self._lock = threading.Lock()
         self._seq = 0
@@ -208,7 +215,7 @@ class StepWatchdog:
         while True:
             eid, arrays = self._probe_q.get()
             try:
-                jax.block_until_ready(arrays)
+                jax.block_until_ready(arrays)  # tpulint: disable=block-until-ready-in-loop (the prober's JOB is to park on each queued step; daemon thread off the dispatch path)
             except Exception:
                 pass  # step failure surfaces on the main thread
             self.disarm(eid)
@@ -252,7 +259,8 @@ class StepWatchdog:
                 # default path aborts the process; a custom on_timeout
                 # handler keeps the monitor alive for later steps
                 self._fire(really_expired)
-            if time.monotonic() - self._abort_polled >= ABORT_POLL_S:
+            if self.broadcast_abort and \
+                    time.monotonic() - self._abort_polled >= ABORT_POLL_S:
                 self._abort_polled = time.monotonic()
                 self._check_remote_abort()
 
@@ -321,7 +329,8 @@ class StepWatchdog:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         except Exception:
             pass
-        self._post_abort(tags)
+        if self.broadcast_abort:
+            self._post_abort(tags)
         if self._on_timeout is not None:
             self._on_timeout(expired)
         else:

@@ -518,7 +518,7 @@ def _sync_tree(x):
 
     for d in leaves:
         try:
-            d.block_until_ready()
+            d.block_until_ready()  # tpulint: disable=block-until-ready-in-loop (trace-window close barrier: every leaf must retire before the profile stops; runs once per trace, not per step)
         except Exception:
             pass
     if leaves:

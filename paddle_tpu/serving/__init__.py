@@ -11,10 +11,19 @@ role (AnalysisPredictor + the fastdeploy serving layer) TPU-natively:
 :class:`Scheduler`     iteration-level admission + prefill/decode
                        interleave, token budget, preemption-on-OOM
 :class:`LLMEngine`     compiled bucketed prefill/decode steps, paged
-                       Llama decode, sampling, streaming callbacks
-:class:`ServingMetrics` queue/KV/latency gauges through
+                       Llama decode, sampling, streaming callbacks;
+                       graceful drain (SIGTERM), step watchdog/retry,
+                       nonfinite-row isolation, host KV swap
+:class:`AdmissionController` queue-depth / TTFT-SLO admission —
+                       rejection is a structured output
+:class:`ServingMetrics` queue/KV/latency + resilience gauges through
                        ``profiler.register_counter_provider``
 =================  ====================================================
+
+Every terminal path names a ``finish_reason`` (see
+:data:`FINISH_REASONS`); requests never silently vanish — drain,
+expiry, rejection, poisoned logits, and step failures all emit
+structured :class:`RequestOutput`\\ s.
 
 Quick start::
 
@@ -34,16 +43,20 @@ batch-synchronous case.)
 from paddle_tpu.serving.block_manager import (  # noqa: F401
     BlockManager, NoFreeBlocksError,
 )
-from paddle_tpu.serving.engine import EngineConfig, LLMEngine  # noqa: F401
+from paddle_tpu.serving.engine import (  # noqa: F401
+    AdmissionController, EngineConfig, EngineStepError, LLMEngine,
+    StepHungError,
+)
 from paddle_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from paddle_tpu.serving.request import (  # noqa: F401
-    Request, RequestOutput, RequestStatus, SamplingParams,
+    FINISH_REASONS, Request, RequestOutput, RequestStatus, SamplingParams,
 )
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     ScheduledBatch, Scheduler, SchedulerConfig,
 )
 
-__all__ = ["BlockManager", "NoFreeBlocksError", "EngineConfig",
-           "LLMEngine", "ServingMetrics", "Request", "RequestOutput",
-           "RequestStatus", "SamplingParams", "ScheduledBatch",
-           "Scheduler", "SchedulerConfig"]
+__all__ = ["BlockManager", "NoFreeBlocksError", "AdmissionController",
+           "EngineConfig", "EngineStepError", "StepHungError",
+           "LLMEngine", "ServingMetrics", "FINISH_REASONS", "Request",
+           "RequestOutput", "RequestStatus", "SamplingParams",
+           "ScheduledBatch", "Scheduler", "SchedulerConfig"]

@@ -9,10 +9,21 @@ Invariants (pinned by tests/test_serving.py randomized sequences):
   * a block id is owned by at most one request at a time,
   * ``num_free_blocks + sum(len(table) for tables) == num_blocks`` always,
   * ``free``/preemption returns every owned block to the free list.
-"""
+
+Swap pool: ``num_host_blocks > 0`` adds a second, host-side slot
+allocator for swap-based preemption (the first concrete instance of the
+ROADMAP host-offload stream): ``swap_out`` trades a victim's device
+blocks for refcounted host slots (the engine copies the KV bytes),
+``swap_in`` trades them back. Host slots are refcounted so a future
+prefix-cache can share one spilled prefix between requests; today every
+slot is born at refcount 1. The same exact-accounting invariants hold
+for the host pool, and ``free()`` releases BOTH sides, so no lifecycle
+path (abort while swapped included) can leak."""
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Tuple
+
+from paddle_tpu.testing import faults
 
 __all__ = ["BlockManager", "NoFreeBlocksError"]
 
@@ -27,15 +38,24 @@ def cdiv(a: int, b: int) -> int:
 
 
 class BlockManager:
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 num_host_blocks: int = 0):
         if num_blocks < 1 or block_size < 1:
             raise ValueError("num_blocks and block_size must be >= 1")
+        if num_host_blocks < 0:
+            raise ValueError("num_host_blocks must be >= 0")
         self.num_blocks = num_blocks
         self.block_size = block_size
         # LIFO free list: recently-freed blocks are reused first (their
         # cache lines are the ones most likely still resident)
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: Dict[str, List[int]] = {}
+        # host swap pool (0 = swap disabled)
+        self.num_host_blocks = num_host_blocks
+        self._host_free: List[int] = list(range(num_host_blocks - 1, -1,
+                                                -1))
+        self._host_tables: Dict[str, List[int]] = {}
+        self._host_refs: Dict[int, int] = {}  # slot -> refcount
 
     # -- accounting ------------------------------------------------------
     @property
@@ -92,6 +112,16 @@ class BlockManager:
         need = self.blocks_needed(new_len) - len(table)
         if need <= 0:
             return list(table)
+        # deterministic forced-OOM injection points: a `flag` fault at
+        # the global point (any request) or the per-request one
+        # (`serving.force_oom.<request_id>`) makes this growth OOM
+        # exactly like a genuinely exhausted free list, so
+        # preemption/swap paths are testable with a roomy cache
+        if faults.check("serving.force_oom") or \
+                faults.check(f"serving.force_oom.{request_id}"):
+            raise NoFreeBlocksError(
+                f"request {request_id!r}: injected OOM "
+                f"(PADDLE_FAULTS serving.force_oom)")
         if need > len(self._free):
             raise NoFreeBlocksError(
                 f"request {request_id!r}: {need} more block(s) needed "
@@ -101,14 +131,100 @@ class BlockManager:
         return list(table)
 
     def free(self, request_id: str) -> int:
-        """Release every block the request owns (completion OR
-        preemption). Returns the number reclaimed; idempotent for
-        unknown ids (a request preempted before admission owns none)."""
+        """Release every block the request owns — device AND host swap
+        slots (completion, preemption, abort-while-swapped). Returns the
+        number of device blocks reclaimed; idempotent for unknown ids
+        (a request preempted before admission owns none)."""
+        self.free_host(request_id)
         table = self._tables.pop(request_id, None)
         if table is None:
             return 0
         self._free.extend(table)
         return len(table)
+
+    # -- host swap pool ---------------------------------------------------
+    @property
+    def num_free_host_blocks(self) -> int:
+        return len(self._host_free)
+
+    def has_host_table(self, request_id: str) -> bool:
+        return request_id in self._host_tables
+
+    def host_table(self, request_id: str) -> List[int]:
+        return list(self._host_tables[request_id])
+
+    def can_swap_out(self, request_id: str, num_tokens: int) -> bool:
+        """Could ``num_tokens`` worth of this request's cached K/V move
+        to host slots right now?"""
+        return (self.num_host_blocks > 0
+                and request_id in self._tables
+                and request_id not in self._host_tables
+                and self.blocks_needed(num_tokens) <= len(self._host_free))
+
+    def swap_out(self, request_id: str,
+                 num_tokens: int) -> Tuple[List[int], List[int]]:
+        """Trade the request's device blocks for host slots covering its
+        first ``num_tokens`` tokens. Returns ``(device_table,
+        host_table)`` — the caller must copy device->host IMMEDIATELY
+        (before anything dispatches new device work; the freed device
+        blocks' bytes stay intact until the next compiled step writes
+        them). Each host slot starts at refcount 1."""
+        if not self.can_swap_out(request_id, num_tokens):
+            raise NoFreeBlocksError(
+                f"request {request_id!r}: cannot swap out "
+                f"{self.blocks_needed(num_tokens)} block(s) "
+                f"({len(self._host_free)} host slots free, "
+                f"pool={self.num_host_blocks})")
+        need = self.blocks_needed(num_tokens)
+        host = [self._host_free.pop() for _ in range(need)]
+        for s in host:
+            self._host_refs[s] = 1
+        self._host_tables[request_id] = host
+        dev = self._tables.pop(request_id)
+        self._free.extend(dev)
+        return dev, host
+
+    def can_swap_in(self, request_id: str) -> bool:
+        return (request_id in self._host_tables
+                and len(self._host_tables[request_id]) <= len(self._free))
+
+    def swap_in(self, request_id: str) -> Tuple[List[int], List[int]]:
+        """Trade host slots back for fresh device blocks (one per spilled
+        block). Returns ``(host_table, device_table)`` — the caller
+        copies host->device, after which the host refs are already
+        dropped. Raises on OOM (the scheduler re-tries next iteration)."""
+        host = self._host_tables.get(request_id)
+        if host is None:
+            raise KeyError(f"request {request_id!r} holds no host table")
+        if request_id in self._tables:
+            raise ValueError(
+                f"request {request_id!r} already holds a device table")
+        if len(host) > len(self._free):
+            raise NoFreeBlocksError(
+                f"request {request_id!r}: {len(host)} device block(s) "
+                f"needed to swap in, {len(self._free)} free")
+        dev = [self._free.pop() for _ in range(len(host))]
+        self._tables[request_id] = dev
+        self._host_tables.pop(request_id)
+        self._unref_host(host)
+        return host, dev
+
+    def free_host(self, request_id: str) -> int:
+        """Drop the request's host slots (abort while swapped)."""
+        host = self._host_tables.pop(request_id, None)
+        if host is None:
+            return 0
+        self._unref_host(host)
+        return len(host)
+
+    def _unref_host(self, slots: List[int]):
+        for s in slots:
+            n = self._host_refs.get(s, 0) - 1
+            if n <= 0:
+                self._host_refs.pop(s, None)
+                self._host_free.append(s)
+            else:
+                self._host_refs[s] = n
 
     # -- introspection (tests + metrics) ---------------------------------
     def check_invariants(self):
@@ -123,3 +239,19 @@ class BlockManager:
             "duplicate block in free list"
         both = set(owned) & set(self._free)
         assert not both, f"blocks both owned and free: {sorted(both)}"
+        # host pool: same exact accounting, plus refcount consistency
+        h_owned = [s for t in self._host_tables.values() for s in t]
+        assert len(h_owned) == len(set(h_owned)), \
+            "double-allocated host slot"
+        assert set(h_owned) == set(self._host_refs), (
+            f"host refcount drift: tables own {sorted(set(h_owned))}, "
+            f"refs track {sorted(self._host_refs)}")
+        assert all(n >= 1 for n in self._host_refs.values()), \
+            "host slot with refcount < 1 still tracked"
+        assert len(h_owned) + len(self._host_free) == \
+            self.num_host_blocks, (
+                f"host slot leak: {len(h_owned)} owned + "
+                f"{len(self._host_free)} free != {self.num_host_blocks}")
+        h_both = set(h_owned) & set(self._host_free)
+        assert not h_both, \
+            f"host slots both owned and free: {sorted(h_both)}"

@@ -24,10 +24,37 @@ Sampling runs host-side per request (greedy / temperature / top-p /
 top-k) on the last-token logits the compiled step returns — B×vocab is
 tiny next to the model pass, and per-request RNG streams stay
 reproducible across preemptions.
+
+Resilience layer (the serving analog of PR 3's fault-tolerant
+training):
+
+* **graceful drain** — :meth:`LLMEngine.install_preemption_handler`
+  wires SIGTERM (cloud preemption, launcher shutdown) into the step
+  loop: a draining engine stops admitting, aborts waiting/swapped
+  requests with structured ``finish_reason='aborted:drain'`` outputs,
+  and finishes the running batch within ``drain_grace_s``;
+* **deadlines + admission** — per-request ``deadline_ms`` TTLs are
+  enforced at every iteration boundary, and :class:`AdmissionController`
+  rejects on queue depth / estimated-TTFT SLO breach — rejection is a
+  first-class ``finish_reason='rejected'`` output, not an exception;
+* **swap-based preemption** — ``swap_mode='host'`` spills an OOM
+  victim's KV blocks to a host pool and restores them on re-admission,
+  token-identical to the recompute path;
+* **step fault isolation** — a process-local watchdog times the
+  compiled dispatch (hung step → :class:`StepHungError` with drain
+  semantics), transient step failures retry with backoff, and an
+  in-graph finite-logits mask aborts only NaN/Inf-poisoned requests
+  while their batch peers continue.
+
+Every failure mode has a deterministic ``PADDLE_FAULTS`` injection
+point: ``serving.step`` (slow / raising / SIGTERM-mid-run),
+``serving.nan_logits`` (poison one row), ``serving.force_oom`` (forced
+preemption) — see paddle_tpu/testing/faults.py.
 """
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -41,8 +68,30 @@ from paddle_tpu.serving.request import (
 from paddle_tpu.serving.scheduler import (
     ScheduledBatch, Scheduler, SchedulerConfig,
 )
+from paddle_tpu.testing import faults
 
-__all__ = ["EngineConfig", "LLMEngine"]
+__all__ = ["EngineConfig", "LLMEngine", "AdmissionController",
+           "EngineStepError", "StepHungError"]
+
+
+class EngineStepError(RuntimeError):
+    """The compiled serving step failed past the retry budget (or in a
+    non-retryable state, e.g. with donated caches). The engine has
+    already drained: every in-flight request was aborted with a
+    structured ``finish_reason='aborted:error'`` output — available on
+    ``.outputs`` — and every KV block was reclaimed."""
+
+    def __init__(self, msg: str, outputs: List[RequestOutput]):
+        super().__init__(msg)
+        self.outputs = outputs
+
+
+class StepHungError(EngineStepError):
+    """The watchdog deadline passed while a dispatched step was still
+    incomplete on device. Raised once the dispatch finally returns (a
+    slow-but-alive device); a truly hung device never returns — pair
+    the engine watchdog with the process-level one
+    (``PADDLE_STEP_TIMEOUT``) for that terminal case."""
 
 
 @dataclass
@@ -60,6 +109,28 @@ class EngineConfig:
     dtype: Optional[str] = None           # default: model param dtype
     donate_cache: Optional[bool] = None   # default: True off-CPU
     min_prefill_bucket: int = 8
+    # -- resilience -----------------------------------------------------
+    # preemption: 'recompute' re-prefills an OOM victim from scratch;
+    # 'host' spills its KV blocks to a host pool of num_host_blocks
+    # slots (default: num_blocks) and restores them on re-admission
+    swap_mode: str = "recompute"
+    num_host_blocks: Optional[int] = None
+    # admission control: reject (first-class 'rejected' output) when the
+    # waiting queue is this deep, or when the estimated TTFT for a new
+    # arrival exceeds the SLO (None = unbounded / no SLO)
+    max_queue_depth: Optional[int] = None
+    ttft_slo_ms: Optional[float] = None
+    # drain: running requests get this long to finish after a drain
+    # starts (SIGTERM / preemption notice); stragglers then abort with
+    # finish_reason='aborted:drain'
+    drain_grace_s: float = 30.0
+    # step fault isolation: watchdog deadline per compiled dispatch
+    # (0 = off), bounded retry with exponential backoff on transient
+    # step failures, and the in-graph NaN/Inf logits guard
+    step_timeout_s: float = 0.0
+    max_step_retries: int = 2
+    step_retry_backoff_s: float = 0.05
+    nonfinite_guard: bool = True
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -70,7 +141,91 @@ class EngineConfig:
             raise ValueError("min_prefill_bucket must be >= 1")
         if self.max_model_len is not None and self.max_model_len < 1:
             raise ValueError("max_model_len must be >= 1")
+        if self.swap_mode not in ("recompute", "host"):
+            raise ValueError(f"unknown swap_mode {self.swap_mode!r} "
+                             f"(want 'recompute' or 'host')")
+        if self.num_host_blocks is not None and self.num_host_blocks < 0:
+            raise ValueError("num_host_blocks must be >= 0")
+        if self.max_queue_depth is not None and self.max_queue_depth < 0:
+            raise ValueError("max_queue_depth must be >= 0")
+        if self.ttft_slo_ms is not None and self.ttft_slo_ms <= 0:
+            raise ValueError("ttft_slo_ms must be > 0")
+        if self.drain_grace_s < 0:
+            raise ValueError("drain_grace_s must be >= 0")
+        if self.step_timeout_s < 0:
+            raise ValueError("step_timeout_s must be >= 0")
+        if self.max_step_retries < 0:
+            raise ValueError("max_step_retries must be >= 0")
         # max_num_seqs / max_batched_tokens validate in SchedulerConfig
+
+
+class AdmissionController:
+    """SLO-aware admission: decide at ``add_request`` time whether the
+    engine should even queue a request. Two signals:
+
+    * queue depth — waiting requests already exceed ``max_queue_depth``
+      (raw backpressure: the caller should shed load or route to
+      another replica);
+    * estimated TTFT — a new arrival's first token is predicted at
+      ``(queue_depth + 1) * avg_recent_step_time`` (each queued request
+      ahead needs about one engine iteration before this one prefills);
+      when that estimate exceeds ``ttft_slo_ms``, admitting the request
+      only manufactures an SLO miss, so it is rejected while there is
+      still time to retry elsewhere. With no step history yet (cold
+      engine) the estimate abstains and admission falls through to the
+      depth check alone.
+
+    Rejection is a verdict string (human-readable reason), never an
+    exception — the engine turns it into a first-class
+    ``finish_reason='rejected'`` output."""
+
+    def __init__(self, max_queue_depth: Optional[int] = None,
+                 ttft_slo_ms: Optional[float] = None):
+        self.max_queue_depth = max_queue_depth
+        self.ttft_slo_ms = ttft_slo_ms
+
+    def verdict(self, engine: "LLMEngine") -> Optional[str]:
+        depth = engine.scheduler.num_waiting
+        if self.max_queue_depth is not None \
+                and depth >= self.max_queue_depth:
+            return (f"queue depth {depth} >= max_queue_depth "
+                    f"{self.max_queue_depth}")
+        if self.ttft_slo_ms is not None:
+            est = engine.metrics.estimated_ttft_ms(depth)
+            if est is not None and est > self.ttft_slo_ms:
+                return (f"estimated TTFT {est:.1f}ms exceeds SLO "
+                        f"{self.ttft_slo_ms}ms at queue depth {depth}")
+        return None
+
+
+class _KVSwapper:
+    """Engine-side block mover for swap-based preemption: copies the
+    stacked (L, nblocks, BS, KH, D) device cache slices to/from the
+    host pool. ``copy_out`` runs synchronously inside the scheduler's
+    eviction (the freed device blocks' bytes are intact until the next
+    compiled step writes them); ``copy_in`` is one scatter dispatch."""
+
+    def __init__(self, engine: "LLMEngine"):
+        self._eng = engine
+
+    def copy_out(self, request: Request, dev_table: List[int],
+                 host_table: List[int]):
+        eng = self._eng
+        # the device table may hold one more block than was written
+        # (a decode-step slot claimed before the eviction); spill only
+        # the blocks the host table covers
+        dev = np.asarray(dev_table[:len(host_table)], np.int32)
+        host = np.asarray(host_table, np.int32)
+        eng._host_k[:, host] = np.asarray(eng._kcs[:, dev])  # tpulint: disable=host-sync-in-traced (swap-out IS the device->host spill; a handful of KV blocks, off the step's critical path)
+        eng._host_v[:, host] = np.asarray(eng._vcs[:, dev])
+
+    def copy_in(self, request: Request, host_table: List[int],
+                dev_table: List[int]):
+        eng = self._eng
+        host = np.asarray(host_table, np.int32)
+        dev = np.asarray(dev_table, np.int32)
+        eng._kcs = eng._kcs.at[:, dev].set(eng._host_k[:, host])
+        eng._vcs = eng._vcs.at[:, dev].set(eng._host_v[:, host])
 
 
 class LLMEngine:
@@ -111,12 +266,21 @@ class LLMEngine:
             self.cfg.num_blocks = (self.cfg.max_num_seqs *
                                    self.max_blocks_per_seq)
 
-        self.block_manager = BlockManager(self.cfg.num_blocks,
-                                          self.cfg.block_size)
+        if self.cfg.num_host_blocks is None:
+            self.cfg.num_host_blocks = (
+                self.cfg.num_blocks if self.cfg.swap_mode == "host" else 0)
+        self.block_manager = BlockManager(
+            self.cfg.num_blocks, self.cfg.block_size,
+            num_host_blocks=self.cfg.num_host_blocks)
+        self._swapper = _KVSwapper(self)
         self.scheduler = Scheduler(
             self.block_manager,
             SchedulerConfig(max_num_seqs=self.cfg.max_num_seqs,
-                            max_batched_tokens=self.cfg.max_batched_tokens))
+                            max_batched_tokens=self.cfg.max_batched_tokens),
+            swap_mode=self.cfg.swap_mode, kv_swapper=self._swapper)
+        self.admission = AdmissionController(
+            max_queue_depth=self.cfg.max_queue_depth,
+            ttft_slo_ms=self.cfg.ttft_slo_ms)
 
         # -- device caches: (L, NB, BS, KH, D) stacked per layer --------
         import jax.numpy as jnp
@@ -133,6 +297,15 @@ class LLMEngine:
                  self.cfg.block_size, kh, hd)
         self._kcs = jnp.zeros(shape, cache_dtype)
         self._vcs = jnp.zeros(shape, cache_dtype)
+        # host swap pool: plain numpy, the restore-on-readmit side of
+        # swap-based preemption (the first concrete host-offload stream)
+        if self.cfg.num_host_blocks > 0:
+            hshape = (mcfg.num_hidden_layers, self.cfg.num_host_blocks,
+                      self.cfg.block_size, kh, hd)
+            self._host_k = np.zeros(hshape, np.dtype(cache_dtype))
+            self._host_v = np.zeros(hshape, np.dtype(cache_dtype))
+        else:
+            self._host_k = self._host_v = None
 
         # -- compiled prefill/decode step -------------------------------
         from paddle_tpu.jit.trace import functionalize
@@ -151,11 +324,16 @@ class LLMEngine:
             # paths stay token-identical (pinned by
             # tests/test_serving_engine.py).
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return logits, greedy, k2, v2
+            # in-graph non-finite guard: one bool per row, so the
+            # all-greedy path can detect a NaN/Inf-poisoned request
+            # without ever fetching its B×vocab logits
+            finite = jnp.isfinite(logits).all(axis=-1)
+            return logits, greedy, finite, k2, v2
 
         donate = self.cfg.donate_cache
         if donate is None:
             donate = jax.default_backend() not in ("cpu",)
+        self._donated = bool(donate)
         self._jstep = jax.jit(
             raw_step, donate_argnums=(4, 5) if donate else ())
         self._key = jax.random.key(0)
@@ -166,6 +344,36 @@ class LLMEngine:
         # decode only; greedy steps ship B in-graph-argmax'd ints) —
         # the observable tests/test_serving_engine.py pins
         self.num_logits_fetches = 0
+
+        # -- resilience state -------------------------------------------
+        # lifetime counters (survive reset_metrics, like the
+        # scheduler's num_preemptions; surfaced as serving/* gauges)
+        self.num_expired = 0
+        self.num_rejected = 0
+        self.num_step_retries = 0
+        self.num_poisoned_aborts = 0
+        self.num_drains_started = 0
+        self.num_drain_aborted = 0
+        self.num_drains_completed = 0
+        self._draining = False
+        self._drain_reason: Optional[str] = None
+        self._drain_deadline: Optional[float] = None
+        self._preempt = None            # PreemptionMonitor once installed
+        self._pending_outputs: List[RequestOutput] = []
+        self._seen_shapes: set = set()  # (kind, B, S) already compiled
+        self._hung_tags: Optional[str] = None
+        if self.cfg.step_timeout_s > 0:
+            from paddle_tpu.distributed.watchdog import StepWatchdog
+
+            # process-LOCAL watchdog: a hung serving step drains this
+            # engine; it must not gang-abort a co-resident train loop
+            self._watchdog = StepWatchdog(
+                timeout=self.cfg.step_timeout_s,
+                on_timeout=self._on_step_timeout,
+                broadcast_abort=False)
+        else:
+            self._watchdog = None
+
         self.metrics = ServingMetrics(self)
 
     # -- request lifecycle ----------------------------------------------
@@ -203,11 +411,118 @@ class LLMEngine:
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
         self._requests[request_id] = req
+        # admission control: a draining engine admits nothing; a live
+        # one consults the controller. Rejection is a first-class
+        # structured output (finish_reason='rejected'), NOT an
+        # exception — the request never reaches the scheduler, stays
+        # queryable, and streams its terminal event like any other.
+        verdict = ("engine is draining" if self._draining
+                   else self.admission.verdict(self))
+        if verdict is not None:
+            req.abort("rejected")
+            self.num_rejected += 1
+            self._pending_outputs.append(self._terminal_output(req))
+            return request_id
         self.scheduler.add(req)
         return request_id
 
     def abort_request(self, request_id: str) -> bool:
-        return self.scheduler.abort(request_id)
+        return self.scheduler.abort(request_id, "aborted:user")
+
+    # -- graceful drain --------------------------------------------------
+    def install_preemption_handler(self, monitor=None):
+        """Wire SIGTERM into the step loop: once the (process-global by
+        default) :class:`PreemptionMonitor` reports a notice, the next
+        :meth:`step` starts a drain — stop admitting, abort waiting/
+        swapped requests with ``finish_reason='aborted:drain'``, give
+        the running batch ``drain_grace_s`` to finish. Pass an existing
+        monitor to share one across engines (or inject a test one);
+        must run on the main thread (signal-module rule)."""
+        if monitor is None:
+            from paddle_tpu.distributed.watchdog import preemption_monitor
+
+            monitor = preemption_monitor()
+        monitor.install()
+        self._preempt = monitor
+        return monitor
+
+    @property
+    def is_draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain ran to completion: nothing unfinished,
+        every request either completed or holds a structured abort."""
+        return self._draining and not self.scheduler.has_unfinished()
+
+    def start_drain(self, reason: str = "manual",
+                    grace_s: Optional[float] = None
+                    ) -> List[RequestOutput]:
+        """Begin a graceful drain: admission closes, every WAITING and
+        SWAPPED request aborts NOW with ``finish_reason='aborted:drain'``
+        (their structured outputs are returned), and the running batch
+        keeps stepping until done or until ``grace_s`` (default
+        ``drain_grace_s``) elapses — stragglers then abort the same
+        way. Idempotent."""
+        if self._draining:
+            return []
+        self._draining = True
+        self._drain_reason = reason
+        grace = self.cfg.drain_grace_s if grace_s is None else grace_s
+        self._drain_deadline = time.monotonic() + grace
+        self.num_drains_started += 1
+        outs = []
+        pending = list(self.scheduler.waiting) + list(self.scheduler.swapped)
+        for r in pending:
+            self.scheduler.abort(r.request_id, "aborted:drain")
+            self.num_drain_aborted += 1
+            outs.append(self._terminal_output(r))
+        return outs
+
+    def drain(self, grace_s: Optional[float] = None,
+              reason: str = "manual") -> List[RequestOutput]:
+        """``start_drain`` + step to completion. Returns every output
+        emitted during the drain (completions and aborts)."""
+        outs = self.start_drain(reason=reason, grace_s=grace_s)
+        while self.scheduler.has_unfinished():
+            outs.extend(self.step())
+        outs.extend(self._flush_pending())
+        return outs
+
+    def _abort_running(self, reason: str) -> List[RequestOutput]:
+        """Terminal sweep of every live request (running AND queued) —
+        the grace-budget-expired / step-failed path. All blocks are
+        reclaimed; each request gets a structured output."""
+        outs = []
+        live = (list(self.scheduler.running) + list(self.scheduler.waiting)
+                + list(self.scheduler.swapped))
+        for r in live:
+            self.scheduler.abort(r.request_id, reason)
+            if reason == "aborted:drain":
+                self.num_drain_aborted += 1
+            outs.append(self._terminal_output(r))
+        return outs
+
+    def _terminal_output(self, req: Request) -> RequestOutput:
+        """Structured tokenless emission for an aborted/expired/rejected
+        request; streams through its callback like a sampled token."""
+        out = RequestOutput(request_id=req.request_id, token=None,
+                            finished=True, generated=list(req.generated),
+                            finish_reason=req.finish_reason)
+        if req.callback is not None:
+            req.callback(req.request_id, None, True)
+        return out
+
+    def _flush_pending(self) -> List[RequestOutput]:
+        out, self._pending_outputs = self._pending_outputs, []
+        return out
+
+    def _on_step_timeout(self, expired):
+        """Watchdog thread callback: note the hang; the dispatching
+        thread surfaces it as StepHungError when (if) the step
+        completes."""
+        self._hung_tags = ", ".join(ent[0] for ent in expired)
 
     def release_request(self, request_id: str) -> Optional[Request]:
         """Drop a FINISHED request's bookkeeping (long-lived engines —
@@ -254,15 +569,39 @@ class LLMEngine:
     def step(self) -> List[RequestOutput]:
         """Schedule + run ONE model iteration (a prefill batch or a
         decode batch), sample one token per scheduled request, retire
-        finished requests. Returns this step's per-request outputs."""
+        finished requests. Returns this step's per-request outputs —
+        sampled tokens plus any structured terminal emissions (expired,
+        rejected, drain-aborted, poisoned) produced at this iteration
+        boundary."""
+        outputs: List[RequestOutput] = self._flush_pending()
+
+        # preemption notice (SIGTERM / programmatic) -> drain
+        if self._preempt is not None and not self._draining \
+                and self._preempt.requested():
+            outputs.extend(self.start_drain("preemption"))
+        if self._draining:
+            if not self.scheduler.has_unfinished():
+                self._finish_drain()
+                return outputs
+            if time.monotonic() > self._drain_deadline:
+                # grace budget spent: the stragglers abort, structured
+                outputs.extend(self._abort_running("aborted:drain"))
+                self._finish_drain()
+                return outputs
+
+        t0 = time.perf_counter()
         batch = self.scheduler.schedule()
+        outputs.extend(self._terminal_output(r) for r in batch.expired)
+        self.num_expired += len(batch.expired)
         if batch.is_empty:
-            if self.scheduler.has_unfinished():
+            if self.scheduler.has_unfinished() and not (
+                    batch.preempted or batch.swapped_in
+                    or self.scheduler.num_swapped):
                 raise RuntimeError(
                     "scheduler produced an empty batch with unfinished "
                     "requests — KV cache too small for any waiting "
                     "request (admission validation should prevent this)")
-            return []
+            return outputs
         reqs = batch.requests
         is_prefill = batch.kind == "prefill"
         n_run = [len(r.tokens_to_run()) for r in reqs]
@@ -284,27 +623,32 @@ class LLMEngine:
             table = self.block_manager.block_table(r.request_id)
             bt[i, :len(table)] = table
 
-        logits, greedy, self._kcs, self._vcs = self._jstep(
-            [p._data for p in self._params],
-            [b._data for b in self._buffers],
-            self._key, ids, self._kcs, self._vcs, bt, enc, dec, now)
-        if all(r.sampling.temperature <= 0.0 for r in reqs):
-            # all-greedy step: the token ids were computed in-graph —
-            # fetch B int32s, never the B×vocab logits
-            logits_np = None
-            tokens_np = np.asarray(greedy)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized int fetch IS the engine's host boundary)
-        else:
-            # sampled decode still samples host-side per request;
-            # in-graph top-k/top-p is the remaining ROADMAP "in-graph
-            # sampling" follow-up
-            self.num_logits_fetches += 1
-            tokens_np = None
-            logits_np = np.asarray(logits)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B×vocab fetch only on the sampled-decode path; ROADMAP serving follow-up: in-graph sampling)
+        all_greedy = all(r.sampling.temperature <= 0.0 for r in reqs)
+        try:
+            tokens_np, logits_np, finite_np = self._dispatch(
+                reqs, batch.kind, (ids, bt, enc, dec, now), B, S,
+                all_greedy)
+        except EngineStepError as e:
+            # this step's already-produced structured outputs (flushed
+            # rejections, expiries) must not vanish with the failure —
+            # they ride the exception ahead of the abort sweep
+            e.outputs = outputs + e.outputs
+            raise
+
+        # non-finite-logits guard: abort ONLY the poisoned row(s); the
+        # rest of the batch continues untouched (their KV blocks and
+        # logits are independent of the poisoned row)
+        poisoned = self._poisoned_rows(reqs, logits_np, finite_np)
 
         self.metrics.record_step(batch.kind, len(reqs), int(sum(n_run)),
-                                 self.cfg.max_num_seqs)
-        outputs: List[RequestOutput] = []
+                                 self.cfg.max_num_seqs,
+                                 time.perf_counter() - t0)
         for i, r in enumerate(reqs):
+            if i in poisoned:
+                self.scheduler.abort(r.request_id, "aborted:nonfinite")
+                self.num_poisoned_aborts += 1
+                outputs.append(self._terminal_output(r))
+                continue
             r.num_cached += n_run[i]
             token = int(tokens_np[i]) if logits_np is None \
                 else self._sample(r, logits_np[i])
@@ -315,11 +659,145 @@ class LLMEngine:
                 self.metrics.record_finish(r)
             out = RequestOutput(request_id=r.request_id, token=token,
                                 finished=finished,
-                                generated=list(r.generated))
+                                generated=list(r.generated),
+                                finish_reason=r.finish_reason)
             outputs.append(out)
             if r.callback is not None:
                 r.callback(r.request_id, token, finished)
+        if self._draining and not self.scheduler.has_unfinished():
+            self._finish_drain()  # this step emptied the engine
         return outputs
+
+    # -- the guarded compiled dispatch ----------------------------------
+    def _dispatch(self, reqs, kind, arrays, B, S, all_greedy):
+        """Run the compiled step under the fault-isolation envelope:
+        watchdog-armed dispatch (hung-step detection), bounded
+        retry-with-backoff on transient failures, and the fetch of this
+        step's host-side views. Returns ``(tokens_np, logits_np,
+        finite_np)`` (exactly one of tokens/logits is set).
+
+        On a failure that exhausts the retry budget — or any failure
+        with donated caches, whose buffers a failed dispatch may have
+        invalidated — the engine aborts EVERY live request with
+        ``finish_reason='aborted:error'`` structured outputs and raises
+        :class:`EngineStepError` carrying them (drain semantics: no
+        request just vanishes)."""
+        ids, bt, enc, dec, now = arrays
+        tag = f"serving.{kind}[B={B},S={S}]"
+        cold = (kind, B, S) not in self._seen_shapes
+        attempt = 0
+        while True:
+            eid = 0
+            try:
+                # arm BEFORE anything that can block (the watchdog
+                # contract: on CPU-callback/full-queue backends the
+                # hang happens inside the dispatch call itself)
+                if self._watchdog is not None:
+                    from paddle_tpu.distributed.watchdog import (
+                        COMPILE_ALLOWANCE,
+                    )
+
+                    eid = self._watchdog.arm(
+                        tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
+                faults.fire("serving.step")  # slow/raise/sigterm point
+                logits, greedy, finite, kcs, vcs = self._jstep(
+                    [p._data for p in self._params],
+                    [b._data for b in self._buffers],
+                    self._key, ids, self._kcs, self._vcs, bt, enc, dec,
+                    now)
+                if self._watchdog is not None:
+                    self._watchdog.attach(eid, (logits, greedy))
+                if all_greedy:
+                    # all-greedy step: token ids computed in-graph —
+                    # fetch B int32s, never the B×vocab logits
+                    logits_np = None
+                    tokens_np = np.asarray(greedy)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized int fetch IS the engine's host boundary)
+                else:
+                    # sampled decode still samples host-side per
+                    # request; in-graph top-k/top-p is the remaining
+                    # ROADMAP "in-graph sampling" follow-up
+                    self.num_logits_fetches += 1
+                    tokens_np = None
+                    logits_np = np.asarray(logits)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B×vocab fetch only on the sampled-decode path; ROADMAP serving follow-up: in-graph sampling)
+                finite_np = None
+                if self.cfg.nonfinite_guard and logits_np is None:
+                    finite_np = np.asarray(finite)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized bool fetch: the nonfinite guard's greedy-path observable)
+            except Exception as e:
+                if self._watchdog is not None:
+                    self._watchdog.disarm(eid)
+                retryable = (not self._donated
+                             and attempt < self.cfg.max_step_retries)
+                if not retryable:
+                    why = ("donated caches make a failed step "
+                           "non-retryable" if self._donated else
+                           f"retry budget ({self.cfg.max_step_retries}) "
+                           f"exhausted")
+                    # the aborts ride the exception (NOT the pending
+                    # queue too — a caller that catches and keeps
+                    # stepping must not see them twice)
+                    outs = self._abort_running("aborted:error")
+                    self._fail_closed()
+                    raise EngineStepError(
+                        f"serving step {tag} failed ({why}): {e!r} — "
+                        f"engine drained, {len(outs)} request(s) "
+                        f"aborted with structured outputs", outs) from e
+                attempt += 1
+                self.num_step_retries += 1
+                time.sleep(self.cfg.step_retry_backoff_s
+                           * (2 ** (attempt - 1)))
+                continue
+            break
+        # commit only after a fully-successful dispatch+fetch, so a
+        # retried attempt re-reads the PRE-failure cache state
+        self._kcs, self._vcs = kcs, vcs
+        self._seen_shapes.add((kind, B, S))
+        if self._hung_tags is not None:
+            # the deadline fired while this (eventually-completed)
+            # dispatch was in flight: the device is unhealthy-slow;
+            # fail the engine with drain semantics rather than serve
+            # SLO-less
+            tags, self._hung_tags = self._hung_tags, None
+            outs = self._abort_running("aborted:error")
+            self._fail_closed()
+            raise StepHungError(
+                f"serving step(s) [{tags}] exceeded the "
+                f"{self.cfg.step_timeout_s}s watchdog deadline — "
+                f"engine drained, {len(outs)} request(s) aborted with "
+                f"structured outputs", outs)
+        return tokens_np, logits_np, finite_np
+
+    def _poisoned_rows(self, reqs, logits_np, finite_np) -> set:
+        """Row indices whose logits are non-finite (or deterministically
+        poisoned via the ``serving.nan_logits`` flag fault, whose arg
+        picks the row by index or request id)."""
+        if not self.cfg.nonfinite_guard:
+            return set()
+        poisoned = set()
+        for arg in faults.check("serving.nan_logits"):
+            for i, r in enumerate(reqs):
+                if arg in (None, "", str(i), r.request_id):
+                    poisoned.add(i)  # as-if this row's logits went NaN
+        if logits_np is not None:
+            fin = np.isfinite(logits_np).all(axis=-1)
+            poisoned |= {i for i in range(len(reqs)) if not fin[i]}
+        elif finite_np is not None:
+            poisoned |= {i for i in range(len(reqs)) if not finite_np[i]}
+        return poisoned
+
+    def _finish_drain(self):
+        if self._drain_deadline is not None:
+            self._drain_deadline = None
+            self.num_drains_completed += 1
+
+    def _fail_closed(self):
+        """Latch the engine shut after a fatal step failure: admission
+        closes (new requests get 'rejected' outputs, not a crash on
+        the next dispatch — with donated caches the buffers the engine
+        still references may have been invalidated by the failed
+        step), and no further drain bookkeeping runs."""
+        self._draining = True
+        self._drain_reason = "step-failure"
+        self._drain_deadline = None
 
     # -- sampling (host-side, per request) ------------------------------
     @staticmethod

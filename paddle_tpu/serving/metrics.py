@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import time
 import weakref
-from typing import Dict, List
+from collections import deque
+from typing import Dict, List, Optional
 
 __all__ = ["ServingMetrics"]
 
@@ -39,7 +40,26 @@ class ServingMetrics:
 
     GAUGES = ("queue_depth", "num_running", "num_waiting",
               "kv_block_utilization", "tokens_per_sec", "ttft_ms_avg",
-              "tpot_ms_avg", "preemptions", "batch_occupancy")
+              "tpot_ms_avg", "preemptions", "batch_occupancy",
+              # resilience (ISSUE 6): lifetime engine/scheduler counters
+              "num_swapped", "swapped_out", "swapped_in", "expired",
+              "rejected", "step_retries", "poisoned_aborts",
+              "drain_started", "drain_aborted", "drain_completed")
+
+    # gauges read straight off the engine/scheduler (they outlive
+    # reset_metrics, like `preemptions` always has)
+    _ENGINE_GAUGES = {
+        "num_swapped": lambda eng: eng.scheduler.num_swapped,
+        "swapped_out": lambda eng: eng.scheduler.num_swap_outs,
+        "swapped_in": lambda eng: eng.scheduler.num_swap_ins,
+        "expired": lambda eng: eng.num_expired,
+        "rejected": lambda eng: eng.num_rejected,
+        "step_retries": lambda eng: eng.num_step_retries,
+        "poisoned_aborts": lambda eng: eng.num_poisoned_aborts,
+        "drain_started": lambda eng: eng.num_drains_started,
+        "drain_aborted": lambda eng: eng.num_drain_aborted,
+        "drain_completed": lambda eng: eng.num_drains_completed,
+    }
 
     def __init__(self, engine):
         self._engine = weakref.ref(engine)
@@ -55,13 +75,18 @@ class ServingMetrics:
         # batch occupancy: scheduled seqs / max_num_seqs per decode step
         self._occupancy_sum = 0.0
         self._occupancy_n = 0
+        # rolling window of recent step wall times — the admission
+        # controller's TTFT estimator input
+        self._step_times_s: deque = deque(maxlen=64)
         self._registered: List[str] = []
         self._register(engine)
 
     # -- recording (called by the engine) --------------------------------
     def record_step(self, kind: str, n_seqs: int, n_tokens: int,
-                    max_num_seqs: int):
+                    max_num_seqs: int, dt_s: Optional[float] = None):
         self.engine_steps += 1
+        if dt_s is not None:
+            self._step_times_s.append(dt_s)
         if kind == "prefill":
             self.prefill_steps += 1
             self.num_prompt_tokens += n_tokens
@@ -69,6 +94,17 @@ class ServingMetrics:
             self.decode_steps += 1
             self._occupancy_sum += n_seqs / max_num_seqs
             self._occupancy_n += 1
+
+    def estimated_ttft_ms(self, queue_depth: int) -> Optional[float]:
+        """Predicted time-to-first-token for a request arriving behind
+        ``queue_depth`` waiting peers: each needs roughly one engine
+        iteration before this one prefills. None while the engine has
+        no step history (cold start — admission abstains rather than
+        reject on a guess)."""
+        if not self._step_times_s:
+            return None
+        avg = sum(self._step_times_s) / len(self._step_times_s)
+        return (queue_depth + 1) * avg * 1e3
 
     def record_token(self):
         self.num_generated_tokens += 1
@@ -118,7 +154,13 @@ class ServingMetrics:
                 "kv_block_utilization": round(
                     eng.block_manager.utilization(), 4),
                 "kv_blocks_total": eng.block_manager.num_blocks,
+                "kv_host_blocks_total": eng.block_manager.num_host_blocks,
             })
+            # resilience counters (what BENCH_serving trends): swap
+            # traffic, TTL expiry, admission rejects, step retries,
+            # poisoned-row aborts, drain lifecycle
+            out.update({f"serving_{name}": int(get(eng))
+                        for name, get in self._ENGINE_GAUGES.items()})
         return out
 
     # -- profiler counter providers --------------------------------------
@@ -133,6 +175,8 @@ class ServingMetrics:
                 eng, m = ref(), mref()
                 if eng is None or m is None:
                     return None  # counters() drops dead providers
+                if name in ServingMetrics._ENGINE_GAUGES:
+                    return ServingMetrics._ENGINE_GAUGES[name](eng)
                 if name == "queue_depth":
                     return eng.scheduler.num_waiting
                 if name == "num_running":

@@ -15,7 +15,8 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-__all__ = ["SamplingParams", "RequestStatus", "Request", "RequestOutput"]
+__all__ = ["SamplingParams", "RequestStatus", "Request", "RequestOutput",
+           "FINISH_REASONS"]
 
 
 @dataclass
@@ -23,7 +24,14 @@ class SamplingParams:
     """Per-request decode knobs. ``temperature<=0`` is greedy argmax;
     otherwise softmax sampling at that temperature, optionally truncated
     to the ``top_k`` highest-probability tokens and/or the smallest
-    nucleus with cumulative mass >= ``top_p``."""
+    nucleus with cumulative mass >= ``top_p``.
+
+    SLO knobs: ``deadline_ms`` is a TTL from arrival — the scheduler
+    expires the request (``finish_reason='expired'``) the first
+    iteration boundary after arrival+deadline, wherever it is in its
+    lifecycle. ``priority`` orders admission and protects against
+    preemption: LOWER values are MORE important (scheduled first,
+    evicted last); default 0, ties broken FCFS by arrival."""
 
     max_new_tokens: int = 32
     temperature: float = 0.0
@@ -31,6 +39,8 @@ class SamplingParams:
     top_k: int = 0
     eos_token_id: Optional[int] = None
     seed: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    priority: int = 0
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -39,12 +49,28 @@ class SamplingParams:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k < 0:
             raise ValueError("top_k must be >= 0")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError("deadline_ms must be > 0")
 
 
 class RequestStatus(Enum):
     WAITING = "waiting"      # queued (new, or preempted for recompute)
     RUNNING = "running"      # KV cached; decoding one token per step
-    FINISHED = "finished"    # EOS / max_new_tokens reached
+    SWAPPED = "swapped"      # preempted with KV spilled to the host pool
+    FINISHED = "finished"    # done — see Request.finish_reason for how
+
+
+# Request.finish_reason vocabulary (every terminal path names one):
+#   "stop"              hit eos_token_id
+#   "length"            hit max_new_tokens
+#   "expired"           deadline_ms TTL passed before completion
+#   "rejected"          admission controller refused it (never scheduled)
+#   "aborted:user"      abort_request() cancellation
+#   "aborted:drain"     engine drained (SIGTERM/preemption) before it ran
+#   "aborted:nonfinite" its logits went NaN/Inf (batch peers continue)
+#   "aborted:error"     engine step failed past the retry budget
+FINISH_REASONS = ("stop", "length", "expired", "rejected", "aborted:user",
+                  "aborted:drain", "aborted:nonfinite", "aborted:error")
 
 
 @dataclass
@@ -66,6 +92,8 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     num_preemptions: int = 0
+    num_swaps: int = 0
+    finish_reason: Optional[str] = None
 
     def __post_init__(self):
         if not self.prompt_ids:
@@ -101,6 +129,30 @@ class Request:
     def is_finished(self) -> bool:
         return self.status == RequestStatus.FINISHED
 
+    @property
+    def priority(self) -> int:
+        return self.sampling.priority
+
+    @property
+    def sort_key(self):
+        """Total scheduling order: (priority, arrival) — lower tuples
+        are more important. Preserved across preemption (arrival_time
+        never resets), so an evicted request keeps its place."""
+        return (self.sampling.priority, self.arrival_time)
+
+    @property
+    def deadline(self) -> Optional[float]:
+        """Absolute monotonic expiry instant, or None (no TTL)."""
+        if self.sampling.deadline_ms is None:
+            return None
+        return self.arrival_time + self.sampling.deadline_ms / 1e3
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        dl = self.deadline
+        if dl is None or self.is_finished:
+            return False
+        return (time.monotonic() if now is None else now) > dl
+
     def tokens_to_run(self) -> List[int]:
         """Tokens whose K/V must be computed this iteration: the whole
         uncached prefix for a prefill, the single newest token for a
@@ -115,6 +167,27 @@ class Request:
         self.num_cached = 0
         self.num_preemptions += 1
 
+    def swap_out(self):
+        """Preemption by host spill: device blocks freed, their contents
+        parked in the BlockManager's host pool. ``num_cached`` is KEPT —
+        for a SWAPPED request it counts tokens whose K/V live in host
+        slots; swap-in restores them and the request resumes decoding
+        with no recompute."""
+        self.status = RequestStatus.SWAPPED
+        self.num_preemptions += 1
+        self.num_swaps += 1
+
+    def swap_in(self):
+        self.status = RequestStatus.RUNNING
+
+    def abort(self, reason: str):
+        """Terminal, without a sampled token: drain, expiry, rejection,
+        user cancel, poisoned logits, step failure."""
+        self.status = RequestStatus.FINISHED
+        self.finish_reason = reason
+        if self.finish_time is None:
+            self.finish_time = time.monotonic()
+
     def append_token(self, token: int) -> bool:
         """Record a sampled token; returns True when the request is now
         finished (EOS or max_new_tokens)."""
@@ -122,11 +195,12 @@ class Request:
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
         sp = self.sampling
-        done = (self.num_generated >= sp.max_new_tokens or
-                (sp.eos_token_id is not None and
-                 int(token) == sp.eos_token_id))
+        hit_eos = (sp.eos_token_id is not None and
+                   int(token) == sp.eos_token_id)
+        done = hit_eos or self.num_generated >= sp.max_new_tokens
         if done:
             self.status = RequestStatus.FINISHED
+            self.finish_reason = "stop" if hit_eos else "length"
             self.finish_time = time.monotonic()
         return done
 
@@ -134,12 +208,21 @@ class Request:
 @dataclass
 class RequestOutput:
     """One step's emission for a request (streamed via ``callback`` and
-    returned from ``LLMEngine.step``)."""
+    returned from ``LLMEngine.step``). ``token`` is None on tokenless
+    terminal emissions — expiry, rejection, drain/nonfinite/error aborts
+    — whose ``finish_reason`` says why; ``generated`` still carries
+    whatever the request produced before the abort."""
 
     request_id: str
-    token: int
+    token: Optional[int]
     finished: bool
     generated: List[int]
+    finish_reason: Optional[str] = None
+
+    @property
+    def aborted(self) -> bool:
+        return self.finished and self.finish_reason not in (
+            None, "stop", "length")
 
     @property
     def text_tokens(self) -> List[int]:  # parity alias

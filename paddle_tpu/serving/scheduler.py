@@ -8,14 +8,28 @@ running request), so late-arriving requests join the running batch at
 the next iteration boundary instead of waiting for a full drain.
 
 Preemption: when a decode step needs a block and none are free, the
-lowest-priority running request (latest arrival) is evicted — its
-blocks reclaimed, its state reset to WAITING for recompute — until the
-victim set frees enough. FCFS admission order plus eviction-from-the-
-back gives the oldest request a monotonically growing claim on the
-cache, so every admitted request eventually finishes (the starvation
-guard pinned by tests/test_serving.py)."""
+lowest-priority running request (largest ``(priority, arrival)`` key)
+is evicted — never a higher-priority one — until the victim set frees
+enough. Priority-then-FCFS admission plus eviction-from-the-back gives
+the most important request a monotonically growing claim on the cache,
+so every admitted request eventually finishes (the starvation guard
+pinned by tests/test_serving.py).
+
+Eviction has two modes (``swap_mode``): ``recompute`` resets the victim
+to WAITING and recomputes its whole prefix on re-admission (vLLM's
+default); ``host`` spills the victim's KV blocks to the
+:class:`BlockManager` host pool through the engine's KV swapper and
+restores them on re-admission — no recompute, token-identical by
+construction (parity pinned by tests/test_serving_resilience.py).
+
+Deadlines: every :meth:`schedule` call first expires requests whose
+``deadline_ms`` TTL has passed — wherever they are (waiting, running,
+swapped) — freeing their blocks and reporting them in
+``ScheduledBatch.expired`` so the engine can emit structured
+``finish_reason='expired'`` outputs."""
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
@@ -52,12 +66,17 @@ class SchedulerConfig:
 @dataclass
 class ScheduledBatch:
     """One iteration's work: requests + phase. ``preempted`` lists
-    requests evicted while forming this batch (already reset to
-    WAITING and re-queued)."""
+    requests evicted while forming this batch (reset to WAITING for
+    recompute, or SWAPPED to the host pool); ``swapped_in`` lists
+    requests restored from the host pool into ``running`` this
+    iteration; ``expired`` lists requests whose deadline passed (already
+    terminal, blocks freed — the engine emits their outputs)."""
 
     kind: str                       # "prefill" | "decode" | "idle"
     requests: List[Request] = field(default_factory=list)
     preempted: List[Request] = field(default_factory=list)
+    swapped_in: List[Request] = field(default_factory=list)
+    expired: List[Request] = field(default_factory=list)
 
     @property
     def is_empty(self) -> bool:
@@ -66,12 +85,29 @@ class ScheduledBatch:
 
 class Scheduler:
     def __init__(self, block_manager: BlockManager,
-                 config: Optional[SchedulerConfig] = None):
+                 config: Optional[SchedulerConfig] = None,
+                 swap_mode: str = "recompute", kv_swapper=None):
+        """``swap_mode='host'`` needs a ``kv_swapper`` — the engine-side
+        mover with ``copy_out(request, dev_table, host_table)`` /
+        ``copy_in(request, host_table, dev_table)`` — plus a
+        BlockManager built with ``num_host_blocks > 0``. When the host
+        pool is full (or absent) eviction falls back to recompute, so
+        ``host`` mode degrades gracefully rather than deadlocking."""
+        if swap_mode not in ("recompute", "host"):
+            raise ValueError(f"unknown swap_mode {swap_mode!r} "
+                             f"(want 'recompute' or 'host')")
+        if swap_mode == "host" and kv_swapper is None:
+            raise ValueError("swap_mode='host' needs a kv_swapper")
         self.block_manager = block_manager
         self.config = config or SchedulerConfig()
+        self.swap_mode = swap_mode
+        self.kv_swapper = kv_swapper
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []
+        self.swapped: List[Request] = []
         self.num_preemptions = 0
+        self.num_swap_outs = 0
+        self.num_swap_ins = 0
 
     # -- queue ops -------------------------------------------------------
     def add(self, request: Request):
@@ -79,7 +115,7 @@ class Scheduler:
         self.waiting.append(request)
 
     def has_unfinished(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.swapped)
 
     @property
     def num_waiting(self) -> int:
@@ -89,54 +125,128 @@ class Scheduler:
     def num_running(self) -> int:
         return len(self.running)
 
+    @property
+    def num_swapped(self) -> int:
+        return len(self.swapped)
+
     def finish(self, request: Request):
         """Completion: reclaim blocks, drop from the running set."""
         self.block_manager.free(request.request_id)
         if request in self.running:
             self.running.remove(request)
 
-    def abort(self, request_id: str) -> bool:
-        """Cancel a request wherever it is; True when found."""
-        for q in (self.running, self.waiting):
+    def abort(self, request_id: str, reason: str = "aborted:user") -> bool:
+        """Cancel a request wherever it is — waiting, running, or
+        swapped (device blocks AND host slots freed); True when found."""
+        for q in (self.running, self.waiting, self.swapped):
             for r in list(q):
                 if r.request_id == request_id:
                     self.block_manager.free(r.request_id)
                     q.remove(r)
-                    r.status = RequestStatus.FINISHED
+                    r.abort(reason)
                     return True
         return False
 
+    def expire_deadlines(self, now: Optional[float] = None
+                         ) -> List[Request]:
+        """TTL sweep: terminate every request whose deadline passed,
+        on every lifecycle queue, freeing its blocks/slots. Returns the
+        expired requests (engine emits their structured outputs)."""
+        now = time.monotonic() if now is None else now
+        out: List[Request] = []
+        for q in (self.running, self.waiting, self.swapped):
+            for r in list(q):
+                if r.expired(now):
+                    self.block_manager.free(r.request_id)
+                    q.remove(r)
+                    r.abort("expired")
+                    out.append(r)
+        return out
+
     # -- preemption ------------------------------------------------------
+    def _evict(self, victim: Request):
+        """Evict ``victim`` from the running set: spill its KV to the
+        host pool when swap is enabled and slots are available (the
+        cached prefix survives, restore is a pure copy), else reset to
+        WAITING for recompute. Either way every device block returns to
+        the free list before this returns."""
+        self.running.remove(victim)
+        self.num_preemptions += 1
+        if (self.swap_mode == "host" and victim.num_cached > 0
+                and self.block_manager.can_swap_out(victim.request_id,
+                                                    victim.num_cached)):
+            dev, host = self.block_manager.swap_out(victim.request_id,
+                                                    victim.num_cached)
+            # copy NOW: the freed device blocks' bytes are intact until
+            # the next compiled step writes them, and nothing dispatches
+            # before schedule() returns
+            self.kv_swapper.copy_out(victim, dev, host)
+            victim.swap_out()
+            self.swapped.append(victim)
+            self.num_swap_outs += 1
+        else:
+            self.block_manager.free(victim.request_id)
+            victim.preempt()
+            self.waiting.appendleft(victim)
+
     def _preempt_one(self, for_request: Request) -> Optional[Request]:
-        """Evict the lowest-priority (latest-arrival) running request to
-        free blocks for ``for_request`` — but never a HIGHER-priority
-        (earlier) one: when ``for_request`` is itself the lowest
-        priority, returns None and the caller self-preempts. The victim
-        goes to the FRONT of the waiting queue so its recompute is not
-        starved behind newer arrivals."""
+        """Evict the lowest-priority running request — largest
+        ``(priority, arrival)`` key — to free blocks for
+        ``for_request``, but never a HIGHER-priority one: when
+        ``for_request`` is itself the lowest priority, returns None and
+        the caller self-preempts. A recompute victim goes to the FRONT
+        of the waiting queue so it is not starved behind newer
+        arrivals; a swapped victim waits in the swap queue."""
         candidates = [r for r in self.running
                       if r is not for_request
-                      and r.arrival_time >= for_request.arrival_time]
+                      and r.sort_key >= for_request.sort_key]
         if not candidates:
             return None
-        victim = max(candidates, key=lambda r: r.arrival_time)
-        self.running.remove(victim)
-        self.block_manager.free(victim.request_id)
-        victim.preempt()
-        self.waiting.appendleft(victim)
-        self.num_preemptions += 1
+        victim = max(candidates, key=lambda r: r.sort_key)
+        self._evict(victim)
         return victim
+
+    def _swap_in_ready(self) -> List[Request]:
+        """Restore swapped requests (most important first) while device
+        blocks allow; they rejoin ``running`` and decode this very
+        iteration if no prefill batch forms."""
+        restored: List[Request] = []
+        for r in sorted(self.swapped, key=lambda r: r.sort_key):
+            if len(self.running) + len(restored) >= self.config.max_num_seqs:
+                break
+            if not self.block_manager.can_swap_in(r.request_id):
+                break  # device blocks free up as others finish
+            host, dev = self.block_manager.swap_in(r.request_id)
+            self.kv_swapper.copy_in(r, host, dev)
+            self.swapped.remove(r)
+            r.swap_in()
+            restored.append(r)
+            self.num_swap_ins += 1
+        self.running.extend(restored)
+        return restored
 
     # -- the per-iteration decision --------------------------------------
     def schedule(self) -> ScheduledBatch:
-        # Phase 1 — admit waiting requests (FCFS) when capacity allows.
-        # A request is admitted only when its FULL uncached prefix fits
-        # the token budget and the free-block supply; admission claims
-        # the blocks immediately so the batch can't oversubscribe.
+        # Phase 0 — TTL sweep, then restore swapped requests while
+        # blocks allow (they already consumed compute; finishing them
+        # frees host AND device memory fastest, and their sort keys
+        # predate anything still waiting).
+        expired = self.expire_deadlines()
+        swapped_in = self._swap_in_ready()
+
+        # Phase 1 — admit waiting requests (priority, then FCFS) when
+        # capacity allows. A request is admitted only when its FULL
+        # uncached prefix fits the token budget and the free-block
+        # supply; admission claims the blocks immediately so the batch
+        # can't oversubscribe. Head-of-line: the first blocked
+        # candidate ends admission, so a starved high-priority request
+        # is never overtaken.
         prefills: List[Request] = []
         batch_max = 0  # longest row admitted -> the padded row width
-        while self.waiting:
-            req = self.waiting[0]
+        # one sort per iteration (timsort is O(n) on the common case —
+        # all-default priorities arrive already FCFS-ordered), and ONE
+        # deque rebuild below instead of an O(n) remove per admit
+        for req in sorted(self.waiting, key=lambda r: r.sort_key):
             need = len(req.tokens_to_run())
             if len(self.running) + len(prefills) >= self.config.max_num_seqs:
                 break
@@ -151,23 +261,27 @@ class Scheduler:
             if not self.block_manager.can_allocate(need):
                 break  # blocks free up as running requests finish
             self.block_manager.allocate(req.request_id, need)
-            self.waiting.popleft()
             req.status = RequestStatus.RUNNING
             prefills.append(req)
             batch_max = max(batch_max, need)
         if prefills:
+            admitted = set(id(r) for r in prefills)
+            self.waiting = deque(r for r in self.waiting
+                                 if id(r) not in admitted)
             self.running.extend(prefills)
-            return ScheduledBatch(kind="prefill", requests=prefills)
+            return ScheduledBatch(kind="prefill", requests=prefills,
+                                  swapped_in=swapped_in, expired=expired)
 
         # Phase 2 — decode: one token for every running request. Each
-        # needs a slot for its new K/V; an OOM on slot growth triggers
-        # preemption of the latest arrival (possibly the request itself,
-        # when it IS the lowest priority).
+        # needs a slot for its new K/V; an OOM on slot growth evicts
+        # the least-important running request (possibly the request
+        # itself, when it IS the least important) — to the host swap
+        # pool when enabled, else back to WAITING for recompute.
         preempted: List[Request] = []
         decodes: List[Request] = []
-        for req in sorted(self.running, key=lambda r: r.arrival_time):
+        for req in sorted(self.running, key=lambda r: r.sort_key):
             if req not in self.running:
-                continue  # evicted while a later arrival was processed
+                continue  # evicted while a less important one ran
             # this step computes K/V for tokens[-1] at position
             # len(tokens)-1, so coverage of len(tokens) slots is exact —
             # +1 would claim each next block one step early (and a
@@ -185,20 +299,18 @@ class Scheduler:
                         break  # nothing left to evict but req itself
                     preempted.append(victim)
                     if victim in decodes:
-                        # an earlier arrival lost its claimed slot too
+                        # a more important request lost its slot too
                         decodes.remove(victim)
             if got_slot:
                 decodes.append(req)
             else:
                 # req could not be saved even after evicting every other
-                # candidate: preempt req itself (vLLM recompute)
-                self.running.remove(req)
-                self.block_manager.free(req.request_id)
-                req.preempt()
-                self.waiting.appendleft(req)
-                self.num_preemptions += 1
+                # candidate: evict req itself
+                self._evict(req)
                 preempted.append(req)
         if decodes:
             return ScheduledBatch(kind="decode", requests=decodes,
-                                  preempted=preempted)
-        return ScheduledBatch(kind="idle", preempted=preempted)
+                                  preempted=preempted,
+                                  swapped_in=swapped_in, expired=expired)
+        return ScheduledBatch(kind="idle", preempted=preempted,
+                              swapped_in=swapped_in, expired=expired)

@@ -23,7 +23,11 @@ Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
 the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
 ``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test
 process knows the point was reached), ``sigterm`` (deliver SIGTERM to
-the current process).
+the current process), ``flag`` (no side effect of its own — the
+production code QUERIES it via :func:`check` and corrupts its own data
+deterministically: the serving engine's NaN-logits and forced-OOM
+points, where the fault must alter behavior rather than kill the
+process).
 """
 from __future__ import annotations
 
@@ -34,8 +38,8 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "FAULT_EXIT", "Fault", "FaultInjector", "fire", "install", "clear",
-    "injected", "active_injector", "tear_file", "child_pids",
+    "FAULT_EXIT", "Fault", "FaultInjector", "fire", "check", "install",
+    "clear", "injected", "active_injector", "tear_file", "child_pids",
     "kill_one_child", "wait_for_path",
 ]
 
@@ -91,16 +95,21 @@ class Fault:
         if self.action == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return
+        if self.action == "flag":
+            return  # queried via check(); no side effect of its own
         raise ValueError(f"unknown fault action {self.action!r}")
 
-    def fire(self):
+    def fire(self) -> bool:
+        """Returns True iff the action was actually performed this hit
+        (past ``@skip``, within ``*times``)."""
         self.hits += 1
         if self.hits <= self.skip:
-            return
+            return False
         if self.times is not None and self.fired >= self.times:
-            return
+            return False
         self.fired += 1
         self._perform()
+        return True
 
 
 class FaultInjector:
@@ -123,6 +132,16 @@ class FaultInjector:
         for f in self._by_point.get(point, ()):
             f.fire()
 
+    def check(self, point: str) -> List[Optional[str]]:
+        """Fire the point and return the ``arg`` of every ``flag`` fault
+        that performed this hit (empty when none did). Non-flag faults
+        installed at the same point fire their actions as usual."""
+        out: List[Optional[str]] = []
+        for f in self._by_point.get(point, ()):
+            if f.fire() and f.action == "flag":
+                out.append(f.arg)
+        return out
+
 
 _active = FaultInjector(os.environ.get(ENV_VAR, ""))
 
@@ -135,6 +154,17 @@ def fire(point: str):
     """Production-side hook: perform any fault installed at ``point``."""
     if _active._by_point:
         _active.fire(point)
+
+
+def check(point: str) -> List[Optional[str]]:
+    """Production-side hook for data-corruption faults: fire ``point``
+    and return the args of the ``flag`` faults that performed, so the
+    caller can deterministically poison its own state (e.g. the serving
+    engine's NaN-logits row, BlockManager's forced OOM). Free when no
+    faults are installed."""
+    if not _active._by_point:
+        return []
+    return _active.check(point)
 
 
 def install(spec: str) -> FaultInjector:

@@ -1,0 +1,80 @@
+"""Serving worker with graceful drain (driven by tests/test_fault_e2e.py).
+
+Boots a tiny-Llama LLMEngine, installs the SIGTERM preemption handler,
+admits ``N_REQUESTS`` mixed-length requests, and serves until done or
+drained. The driving test SIGTERMs this process (directly, or through
+the distributed launcher's fan-out) mid-run and asserts a clean rc-0
+exit with every request accounted for: completed ones with their token
+counts, drained ones with ``finish_reason='aborted:drain'``.
+
+Env protocol:
+  RESULT_FILE    json written on exit: {finished: {rid: reason},
+                 n_tokens: {rid: n}, drained, drain_aborted,
+                 blocks_clean}
+  PROGRESS_FILE  rewritten with the engine step number every step
+  N_REQUESTS     total requests to admit (default 8)
+  MAX_NEW        max_new_tokens per request (default 16)
+  STEP_SLEEP     host sleep per step, widens the SIGTERM window
+                 (default 0.05)
+"""
+import json
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+result_file = os.environ.get("RESULT_FILE")
+progress_file = os.environ.get("PROGRESS_FILE")
+n_requests = int(os.environ.get("N_REQUESTS", "8"))
+max_new = int(os.environ.get("MAX_NEW", "16"))
+step_sleep = float(os.environ.get("STEP_SLEEP", "0.05"))
+
+paddle.seed(0)
+model = LlamaForCausalLM(LlamaConfig.tiny())
+model.eval()
+
+eng = LLMEngine(model, EngineConfig(block_size=4, max_num_seqs=4,
+                                    max_model_len=64))
+eng.install_preemption_handler()
+
+rng = np.random.default_rng(3)
+sp = SamplingParams(max_new_tokens=max_new)
+rids = [eng.add_request(
+    list(map(int, rng.integers(0, model.config.vocab_size,
+                               size=3 + (i % 4)))), sampling=sp)
+    for i in range(n_requests)]
+
+outs = []
+steps = 0
+while eng.has_unfinished():
+    outs.extend(eng.step())
+    steps += 1
+    if progress_file:
+        with open(progress_file, "w") as f:
+            f.write(str(steps))
+    if step_sleep:
+        time.sleep(step_sleep)
+
+final = {o.request_id: o for o in outs if o.finished}
+payload = {
+    "finished": {r: final[r].finish_reason for r in rids if r in final},
+    "n_tokens": {r: len(final[r].generated)
+                 for r in rids if r in final},
+    "drained": eng.drained,
+    "drain_aborted": eng.num_drain_aborted,
+    "blocks_clean":
+        eng.block_manager.num_free_blocks == eng.cfg.num_blocks,
+}
+if result_file:
+    with open(result_file + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(result_file + ".tmp", result_file)
+print("SERVING_WORKER_DONE drained=%s" % payload["drained"], flush=True)

@@ -26,7 +26,7 @@ def test_rule_catalog_has_all_launch_rules():
     names = set(get_rules())
     assert {"host-sync-in-traced", "use-after-donate",
             "trace-time-impurity", "tensor-bool-branch",
-            "counter-provider-leak"} <= names
+            "counter-provider-leak", "block-until-ready-in-loop"} <= names
     for r in get_rules().values():
         assert r.summary and r.doc  # per-rule docs are part of the API
 
@@ -556,6 +556,82 @@ class TestCounterLeak:
 
             def detach(name):
                 unregister_counter_provider(name)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# block-until-ready-in-loop
+# ---------------------------------------------------------------------------
+class TestBlockUntilReadyLoop:
+    def test_function_spelling_in_for_loop(self):
+        fs = run("""
+            import jax
+
+            def train(data, step, state):
+                for batch in data:
+                    state = step(state, batch)
+                    jax.block_until_ready(state)
+                return state
+        """)
+        assert rules_of(fs) == ["block-until-ready-in-loop"]
+        assert "EVERY iteration" in fs[0].message
+
+    def test_method_spelling_in_while_loop(self):
+        fs = run("""
+            def drain(q):
+                while q:
+                    out = q.pop()
+                    out.block_until_ready()
+        """)
+        assert rules_of(fs) == ["block-until-ready-in-loop"]
+
+    def test_comprehension_counts_as_loop(self):
+        fs = run("""
+            import jax
+
+            def collect(outs):
+                return [jax.block_until_ready(o) for o in outs]
+        """)
+        assert rules_of(fs) == ["block-until-ready-in-loop"]
+
+    def test_near_miss_sync_after_loop_clean(self):
+        # the fix pattern itself: one sync on the final value
+        fs = run("""
+            import jax
+
+            def train(data, step, state):
+                for batch in data:
+                    state = step(state, batch)
+                jax.block_until_ready(state)
+                return state
+        """)
+        assert fs == []
+
+    def test_near_miss_def_inside_loop_clean(self):
+        # a function DEFINED under a loop is not executed per
+        # iteration; flagging it would poison every closure factory
+        fs = run("""
+            import jax
+
+            def make_waiters(arrays):
+                waiters = []
+                for a in arrays:
+                    def wait(a=a):
+                        jax.block_until_ready(a)
+                    waiters.append(wait)
+                return waiters
+        """)
+        assert fs == []
+
+    def test_suppression_with_reason_honored(self):
+        fs = run("""
+            import jax
+
+            def probe_loop(q):
+                while True:
+                    arrays = q.get()
+                    jax.block_until_ready(arrays)  # tpulint: disable=block-until-ready-in-loop (prober parks on purpose)
         """)
         assert fs == []
 

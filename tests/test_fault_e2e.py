@@ -24,6 +24,7 @@ from paddle_tpu.testing import faults
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPTS = os.path.join(os.path.dirname(__file__), "mp_scripts")
 WORKER = os.path.join(SCRIPTS, "ckpt_train_worker.py")
+SERVING_WORKER = os.path.join(SCRIPTS, "serving_worker.py")
 
 pytestmark = pytest.mark.slow
 
@@ -153,3 +154,75 @@ def test_launcher_forwards_sigterm_for_final_save(tmp_path):
     result = json.load(open(tmp_path / "result.json"))
     mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
     assert mgr.latest_step() == result["preempted_at"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serving drain (ISSUE 6) — the subprocess/launcher versions of the
+# tier-1 in-process pin in test_serving_resilience.py
+# ---------------------------------------------------------------------------
+def _assert_drained_result(tmp_path, n_requests, max_new=16):
+    result = json.load(open(tmp_path / "result.json"))
+    assert result["drained"] is True
+    assert result["blocks_clean"] is True
+    reasons = result["finished"]
+    assert len(reasons) == n_requests          # nobody vanished
+    completed = [r for r, why in reasons.items() if why == "length"]
+    drained = [r for r, why in reasons.items()
+               if why == "aborted:drain"]
+    assert sorted(completed + drained) == sorted(reasons)
+    assert drained, "SIGTERM landed too late to abort anything"
+    assert completed, "SIGTERM landed before anything could finish"
+    assert result["drain_aborted"] == len(drained)
+    # running requests ran to completion; drained ones never started
+    # (they were waiting — the engine aborts queued work immediately)
+    for r in completed:
+        assert result["n_tokens"][r] == max_new
+    for r in drained:
+        assert result["n_tokens"][r] == 0
+    return result
+
+
+def test_serving_worker_sigterm_drains_gracefully(tmp_path):
+    """SIGTERM straight to the serving process: the engine drains —
+    running requests finish, waiting ones abort structured — and the
+    process exits 0 on its own."""
+    env = _env(tmp_path, N_REQUESTS=8, MAX_NEW=16, STEP_SLEEP="0.05")
+    p = subprocess.Popen([sys.executable, SERVING_WORKER], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        assert faults.wait_for_path(str(tmp_path / "progress"),
+                                    timeout=240)
+        time.sleep(0.4)                      # a few decode steps pass
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+    finally:
+        p.kill()
+    assert p.returncode == 0, out            # clean exit — the pin
+    assert "SERVING_WORKER_DONE drained=True" in out
+    _assert_drained_result(tmp_path, 8)
+
+
+def test_launcher_forwards_sigterm_to_serving_worker(tmp_path):
+    """The launcher is the process the cloud signals: its SIGTERM
+    fan-out must reach the serving worker, whose drain then produces
+    the same clean rc-0 exit with no gang restart."""
+    env = _env(tmp_path, N_REQUESTS=8, MAX_NEW=16, STEP_SLEEP="0.05")
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restart", "3",
+         "--stop_timeout", "60", SERVING_WORKER],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        assert faults.wait_for_path(str(tmp_path / "progress"),
+                                    timeout=240)
+        time.sleep(0.4)
+        launcher.send_signal(signal.SIGTERM)
+        out, _ = launcher.communicate(timeout=120)
+    finally:
+        launcher.kill()
+    assert launcher.returncode == 0, out     # no restart, clean stop
+    assert "forwarding to workers" in out
+    assert "SERVING_WORKER_DONE drained=True" in out
+    _assert_drained_result(tmp_path, 8)

@@ -247,3 +247,251 @@ def test_request_and_sampling_validation():
                                          eos_token_id=42))
     assert r2.append_token(41) is False
     assert r2.append_token(42) is True    # EOS
+
+# ---------------------------------------------------------------------------
+# host swap pool + abort-leak invariants (ISSUE 6)
+# ---------------------------------------------------------------------------
+class _StubSwapper:
+    """Model-free KV mover: records traffic, moves no bytes."""
+
+    def __init__(self):
+        self.out_calls = []
+        self.in_calls = []
+
+    def copy_out(self, request, dev_table, host_table):
+        self.out_calls.append((request.request_id, list(dev_table),
+                               list(host_table)))
+
+    def copy_in(self, request, host_table, dev_table):
+        self.in_calls.append((request.request_id, list(host_table),
+                              list(dev_table)))
+
+
+def test_block_manager_swap_accounting():
+    bm = BlockManager(num_blocks=4, block_size=2, num_host_blocks=3)
+    bm.allocate("a", 5)                      # 3 device blocks
+    assert bm.can_swap_out("a", 5)
+    dev, host = bm.swap_out("a", 5)
+    assert len(dev) == 3 and len(host) == 3
+    assert bm.num_free_blocks == 4           # device side fully back
+    assert bm.num_free_host_blocks == 0
+    assert not bm.has_table("a") and bm.has_host_table("a")
+    bm.check_invariants()
+    # restore: host slots come back, device blocks claimed again
+    host2, dev2 = bm.swap_in("a")
+    assert host2 == host and len(dev2) == 3
+    assert bm.num_free_host_blocks == 3
+    assert bm.num_free_blocks == 1
+    bm.check_invariants()
+    assert bm.free("a") == 3
+    bm.check_invariants()
+
+
+def test_block_manager_swap_rejects_when_pool_small():
+    bm = BlockManager(num_blocks=8, block_size=2, num_host_blocks=1)
+    bm.allocate("a", 6)                      # needs 3 host slots
+    assert not bm.can_swap_out("a", 6)
+    with pytest.raises(NoFreeBlocksError, match="swap out"):
+        bm.swap_out("a", 6)
+    # no-pool manager never swaps
+    bm0 = BlockManager(num_blocks=4, block_size=2)
+    bm0.allocate("a", 2)
+    assert not bm0.can_swap_out("a", 2)
+
+
+def test_block_manager_free_releases_host_slots_too():
+    """The abort-while-swapped leak class: free() must drop BOTH
+    sides, and is idempotent."""
+    bm = BlockManager(num_blocks=4, block_size=2, num_host_blocks=4)
+    bm.allocate("a", 4)
+    bm.swap_out("a", 4)
+    assert bm.num_free_host_blocks == 2
+    assert bm.free("a") == 0                 # no device blocks held
+    assert bm.num_free_host_blocks == 4      # host slots reclaimed
+    assert bm.free("a") == 0
+    bm.check_invariants()
+
+
+def test_scheduler_swap_preempts_and_restores():
+    """Eviction with a host pool spills instead of recomputing: the
+    victim keeps num_cached, rejoins running via swap-in when blocks
+    free, and the swapper sees matching out/in traffic."""
+    bm = BlockManager(num_blocks=4, block_size=2, num_host_blocks=4)
+    sw = _StubSwapper()
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4), swap_mode="host",
+                  kv_swapper=sw)
+    a = _req("a", n_prompt=4, max_new=8, arrival=1.0)
+    b = _req("b", n_prompt=4, max_new=8, arrival=2.0)
+    for r in (a, b):
+        s.add(r)
+    s.schedule()                             # both prefill, cache full
+    for r in (a, b):
+        r.num_cached += len(r.tokens_to_run())
+        r.append_token(7)
+    batch = s.schedule()                     # OOM -> b swaps out
+    assert [r.request_id for r in batch.requests] == ["a"]
+    assert [r.request_id for r in batch.preempted] == ["b"]
+    assert b.status == RequestStatus.SWAPPED
+    assert b.num_cached == 4                 # cached prefix KEPT
+    assert b.num_swaps == 1 and s.num_swap_outs == 1
+    assert len(sw.out_calls) == 1
+    bm.check_invariants()
+    # finish a -> blocks free -> b swaps back in and decodes
+    a.num_cached += 1
+    while not a.append_token(7):
+        pass
+    s.finish(a)
+    batch = s.schedule()
+    assert [r.request_id for r in batch.swapped_in] == ["b"]
+    assert batch.kind == "decode"
+    assert [r.request_id for r in batch.requests] == ["b"]
+    assert b.status == RequestStatus.RUNNING
+    assert s.num_swap_ins == 1 and len(sw.in_calls) == 1
+    # the restored device table covers the cached prefix
+    assert len(bm.block_table("b")) >= 2
+    bm.check_invariants()
+
+
+def test_scheduler_host_pool_exhaustion_falls_back_to_recompute():
+    """A full host pool must not deadlock eviction: the victim falls
+    back to the recompute path (WAITING, num_cached reset)."""
+    bm = BlockManager(num_blocks=4, block_size=2, num_host_blocks=1)
+    sw = _StubSwapper()
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4), swap_mode="host",
+                  kv_swapper=sw)
+    a = _req("a", n_prompt=4, max_new=8, arrival=1.0)
+    b = _req("b", n_prompt=4, max_new=8, arrival=2.0)
+    for r in (a, b):
+        s.add(r)
+    s.schedule()
+    for r in (a, b):
+        r.num_cached += len(r.tokens_to_run())
+        r.append_token(7)
+    batch = s.schedule()                     # b evicted; pool too small
+    assert [r.request_id for r in batch.preempted] == ["b"]
+    assert b.status == RequestStatus.WAITING
+    assert b.num_cached == 0 and s.num_swap_outs == 0
+    assert sw.out_calls == []
+    bm.check_invariants()
+
+
+def test_scheduler_priority_orders_admission_and_eviction():
+    """priority < 0 beats FCFS: a late VIP admits first and is never
+    the eviction victim while a lower-priority peer remains."""
+    bm = BlockManager(num_blocks=4, block_size=2)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=2))
+    lo = Request(request_id="lo", prompt_ids=[1, 2, 3, 4],
+                 sampling=SamplingParams(max_new_tokens=8))
+    lo.arrival_time = 1.0
+    vip = Request(request_id="vip", prompt_ids=[1, 2, 3, 4],
+                  sampling=SamplingParams(max_new_tokens=8, priority=-1))
+    vip.arrival_time = 2.0                   # later, but outranks
+    s.add(lo), s.add(vip)
+    batch = s.schedule()
+    assert [r.request_id for r in batch.requests] == ["vip", "lo"]
+    for r in batch.requests:
+        r.num_cached += len(r.tokens_to_run())
+        r.append_token(7)
+    batch = s.schedule()                     # OOM: LO is the victim
+    assert [r.request_id for r in batch.requests] == ["vip"]
+    assert [r.request_id for r in batch.preempted] == ["lo"]
+    assert lo.status == RequestStatus.WAITING
+    bm.check_invariants()
+
+
+def test_scheduler_expire_deadlines_every_queue():
+    import time as _time
+
+    bm = BlockManager(num_blocks=8, block_size=2, num_host_blocks=8)
+    sw = _StubSwapper()
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=2), swap_mode="host",
+                  kv_swapper=sw)
+    mk = lambda rid: Request(  # noqa: E731
+        request_id=rid, prompt_ids=[1, 2, 3],
+        sampling=SamplingParams(max_new_tokens=4, deadline_ms=1e-3))
+    r_wait, r_run, r_swap = mk("w"), mk("r"), mk("s")
+    # place one per queue, bypassing schedule for direct control
+    s.waiting.append(r_wait)
+    bm.allocate("r", 3)
+    r_run.status = RequestStatus.RUNNING
+    s.running.append(r_run)
+    bm.allocate("s", 3)
+    r_swap.num_cached = 3
+    bm.swap_out("s", 3)
+    r_swap.status = RequestStatus.SWAPPED
+    s.swapped.append(r_swap)
+    _time.sleep(0.002)
+    expired = s.expire_deadlines()
+    assert sorted(r.request_id for r in expired) == ["r", "s", "w"]
+    assert all(r.finish_reason == "expired" for r in expired)
+    assert not s.has_unfinished()
+    assert bm.num_free_blocks == 8 and bm.num_free_host_blocks == 8
+    bm.check_invariants()
+
+
+def test_randomized_abort_interleaving_never_leaks_blocks():
+    """Satellite-1 acceptance: after ANY interleaving of admission,
+    decode, preemption (swap AND recompute), expiry, and abort —
+    across every lifecycle state — both free lists return to full.
+    400 iterations of a seeded random storm, invariants checked every
+    step."""
+    rng = np.random.default_rng(7)
+    bm = BlockManager(num_blocks=10, block_size=2, num_host_blocks=4)
+    sw = _StubSwapper()
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=3,
+                                      max_batched_tokens=32),
+                  swap_mode="host", kv_swapper=sw)
+    reqs = []
+    n_aborted = 0
+    for it in range(400):
+        if len(reqs) < 24 and rng.random() < 0.25:
+            r = Request(
+                request_id=f"r{len(reqs)}",
+                prompt_ids=list(range(1, int(rng.integers(2, 9)))),
+                sampling=SamplingParams(
+                    max_new_tokens=int(rng.integers(1, 6)),
+                    priority=int(rng.integers(-1, 2)),
+                    # a slice of requests carries a TTL that will
+                    # expire mid-storm
+                    deadline_ms=(float(rng.integers(1, 20))
+                                 if rng.random() < 0.3 else None)))
+            r.arrival_time = float(it)
+            reqs.append(r)
+            s.add(r)
+        # random abort of a random LIVE request, in ANY state
+        # (waiting / running / swapped alike)
+        if rng.random() < 0.15:
+            live = [r for r in reqs if not r.is_finished]
+            if live:
+                victim = live[int(rng.integers(0, len(live)))]
+                assert s.abort(victim.request_id)
+                n_aborted += 1
+        if not s.has_unfinished():
+            continue
+        batch = s.schedule()
+        for r in batch.requests:
+            r.num_cached += len(r.tokens_to_run())
+            if r.append_token(int(rng.integers(0, 100))):
+                s.finish(r)
+        bm.check_invariants()
+    # drain the stragglers (aborting a random half on the way out)
+    guard = 0
+    while s.has_unfinished():
+        guard += 1
+        assert guard < 300, "storm failed to converge"
+        live = [r for r in reqs if not r.is_finished]
+        if live and rng.random() < 0.3:
+            s.abort(live[0].request_id)
+            n_aborted += 1
+        batch = s.schedule()
+        for r in batch.requests:
+            r.num_cached += len(r.tokens_to_run())
+            if r.append_token(int(rng.integers(0, 100))):
+                s.finish(r)
+        bm.check_invariants()
+    assert len(reqs) == 24 and all(r.is_finished for r in reqs)
+    assert n_aborted > 0, "storm never exercised abort"
+    # the satellite's pin: NOTHING leaks, device or host side
+    assert bm.num_free_blocks == bm.num_blocks
+    assert bm.num_free_host_blocks == bm.num_host_blocks
+    bm.check_invariants()

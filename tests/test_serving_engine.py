@@ -275,3 +275,12 @@ def test_bench_serving_smoke():
                 "kv_block_utilization", "preemptions"):
         assert key in ex
     assert ex["batch_occupancy"] > 0
+    # the ISSUE-6 resilience counters ride the JSON, with real traffic
+    # from the swap+drain smoke phase
+    for key in ("serving_swapped_out", "serving_rejected",
+                "serving_expired", "serving_drain_completed"):
+        assert key in ex
+    smoke = ex["resilience_smoke"]
+    assert smoke["serving_swapped_out"] > 0
+    assert smoke["serving_swapped_in"] == smoke["serving_swapped_out"]
+    assert smoke["serving_drain_completed"] == 1

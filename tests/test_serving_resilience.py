@@ -1,0 +1,480 @@
+"""Serving resilience layer e2e on XLA:CPU (ISSUE 6 acceptance pins).
+
+Fast, tier-1, all failure modes injected deterministically through
+``PADDLE_FAULTS``-style installs:
+
+* SIGTERM during an 8-request mixed prefill/decode run drains
+  gracefully — running requests complete with CORRECT tokens, waiting
+  requests return ``aborted:drain``, the loop exits clean;
+* swap-based preemption (``swap_mode='host'``) is token-identical to
+  recompute preemption under both genuine and forced OOM;
+* per-request deadlines expire wherever the request is; admission
+  control rejects as a first-class output;
+* a NaN-poisoned request aborts ALONE while its batch peers finish
+  with parity; transient step failures retry; exhausted retries and
+  hung steps fail the engine WITH structured outputs (drain
+  semantics, no request just vanishes).
+
+The slow subprocess/launcher versions live in test_fault_e2e.py; the
+model-free allocator/scheduler invariants in test_serving.py.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.watchdog import PreemptionMonitor
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineConfig, EngineStepError, LLMEngine, SamplingParams,
+    StepHungError,
+)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()          # 4 heads / 2 KV heads: GQA path
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.clear()
+
+
+def _naive(model, prompt, max_new):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int32))
+    out = model.generate(ids, max_new_tokens=max_new, use_cache=False)
+    return [int(t) for t in out.numpy()[0][len(prompt):]]
+
+
+def _prompts(rng, vocab, lens):
+    return [list(map(int, rng.integers(0, vocab, size=n))) for n in lens]
+
+
+def _serve(eng, collect=None, max_steps=500):
+    outs = []
+    steps = 0
+    while eng.has_unfinished():
+        outs.extend(eng.step())
+        eng.block_manager.check_invariants()
+        steps += 1
+        assert steps < max_steps, "engine failed to converge"
+        if collect is not None:
+            collect(eng, steps)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (SIGTERM mid-run) — the tier-1 acceptance pin
+# ---------------------------------------------------------------------------
+def test_sigterm_mid_run_drains_gracefully(tiny_model):
+    """8 requests, 4 running + 4 waiting, SIGTERM injected mid-decode:
+    the running half completes with naive-parity tokens, the waiting
+    half returns structured ``aborted:drain`` outputs, every KV block
+    returns to the free list, and the loop exits on its own."""
+    m = tiny_model
+    rng = np.random.default_rng(10)
+    prompts = _prompts(rng, m.config.vocab_size,
+                       [3, 5, 7, 4, 6, 2, 5, 3])
+    max_new = 6
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=4,
+                                    max_model_len=64))
+    monitor = PreemptionMonitor()
+    eng.install_preemption_handler(monitor)
+    try:
+        # a REAL SIGTERM, delivered by the fault point mid-run (after
+        # the prefill and two decode steps — mixed-phase, batch hot)
+        faults.install("serving.step:sigterm@2*1")
+        sp = SamplingParams(max_new_tokens=max_new)
+        rids = [eng.add_request(p, sampling=sp) for p in prompts]
+        outs = _serve(eng)
+    finally:
+        monitor.uninstall()
+
+    final = {o.request_id: o for o in outs if o.finished}
+    assert set(final) == set(rids)            # nobody vanished
+    drained = [r for r in rids
+               if final[r].finish_reason == "aborted:drain"]
+    completed = [r for r in rids if final[r].finish_reason == "length"]
+    assert sorted(drained + completed) == sorted(rids)
+    # only 4 sequences fit the engine; the rest had not started and
+    # must be the drained ones, with zero tokens
+    assert len(completed) == 4 and len(drained) == 4
+    assert all(final[r].token is None and final[r].generated == []
+               for r in drained)
+    # the running half produced CORRECT tokens, not just any tokens
+    for rid, p in zip(rids, prompts):
+        if rid in completed:
+            assert eng.get_request(rid).generated == \
+                _naive(m, p, max_new), rid
+    assert eng.drained and eng.is_draining   # drain latched + finished
+    assert eng.num_drains_started == 1
+    assert eng.num_drain_aborted == 4
+    assert eng.num_drains_completed == 1
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+    # a draining engine admits nothing: structured rejection, not error
+    late = eng.add_request(prompts[0], sampling=sp)
+    assert eng.get_request(late).finish_reason == "rejected"
+    assert eng.num_rejected == 1
+    pend = eng.step()                        # pending flushed exactly once
+    assert [o.finish_reason for o in pend] == ["rejected"]
+    assert eng.step() == []
+
+
+def test_drain_api_grace_budget_aborts_stragglers(tiny_model):
+    """A zero-grace drain can't wait for the running batch: everything
+    still in flight aborts with ``aborted:drain`` — with its partial
+    progress in the output — and the engine reports drained."""
+    m = tiny_model
+    rng = np.random.default_rng(11)
+    prompts = _prompts(rng, m.config.vocab_size, [4, 6])
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64))
+    sp = SamplingParams(max_new_tokens=8)
+    rids = [eng.add_request(p, sampling=sp) for p in prompts]
+    for _ in range(3):            # prefill + 2 decodes
+        eng.step()
+    outs = eng.drain(grace_s=0.0)
+    final = {o.request_id: o for o in outs if o.finished}
+    assert set(final) == set(rids)
+    for rid in rids:
+        assert final[rid].finish_reason == "aborted:drain"
+        # progress preserved: prefill + 2 decode tokens
+        assert len(final[rid].generated) == 3
+        assert eng.get_request(rid).is_finished
+    assert eng.drained
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# deadlines + admission control
+# ---------------------------------------------------------------------------
+def test_deadline_expires_waiting_and_running(tiny_model):
+    """TTL enforcement at iteration boundaries: a queued request whose
+    deadline passed expires before ever running; a RUNNING request
+    expires mid-decode keeping its partial progress; an undeadlined
+    peer in the same batch is untouched and exact."""
+    m = tiny_model
+    rng = np.random.default_rng(12)
+    p_run, p_wait, p_free = _prompts(rng, m.config.vocab_size, [5, 4, 6])
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=4,
+                                    max_model_len=64))
+    max_new = 8
+    # expires mid-run: long enough for prefill + a few decode steps
+    r_run = eng.add_request(p_run, sampling=SamplingParams(
+        max_new_tokens=max_new, deadline_ms=250))
+    # expires before it ever runs
+    r_wait = eng.add_request(p_wait, sampling=SamplingParams(
+        max_new_tokens=max_new, deadline_ms=20))
+    r_free = eng.add_request(p_free, sampling=SamplingParams(
+        max_new_tokens=max_new))
+    time.sleep(0.03)              # r_wait's TTL passes pre-first-step
+
+    def stall(eng_, steps):
+        if steps == 3:
+            time.sleep(0.3)       # r_run's TTL passes mid-decode
+
+    outs = _serve(eng, collect=stall)
+    final = {o.request_id: o for o in outs if o.finished}
+    assert final[r_wait].finish_reason == "expired"
+    assert final[r_wait].generated == []
+    assert final[r_run].finish_reason == "expired"
+    assert 0 < len(final[r_run].generated) < max_new  # partial progress
+    assert final[r_free].finish_reason == "length"
+    assert eng.get_request(r_free).generated == \
+        _naive(m, p_free, max_new)
+    assert eng.num_expired == 2
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+
+
+def test_admission_rejects_on_queue_depth(tiny_model):
+    """Backpressure: past ``max_queue_depth`` waiting requests, new
+    arrivals get first-class 'rejected' outputs (callback included) and
+    never touch the scheduler; admitted ones are unaffected."""
+    m = tiny_model
+    rng = np.random.default_rng(13)
+    prompts = _prompts(rng, m.config.vocab_size, [4, 5, 3, 6, 4])
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=1,
+                                    max_model_len=64, max_queue_depth=2))
+    events = []
+    sp = SamplingParams(max_new_tokens=4)
+    rids = [eng.add_request(
+        p, sampling=sp,
+        callback=lambda r, tok, done: events.append((r, tok, done)))
+        for p in prompts]
+    # depth check at add time (no step ran between adds, so nothing
+    # left the queue): r0 queues at depth 0, r1 at depth 1, r2/r3/r4
+    # each see depth 2 >= max_queue_depth -> rejected
+    rejected = [r for r in rids
+                if eng.get_request(r).finish_reason == "rejected"]
+    assert rejected == rids[2:]
+    assert eng.num_rejected == 3
+    assert [e for e in events if e[1] is None] == \
+        [(r, None, True) for r in rejected]   # terminal callbacks fired
+    outs = _serve(eng)
+    final = {o.request_id: o for o in outs if o.finished}
+    assert set(final) == set(rids)            # rejections flushed too
+    for rid, p in zip(rids[:2], prompts[:2]):
+        assert final[rid].finish_reason == "length"
+        assert eng.get_request(rid).generated == _naive(m, p, 4)
+    # rejected requests are FINISHED and releasable like any other
+    assert eng.release_request(rids[4]).finish_reason == "rejected"
+
+
+def test_admission_rejects_on_ttft_slo(tiny_model):
+    """SLO-aware admission: once step-time history exists, an arrival
+    whose estimated TTFT exceeds the SLO is rejected; a cold engine
+    abstains (no history -> no guess-based rejects)."""
+    m = tiny_model
+    rng = np.random.default_rng(14)
+    p = _prompts(rng, m.config.vocab_size, [4])[0]
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64,
+                                    ttft_slo_ms=1e-3))
+    sp = SamplingParams(max_new_tokens=3)
+    # cold engine: the estimator abstains, the request is admitted
+    first = eng.add_request(p, sampling=sp)
+    assert eng.get_request(first).finish_reason is None
+    _serve(eng)
+    assert eng.get_request(first).finish_reason == "length"
+    # warm engine: any real step time exceeds a 1 microsecond SLO
+    second = eng.add_request(p, sampling=sp)
+    assert eng.get_request(second).finish_reason == "rejected"
+    verdict = eng.admission.verdict(eng)
+    assert verdict is not None and "SLO" in verdict
+
+
+# ---------------------------------------------------------------------------
+# swap-based preemption
+# ---------------------------------------------------------------------------
+def test_swap_preemption_token_parity_with_recompute(tiny_model):
+    """The acceptance pin: under genuine forced OOM (cache too small
+    for the batch), swap_mode='host' must preempt via host spill and
+    produce TOKEN-IDENTICAL outputs to the recompute path — which is
+    itself pinned against the naive generate."""
+    m = tiny_model
+    rng = np.random.default_rng(15)
+    prompts = _prompts(rng, m.config.vocab_size, [6, 8, 5, 7])
+    max_new = 8
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    def run(mode):
+        eng = LLMEngine(m, EngineConfig(
+            block_size=4, num_blocks=10, max_num_seqs=4,
+            max_model_len=32, swap_mode=mode))
+        rids = [eng.add_request(p, sampling=sp) for p in prompts]
+        _serve(eng)
+        return eng, [eng.get_request(r).generated for r in rids]
+
+    eng_r, toks_r = run("recompute")
+    eng_h, toks_h = run("host")
+    assert eng_r.scheduler.num_preemptions > 0, "config must force OOM"
+    assert eng_h.scheduler.num_swap_outs > 0
+    assert eng_h.scheduler.num_swap_ins == eng_h.scheduler.num_swap_outs
+    assert toks_h == toks_r
+    assert toks_r == [_naive(m, p, max_new) for p in prompts]
+    for eng in (eng_r, eng_h):
+        assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+    assert eng_h.block_manager.num_free_host_blocks == \
+        eng_h.cfg.num_host_blocks
+    snap = eng_h.metrics.snapshot()
+    assert snap["serving_swapped_out"] == eng_h.scheduler.num_swap_outs
+    assert snap["serving_swapped_in"] == eng_h.scheduler.num_swap_ins
+
+
+def test_forced_oom_injection_targets_a_request(tiny_model):
+    """The ``serving.force_oom`` flag fault makes a ROOMY cache OOM on
+    a chosen victim's slot growth: deterministic swap-preemption
+    coverage without tuning cache sizes; parity still holds."""
+    m = tiny_model
+    rng = np.random.default_rng(16)
+    prompts = _prompts(rng, m.config.vocab_size, [5, 4, 6])
+    max_new = 6
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=4,
+                                    max_model_len=64, swap_mode="host"))
+    sp = SamplingParams(max_new_tokens=max_new)
+    rids = [eng.add_request(p, sampling=sp) for p in prompts]
+    # victim = the SECOND request, on its first two block growths
+    faults.install(f"serving.force_oom.{rids[1]}:flag*2")
+    outs = _serve(eng)
+    faults.clear()
+    assert eng.scheduler.num_preemptions > 0
+    victim = eng.get_request(rids[1])
+    assert victim.num_swaps > 0 or victim.num_preemptions > 0
+    final = {o.request_id: o for o in outs if o.finished}
+    for rid, p in zip(rids, prompts):
+        assert final[rid].finish_reason == "length"
+        assert eng.get_request(rid).generated == _naive(m, p, max_new)
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+    assert eng.block_manager.num_free_host_blocks == \
+        eng.cfg.num_host_blocks
+
+
+# ---------------------------------------------------------------------------
+# step-level fault isolation
+# ---------------------------------------------------------------------------
+def test_nan_poisoned_request_aborts_alone(tiny_model):
+    """The acceptance pin: a NaN-poisoned row aborts with
+    'aborted:nonfinite' and its KV blocks free, while the REST of the
+    batch completes with exact naive parity."""
+    m = tiny_model
+    rng = np.random.default_rng(17)
+    prompts = _prompts(rng, m.config.vocab_size, [5, 4, 6])
+    max_new = 6
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=4,
+                                    max_model_len=64))
+    sp = SamplingParams(max_new_tokens=max_new)
+    rids = [eng.add_request(p, sampling=sp) for p in prompts]
+    # poison row 1 (the middle request) on the second decode step
+    faults.install("serving.nan_logits:flag:1@2*1")
+    outs = _serve(eng)
+    faults.clear()
+    final = {o.request_id: o for o in outs if o.finished}
+    assert final[rids[1]].finish_reason == "aborted:nonfinite"
+    assert 0 < len(final[rids[1]].generated) < max_new
+    assert eng.num_poisoned_aborts == 1
+    for rid, p in zip(rids, prompts):
+        if rid != rids[1]:
+            assert final[rid].finish_reason == "length"
+            assert eng.get_request(rid).generated == \
+                _naive(m, p, max_new), rid
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+
+
+def test_nan_guard_covers_sampled_decode_path(tiny_model):
+    """The guard must also work where the B×vocab logits ARE fetched
+    (temperature>0): poisoned row aborts, sampled peer finishes."""
+    m = tiny_model
+    rng = np.random.default_rng(18)
+    pg, ps = _prompts(rng, m.config.vocab_size, [5, 5])
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64))
+    rg = eng.add_request(pg, sampling=SamplingParams(max_new_tokens=4))
+    rs = eng.add_request(ps, sampling=SamplingParams(
+        max_new_tokens=4, temperature=0.8, seed=7))
+    faults.install("serving.nan_logits:flag:0@1*1")
+    outs = _serve(eng)
+    faults.clear()
+    final = {o.request_id: o for o in outs if o.finished}
+    assert final[rg].finish_reason == "aborted:nonfinite"
+    assert final[rs].finish_reason == "length"
+    assert len(final[rs].generated) == 4
+    assert eng.num_poisoned_aborts == 1
+    assert eng.num_logits_fetches > 0     # the sampled path was taken
+
+
+def test_transient_step_failure_retries_and_recovers(tiny_model):
+    """Two injected step failures, three retries budgeted: the run
+    completes with exact tokens and reports step_retries == 2."""
+    m = tiny_model
+    rng = np.random.default_rng(19)
+    p = _prompts(rng, m.config.vocab_size, [5])[0]
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64, max_step_retries=3,
+                                    step_retry_backoff_s=0.01))
+    faults.install("serving.step:raise*2")
+    out = eng.generate([p], SamplingParams(max_new_tokens=6))
+    faults.clear()
+    assert eng.num_step_retries == 2
+    assert out[0] == _naive(m, p, 6)
+    assert eng.metrics.snapshot()["serving_step_retries"] == 2
+
+
+def test_exhausted_retries_abort_with_structured_outputs(tiny_model):
+    """Past the retry budget the engine fails CLOSED: EngineStepError
+    carries one 'aborted:error' output per live request (running AND
+    waiting), the scheduler is empty, every block reclaimed."""
+    m = tiny_model
+    rng = np.random.default_rng(20)
+    prompts = _prompts(rng, m.config.vocab_size, [5, 4, 6, 5])
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64, max_step_retries=1,
+                                    step_retry_backoff_s=0.01,
+                                    max_queue_depth=3))
+    sp = SamplingParams(max_new_tokens=4)
+    rids = [eng.add_request(p, sampling=sp) for p in prompts]
+    # the 4th add is REJECTED (depth 3 >= 3): its pending output must
+    # ride the exception too, not vanish with the failed step
+    assert eng.get_request(rids[3]).finish_reason == "rejected"
+    faults.install("serving.step:raise")
+    with pytest.raises(EngineStepError, match="retry budget") as ei:
+        eng.step()
+    faults.clear()
+    assert sorted(o.request_id for o in ei.value.outputs) == sorted(rids)
+    reasons = {o.request_id: o.finish_reason for o in ei.value.outputs}
+    assert reasons.pop(rids[3]) == "rejected"
+    assert set(reasons.values()) == {"aborted:error"}
+    # nothing vanished: 3 structured aborts, engine empty, blocks back
+    assert not eng.has_unfinished()
+    assert eng.num_step_retries == 1
+    assert all(eng.get_request(r).finish_reason == "aborted:error"
+               for r in rids[:3])
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+    eng.block_manager.check_invariants()
+    # fail-closed: a fatally-failed engine admits nothing more (with
+    # donated caches the next dispatch would read invalidated buffers)
+    post = eng.add_request(prompts[0], sampling=sp)
+    assert eng.get_request(post).finish_reason == "rejected"
+    pend = eng.step()                 # flushes the rejection, no dispatch
+    assert [o.finish_reason for o in pend] == ["rejected"]
+    assert eng.step() == []
+
+
+def test_hung_step_watchdog_fails_engine_with_drain_semantics(tiny_model):
+    """A step that blows through the watchdog deadline (injected slow
+    dispatch on a WARM shape) surfaces as StepHungError once it
+    completes, with every request aborted as structured output."""
+    m = tiny_model
+    rng = np.random.default_rng(21)
+    p = _prompts(rng, m.config.vocab_size, [5])[0]
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64,
+                                    step_timeout_s=0.1))
+    rid = eng.add_request(p, sampling=SamplingParams(max_new_tokens=6))
+    # skip prefill and the first decode (both COLD shapes, which get
+    # the compile allowance); the third step is warm with a 0.1s
+    # deadline and sleeps 0.5s
+    faults.install("serving.step:sleep:0.5@2*1")
+    with pytest.raises(StepHungError, match="watchdog deadline") as ei:
+        _serve(eng)
+    faults.clear()
+    assert [o.finish_reason for o in ei.value.outputs] == \
+        ["aborted:error"]
+    assert eng.get_request(rid).is_finished
+    assert not eng.has_unfinished()
+    assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+def test_resilience_counters_via_profiler(tiny_model):
+    """The new serving/* gauges ride the PR-3 counter-provider
+    machinery like every other serving metric."""
+    from paddle_tpu import profiler
+
+    m = tiny_model
+    rng = np.random.default_rng(22)
+    p = _prompts(rng, m.config.vocab_size, [4])[0]
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=64, swap_mode="host",
+                                    max_queue_depth=0))
+    # max_queue_depth=0 rejects EVERYTHING: cheap counter traffic
+    rid = eng.add_request(p, sampling=SamplingParams(max_new_tokens=2))
+    assert eng.get_request(rid).finish_reason == "rejected"
+    c = profiler.counters()
+    for gauge, want in (("rejected", 1), ("swapped_out", 0),
+                        ("swapped_in", 0), ("expired", 0),
+                        ("poisoned_aborts", 0), ("step_retries", 0),
+                        ("drain_started", 0), ("drain_completed", 0)):
+        assert c[f"serving/{gauge}#{id(eng)}"] == want, gauge
+    snap = eng.metrics.snapshot()
+    assert snap["serving_rejected"] == 1
+    assert snap["kv_host_blocks_total"] == eng.cfg.num_host_blocks
