@@ -16,6 +16,16 @@ and ships a buffer to host. Two placements are flagged:
   driver code, the exact shape of the serving engine's per-step
   B×vocab logits pull. These are sometimes legitimate (a scalar loss, a
   B-sized token vector) — suppress with a reason when they are.
+
+The dispatch-result placement tracks results ACROSS methods of a class:
+``self._last = self._jstep(...)`` (directly, or via a local name still
+carrying the dispatch result) marks ``self._last`` dispatch-carrying
+class-wide, so ``np.asarray(self._last)`` in a different method is
+flagged too. An attribute REASSIGNED from anything non-dispatch
+anywhere in the class is conservatively cleared (method execution order
+is unknowable statically), and plain ``self._last = None``
+initializers don't clear — they are the standard ``__init__`` idiom
+next to a real bind.
 """
 from __future__ import annotations
 
@@ -133,6 +143,98 @@ def _arg_root_name(node: ast.AST):
     return None
 
 
+def _self_attr_root(node: ast.AST):
+    """For ``self.x``, ``self.x[i]``, ``self.x.y`` shapes: the attribute
+    read directly off ``self`` (``x``), else None."""
+    last = None
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            last = node
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Name) and node.id == "self" and last is not None:
+        return last.attr
+    return None
+
+
+def _methods(cdef: ast.ClassDef):
+    for node in cdef.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _class_attr_events(module, cdef: ast.ClassDef):
+    """Across all direct methods of ``cdef``: ``self`` attributes bound
+    to a compiled-dispatch result (directly, or via a local name whose
+    dispatch bind is live at the assignment) -> {attr: (method, line)},
+    and attributes killed by any other reassignment. ``self.x = None``
+    is neither — it's the ``__init__`` placeholder idiom, not a value
+    that clears the bind in whichever order methods actually run."""
+    binds, kills = {}, {}
+
+    def record(attr, value, lineno, meth, local_binds, local_kills):
+        if isinstance(value, ast.Constant) and value.value is None:
+            return
+        is_dispatch = (
+            isinstance(value, ast.Call)
+            and module.jit_bindings.lookup(value.func) is not None)
+        if not is_dispatch and isinstance(value, ast.Name):
+            is_dispatch = _live_bind_line(
+                local_binds, local_kills, value.id, lineno) is not None
+        if is_dispatch:
+            binds.setdefault(attr, (meth.name, lineno))
+        else:
+            kills.setdefault(attr, (meth.name, lineno))
+
+    for meth in _methods(cdef):
+        local_binds, local_kills = _dispatch_result_events(module, meth)
+        for node in walk_own(meth):
+            if isinstance(node, ast.Assign):
+                pairs = []
+                tgt = node.targets[0] if len(node.targets) == 1 else None
+                if isinstance(tgt, ast.Tuple) \
+                        and isinstance(node.value, ast.Tuple) \
+                        and len(tgt.elts) == len(node.value.elts):
+                    # `self.a, self.b = ka, vb` — track elementwise
+                    pairs = list(zip(tgt.elts, node.value.elts))
+                else:
+                    for t in node.targets:
+                        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                            else [t]
+                        pairs.extend((e, node.value) for e in elts)
+                for t, value in pairs:
+                    if isinstance(t, ast.Starred):
+                        t = t.value
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        record(t.attr, value, node.lineno, meth,
+                               local_binds, local_kills)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                t = node.target
+                value = getattr(node, "value", None)
+                if value is not None and isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    if isinstance(node, ast.AugAssign):
+                        kills.setdefault(t.attr, (meth.name, node.lineno))
+                    else:
+                        record(t.attr, value, node.lineno, meth,
+                               local_binds, local_kills)
+            elif isinstance(node, ast.For):
+                t = node.target
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Attribute) \
+                            and isinstance(e.value, ast.Name) \
+                            and e.value.id == "self":
+                        kills.setdefault(e.attr, (meth.name, node.lineno))
+    return binds, kills
+
+
 @register(
     "host-sync-in-traced",
     "device->host copy inside a traced function or on a dispatch result",
@@ -190,4 +292,41 @@ def check(module) -> List[Finding]:
                     f"per-step device->host copy (the PR-2 copy_frac "
                     f"bug class); keep it on device or fold the "
                     f"consumer into the compiled step"))
+    # placement 2b: dispatch results parked on self attributes and
+    # fetched from a DIFFERENT method (`self._last = self._jstep(...)`
+    # in step(), `np.asarray(self._last)` in result()). Method call
+    # order is unknowable statically, so an attribute reassigned from
+    # anything non-dispatch anywhere in the class clears the bind.
+    for cdef in ast.walk(module.tree):
+        if not isinstance(cdef, ast.ClassDef):
+            continue
+        attr_binds, attr_kills = _class_attr_events(module, cdef)
+        live = {a: b for a, b in attr_binds.items() if a not in attr_kills}
+        if not live:
+            continue
+        for meth in _methods(cdef):
+            for node in walk_own(meth):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                kind = _sync_kind(module, node)
+                if kind is None:
+                    continue
+                if kind.startswith("."):
+                    target = node.func.value
+                elif node.args:
+                    target = node.args[0]
+                else:
+                    continue
+                attr = _self_attr_root(target)
+                if attr is None or attr not in live:
+                    continue
+                bind_meth, bind_line = live[attr]
+                seen.add(id(node))
+                out.append(module.finding(
+                    "host-sync-in-traced", node,
+                    f"{kind} fetches 'self.{attr}', which carries the "
+                    f"compiled-dispatch result bound in "
+                    f"{bind_meth}() at line {bind_line} — a cross-method "
+                    f"per-step device->host copy; keep it on device or "
+                    f"fold the consumer into the compiled step"))
     return out

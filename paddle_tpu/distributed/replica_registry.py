@@ -1,0 +1,135 @@
+"""Store-backed serving-replica registry with heartbeat liveness.
+
+The fleet router (``paddle_tpu.serving.fleet``) needs a health view of
+its replicas that keeps working when replicas move out of process: the
+same shape the elastic launcher already uses for worker liveness — a
+key per member, refreshed on a heartbeat cadence, considered dead once
+its record goes stale. This module packages that pattern over any
+store-shaped object (:class:`~paddle_tpu.distributed.store.Store`,
+``FileStore``, ``TCPStore``, or the in-memory default), so an
+in-process fleet and a future process-per-replica fleet share one
+liveness protocol.
+
+Key layout (``/`` flattens to ``__`` in ``list()`` on every store
+implementation, which is why replica ids may not contain either)::
+
+    <prefix>/hb/<replica_id>   -> JSON {"ts": wall-clock, "load": {...}}
+
+``alive()`` is a read-side filter, not a lease: a stale record is
+simply ignored, and a replica that resumes heartbeating after a pause
+reappears — the router decides what a disappearance means (it treats
+one as replica death and re-enqueues that replica's requests).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ReplicaRegistry", "MemStore"]
+
+
+class MemStore:
+    """Dict-backed store with the Store/FileStore surface the registry
+    uses (set/try_get/delete/list) — the single-process default, so an
+    in-process fleet needs no filesystem or coordination service."""
+
+    def __init__(self):
+        self._d: Dict[str, bytes] = {}
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._d[key] = value
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._d.get(key)
+
+    def delete(self, key: str) -> None:
+        self._d.pop(key, None)
+
+    def list(self, prefix: str = "") -> List[str]:
+        # FileStore/TCPStore parity: '/' flattens to '__' in listings
+        pat = prefix.replace("/", "__")
+        return [k.replace("/", "__") for k in self._d
+                if k.replace("/", "__").startswith(pat)]
+
+
+class ReplicaRegistry:
+    """Membership + liveness for one fleet of serving replicas.
+
+    ``ttl_s`` bounds staleness: a replica missing ``ttl_s`` of
+    heartbeats is excluded from :meth:`alive` (and :meth:`is_alive`
+    returns False) until it heartbeats again. ``now`` parameters exist
+    so tests can drive the clock instead of sleeping."""
+
+    def __init__(self, store=None, prefix: str = "serving_fleet",
+                 ttl_s: float = 5.0):
+        if ttl_s <= 0:
+            raise ValueError("ttl_s must be > 0")
+        self.store = store if store is not None else MemStore()
+        self.prefix = prefix
+        self.ttl_s = ttl_s
+
+    def _key(self, replica_id: str) -> str:
+        if "/" in replica_id or "__" in replica_id:
+            raise ValueError(
+                f"replica id {replica_id!r} may not contain '/' or '__' "
+                f"(store listings flatten '/' to '__')")
+        return f"{self.prefix}/hb/{replica_id}"
+
+    # -- write side (each replica, or the router on its behalf) ---------
+    def register(self, replica_id: str, meta: Optional[dict] = None,
+                 now: Optional[float] = None) -> None:
+        self.heartbeat(replica_id, load=None, meta=meta, now=now)
+
+    def heartbeat(self, replica_id: str, load: Optional[dict] = None,
+                  meta: Optional[dict] = None,
+                  now: Optional[float] = None) -> None:
+        rec = {"ts": time.time() if now is None else now}
+        if meta:
+            rec["meta"] = meta
+        if load:
+            rec["load"] = load
+        self.store.set(self._key(replica_id), json.dumps(rec))
+
+    def deregister(self, replica_id: str) -> None:
+        self.store.delete(self._key(replica_id))
+
+    # -- read side (the router's health view) ----------------------------
+    def record(self, replica_id: str) -> Optional[dict]:
+        raw = self.store.try_get(self._key(replica_id))
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode() if isinstance(raw, bytes)
+                              else raw)
+        except (ValueError, UnicodeDecodeError):
+            return None  # torn/garbage record reads as absent
+
+    def members(self) -> List[str]:
+        flat = f"{self.prefix}/hb/".replace("/", "__")
+        out = []
+        for name in self.store.list(f"{self.prefix}/hb/"):
+            if name.startswith(flat):
+                out.append(name[len(flat):])
+        return sorted(out)
+
+    def alive(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """replica_id -> last heartbeat record, for every member whose
+        record is within ``ttl_s``."""
+        now = time.time() if now is None else now
+        out: Dict[str, dict] = {}
+        for rid in self.members():
+            rec = self.record(rid)
+            if rec is not None and now - rec.get("ts", 0.0) <= self.ttl_s:
+                out[rid] = rec
+        return out
+
+    def is_alive(self, replica_id: str,
+                 now: Optional[float] = None) -> bool:
+        rec = self.record(replica_id)
+        if rec is None:
+            return False
+        now = time.time() if now is None else now
+        return now - rec.get("ts", 0.0) <= self.ttl_s

@@ -18,6 +18,9 @@ role (AnalysisPredictor + the fastdeploy serving layer) TPU-natively:
                        rejection is a structured output
 :class:`ServingMetrics` queue/KV/latency + resilience gauges through
                        ``profiler.register_counter_provider``
+``fleet``              multi-replica router: SLO-aware dispatch, tenant
+                       fairness, drain hand-off, elastic scaling
+                       (``paddle_tpu.serving.fleet``)
 =================  ====================================================
 
 Every terminal path names a ``finish_reason`` (see
@@ -54,9 +57,10 @@ from paddle_tpu.serving.request import (  # noqa: F401
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     ScheduledBatch, Scheduler, SchedulerConfig,
 )
+from paddle_tpu.serving import fleet  # noqa: F401
 
 __all__ = ["BlockManager", "NoFreeBlocksError", "AdmissionController",
            "EngineConfig", "EngineStepError", "StepHungError",
            "LLMEngine", "ServingMetrics", "FINISH_REASONS", "Request",
            "RequestOutput", "RequestStatus", "SamplingParams",
-           "ScheduledBatch", "Scheduler", "SchedulerConfig"]
+           "ScheduledBatch", "Scheduler", "SchedulerConfig", "fleet"]

@@ -166,35 +166,45 @@ class AdmissionController:
     * queue depth — waiting requests already exceed ``max_queue_depth``
       (raw backpressure: the caller should shed load or route to
       another replica);
-    * estimated TTFT — a new arrival's first token is predicted at
-      ``(queue_depth + 1) * avg_recent_step_time`` (each queued request
-      ahead needs about one engine iteration before this one prefills);
-      when that estimate exceeds ``ttft_slo_ms``, admitting the request
-      only manufactures an SLO miss, so it is rejected while there is
-      still time to retry elsewhere. With no step history yet (cold
-      engine) the estimate abstains and admission falls through to the
-      depth check alone.
+    * estimated TTFT — a new arrival's first token is predicted from
+      the queue depth (each queued request ahead needs about one engine
+      iteration before this one prefills) PLUS the prefill tokens those
+      peers and this prompt itself queue up, scaled by the engine's
+      per-iteration token budget — so a burst of long prompts can't
+      sneak past the gate at a shallow queue depth. When that estimate
+      exceeds ``ttft_slo_ms``, admitting the request only manufactures
+      an SLO miss, so it is rejected while there is still time to retry
+      elsewhere. With no step history yet (cold engine) the estimate
+      abstains and admission falls through to the depth check alone.
 
     Rejection is a verdict string (human-readable reason), never an
     exception — the engine turns it into a first-class
-    ``finish_reason='rejected'`` output."""
+    ``finish_reason='rejected'`` output. The fleet router consults the
+    same verdict per replica (passing the prompt length) and rejects
+    fleet-wide only when EVERY replica's verdict rejects."""
 
     def __init__(self, max_queue_depth: Optional[int] = None,
                  ttft_slo_ms: Optional[float] = None):
         self.max_queue_depth = max_queue_depth
         self.ttft_slo_ms = ttft_slo_ms
 
-    def verdict(self, engine: "LLMEngine") -> Optional[str]:
+    def verdict(self, engine: "LLMEngine",
+                prompt_tokens: int = 0) -> Optional[str]:
         depth = engine.scheduler.num_waiting
         if self.max_queue_depth is not None \
                 and depth >= self.max_queue_depth:
             return (f"queue depth {depth} >= max_queue_depth "
                     f"{self.max_queue_depth}")
         if self.ttft_slo_ms is not None:
-            est = engine.metrics.estimated_ttft_ms(depth)
+            est = engine.metrics.estimated_ttft_ms(
+                depth,
+                queued_prefill_tokens=engine.scheduler.num_waiting_tokens,
+                prompt_tokens=prompt_tokens,
+                tokens_per_step=engine.cfg.max_batched_tokens)
             if est is not None and est > self.ttft_slo_ms:
                 return (f"estimated TTFT {est:.1f}ms exceeds SLO "
-                        f"{self.ttft_slo_ms}ms at queue depth {depth}")
+                        f"{self.ttft_slo_ms}ms at queue depth {depth} "
+                        f"({prompt_tokens}-token prompt)")
         return None
 
 
@@ -355,6 +365,9 @@ class LLMEngine:
         self.num_drains_started = 0
         self.num_drain_aborted = 0
         self.num_drains_completed = 0
+        # per-terminal-reason histogram: every request that reaches a
+        # terminal state lands in exactly one bucket (serving/finish/*)
+        self.finish_counts: Dict[str, int] = {}
         self._draining = False
         self._drain_reason: Optional[str] = None
         self._drain_deadline: Optional[float] = None
@@ -379,11 +392,17 @@ class LLMEngine:
     # -- request lifecycle ----------------------------------------------
     def add_request(self, request_id, prompt_ids: Sequence[int] = None,
                     sampling: Optional[SamplingParams] = None,
-                    callback: Optional[Callable] = None) -> str:
+                    callback: Optional[Callable] = None, *,
+                    rng_state=None) -> str:
         """Admit a request into the waiting queue. ``request_id`` may be
         omitted by passing the prompt first — ``add_request(prompt_ids)``
         or ``add_request(prompt_ids, SamplingParams(...))``. Returns the
-        request id."""
+        request id.
+
+        ``rng_state`` (a ``np.random.Generator`` bit-generator state
+        dict) resumes the request's sampling stream mid-way — the fleet
+        router's drain hand-off passes the donor replica's stream state
+        so a re-enqueued sampled request continues token-identically."""
         if isinstance(prompt_ids, SamplingParams):
             if sampling is not None:
                 raise TypeError("sampling passed twice")
@@ -410,6 +429,8 @@ class LLMEngine:
                 f"it could never be served even alone")
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
+        if rng_state is not None:
+            req._rng.bit_generator.state = rng_state
         self._requests[request_id] = req
         # admission control: a draining engine admits nothing; a live
         # one consults the controller. Rejection is a first-class
@@ -417,7 +438,8 @@ class LLMEngine:
         # exception — the request never reaches the scheduler, stays
         # queryable, and streams its terminal event like any other.
         verdict = ("engine is draining" if self._draining
-                   else self.admission.verdict(self))
+                   else self.admission.verdict(
+                       self, prompt_tokens=len(prompt_ids)))
         if verdict is not None:
             req.abort("rejected")
             self.num_rejected += 1
@@ -427,7 +449,15 @@ class LLMEngine:
         return request_id
 
     def abort_request(self, request_id: str) -> bool:
-        return self.scheduler.abort(request_id, "aborted:user")
+        found = self.scheduler.abort(request_id, "aborted:user")
+        if found:
+            self._count_finish("aborted:user")
+        return found
+
+    def _count_finish(self, reason: Optional[str]):
+        if reason is not None:
+            self.finish_counts[reason] = \
+                self.finish_counts.get(reason, 0) + 1
 
     # -- graceful drain --------------------------------------------------
     def install_preemption_handler(self, monitor=None):
@@ -507,6 +537,7 @@ class LLMEngine:
     def _terminal_output(self, req: Request) -> RequestOutput:
         """Structured tokenless emission for an aborted/expired/rejected
         request; streams through its callback like a sampled token."""
+        self._count_finish(req.finish_reason)
         out = RequestOutput(request_id=req.request_id, token=None,
                             finished=True, generated=list(req.generated),
                             finish_reason=req.finish_reason)
@@ -657,6 +688,7 @@ class LLMEngine:
             if finished:
                 self.scheduler.finish(r)
                 self.metrics.record_finish(r)
+                self._count_finish(r.finish_reason)
             out = RequestOutput(request_id=r.request_id, token=token,
                                 finished=finished,
                                 generated=list(r.generated),

@@ -1,0 +1,39 @@
+"""paddle_tpu.serving.fleet — multi-replica serving router.
+
+The layer above :class:`~paddle_tpu.serving.LLMEngine`: a
+:class:`FleetRouter` owns a set of replica handles and provides
+SLO-aware dispatch, fleet-wide admission, per-tenant fairness
+(weighted deficit round robin), transparent drain hand-off, and
+elastic scaling hooks (:class:`FleetController`). See the README
+"Fleet serving" section for the architecture.
+
+Quick start::
+
+    from paddle_tpu.serving import EngineConfig, SamplingParams
+    from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
+
+    router = FleetRouter([
+        InProcessReplica(model, EngineConfig(), replica_id=f"r{i}")
+        for i in range(2)])
+    router.add_request(prompt_ids, SamplingParams(
+        max_new_tokens=64, tenant_id="team-a"))
+    while router.has_unfinished():
+        for out in router.step():
+            ...  # replica drains/deaths are invisible here
+"""
+from paddle_tpu.serving.fleet.controller import (  # noqa: F401
+    AutoscalePolicy, FleetController, LoadThresholdPolicy,
+)
+from paddle_tpu.serving.fleet.metrics import FleetMetrics  # noqa: F401
+from paddle_tpu.serving.fleet.replica import (  # noqa: F401
+    InProcessReplica, ReplicaHandle, ReplicaLoad,
+)
+from paddle_tpu.serving.fleet.router import (  # noqa: F401
+    FleetConfig, FleetRouter, HANDOFF_REASONS,
+)
+from paddle_tpu.serving.fleet.tenant import TenantQueue  # noqa: F401
+
+__all__ = ["AutoscalePolicy", "FleetController", "LoadThresholdPolicy",
+           "FleetMetrics", "InProcessReplica", "ReplicaHandle",
+           "ReplicaLoad", "FleetConfig", "FleetRouter",
+           "HANDOFF_REASONS", "TenantQueue"]

@@ -1,0 +1,108 @@
+"""Elastic scaling hooks: FleetController.scale_to + autoscale policy.
+
+Scaling DOWN runs through the drain machinery — the victim replica
+drains gracefully and its in-flight requests hand off to peers before
+it detaches — so elasticity reuses the exact resilience path that
+SIGTERM preemption exercises. Scaling UP calls a user-supplied
+``replica_factory`` (build an engine, return a handle); actual TPU
+topology acquisition is out of scope, which is why the factory is a
+hook and not an implementation.
+
+Autoscale is a pluggable policy object consulted on :meth:`tick`;
+its decisions are surfaced as counters
+(``fleet/{scale_ups,scale_downs,autoscale_decisions}``) whether or not
+they change the target, so a dashboard can watch the policy think.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from paddle_tpu.serving.fleet.replica import ReplicaHandle
+from paddle_tpu.serving.fleet.router import FleetRouter
+
+__all__ = ["AutoscalePolicy", "LoadThresholdPolicy", "FleetController"]
+
+
+class AutoscalePolicy:
+    """Decide a replica-count target from the router's load signal.
+    Return the desired dispatchable-replica count, or None for "no
+    change"."""
+
+    def decide(self, load: float, replicas_live: int,
+               queued: int) -> Optional[int]:
+        raise NotImplementedError
+
+
+class LoadThresholdPolicy(AutoscalePolicy):
+    """Hysteresis band: scale up one replica when fleet load exceeds
+    ``high`` (or requests are queued with nothing dispatchable), down
+    one when it falls below ``low``; hold inside the band."""
+
+    def __init__(self, high: float = 0.8, low: float = 0.2,
+                 min_replicas: int = 1, max_replicas: int = 8):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError("need 0 <= low < high <= 1")
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        self.high = high
+        self.low = low
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+
+    def decide(self, load: float, replicas_live: int,
+               queued: int) -> Optional[int]:
+        if ((load > self.high or (queued > 0 and replicas_live == 0))
+                and replicas_live < self.max_replicas):
+            return replicas_live + 1
+        if load < self.low and replicas_live > self.min_replicas:
+            return replicas_live - 1
+        return None
+
+
+class FleetController:
+    """Owns the replica count. ``replica_factory(index)`` must return a
+    fresh :class:`ReplicaHandle` with a unique ``replica_id``."""
+
+    def __init__(self, router: FleetRouter,
+                 replica_factory: Callable[[int], ReplicaHandle],
+                 policy: Optional[AutoscalePolicy] = None):
+        self.router = router
+        self.replica_factory = replica_factory
+        self.policy = policy
+        self._spawned = len(router.replicas)
+
+    def scale_to(self, n: int, reason: str = "manual") -> None:
+        """Move the DISPATCHABLE replica count to ``n``: spin up fresh
+        replicas, or drain the least-loaded ones down through the
+        hand-off path. Draining victims keep stepping until empty (the
+        router reaps them), so scale-down is lossless."""
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        while len(self.router.dispatchable()) < n:
+            handle = self.replica_factory(self._spawned)
+            self._spawned += 1
+            self.router.attach_replica(handle)
+            self.router.num_scale_ups += 1
+        extra = len(self.router.dispatchable()) - n
+        if extra > 0:
+            victims = sorted(self.router.dispatchable(),
+                             key=lambda h: (h.load().occupancy,
+                                            h.replica_id))[:extra]
+            for h in victims:
+                self.router.retire_replica(h, reason=f"{reason}")
+                self.router.num_scale_downs += 1
+
+    def tick(self) -> Optional[int]:
+        """Consult the autoscale policy once; apply and return its
+        target if it wants a change. Call on the serving loop's cadence
+        (every N router steps, or a timer)."""
+        if self.policy is None:
+            return None
+        live = len(self.router.dispatchable())
+        target = self.policy.decide(self.router.load(), live,
+                                    len(self.router._queue))
+        self.router.num_autoscale_decisions += 1
+        if target is not None and target != live:
+            self.scale_to(target, reason="autoscale")
+            return target
+        return None

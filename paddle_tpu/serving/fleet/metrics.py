@@ -1,0 +1,118 @@
+"""Fleet-level observability: router counters + aggregated snapshot.
+
+Mirrors :class:`~paddle_tpu.serving.metrics.ServingMetrics` one level
+up: every gauge registers a ``fleet/<name>#<id>`` profiler counter
+provider (weakref'd — a dropped router unregisters itself), and
+:meth:`FleetMetrics.snapshot` returns the one dict
+``bench.py --serving --replicas N`` emits as BENCH_serving JSON.
+
+The ``fleet_finish`` histogram is the CLIENT-visible aggregate (one
+bucket per request, from the router's bookkeeping); the nested
+per-replica snapshots keep the engine-side ``serving_finish/*`` view,
+which intentionally double-counts handed-off attempts (each donor
+engine recorded an ``aborted:drain`` the client never saw).
+"""
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Dict, List
+
+__all__ = ["FleetMetrics"]
+
+def _mean(xs: List[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+class FleetMetrics:
+    """Owned by one :class:`~paddle_tpu.serving.fleet.FleetRouter`."""
+
+    GAUGES = ("dispatched", "handoffs", "rejected_fleetwide",
+              "replicas_live", "tenant_waiting", "replicas_dead",
+              "scale_ups", "scale_downs", "autoscale_decisions",
+              "tokens_emitted")
+
+    _ROUTER_GAUGES = {
+        "dispatched": lambda r: r.num_dispatched,
+        "handoffs": lambda r: r.num_handoffs,
+        "rejected_fleetwide": lambda r: r.num_rejected_fleetwide,
+        "replicas_live": lambda r: len(r.dispatchable()),
+        "tenant_waiting": lambda r: len(r._queue),
+        "replicas_dead": lambda r: r.num_replicas_dead,
+        "scale_ups": lambda r: r.num_scale_ups,
+        "scale_downs": lambda r: r.num_scale_downs,
+        "autoscale_decisions": lambda r: r.num_autoscale_decisions,
+        "tokens_emitted": lambda r: r.num_tokens_emitted,
+    }
+
+    def __init__(self, router):
+        self._router = weakref.ref(router)
+        self._registered: List[str] = []
+        self._register(router)
+
+    def snapshot(self) -> Dict:
+        r = self._router()
+        if r is None:
+            return {}
+        dt = time.monotonic() - r.start_time
+        out = {f"fleet_{name}": int(get(r))
+               for name, get in self._ROUTER_GAUGES.items()}
+        out["fleet_replicas_total"] = len(r.replicas)
+        out["fleet_tokens_per_sec"] = round(
+            r.num_tokens_emitted / dt if dt > 0 else 0.0, 2)
+        out["fleet_load"] = round(r.load(), 4)
+        out["fleet_finish"] = dict(sorted(r.finish_counts.items()))
+        tenants = {}
+        waiting = r._queue.waiting_by_tenant()
+        for t in sorted(set(waiting) | set(r.tenant_wait_s)):
+            waits = r.tenant_wait_s.get(t, [])
+            tenants[t] = {
+                "waiting": waiting.get(t, 0),
+                "dispatched": len(waits),
+                "wait_ms_avg": round(_mean(waits) * 1e3, 3),
+                "wait_ms_max": round(max(waits) * 1e3, 3) if waits
+                else 0.0,
+            }
+        out["fleet_tenants"] = tenants
+        replicas = {}
+        for h in r.replicas:
+            rec = {"alive": bool(h.alive),
+                   "draining": bool(h.is_draining),
+                   "retiring": bool(h.retiring)}
+            snap = getattr(h, "snapshot", None)
+            if callable(snap):
+                try:
+                    rec.update(snap())
+                except Exception:
+                    pass  # a dead handle's snapshot is best-effort
+            replicas[h.replica_id] = rec
+        out["replicas"] = replicas
+        return out
+
+    # -- profiler counter providers --------------------------------------
+    def _register(self, router):
+        from paddle_tpu import profiler
+
+        ref = weakref.ref(router)
+
+        def provider(name):
+            def get():
+                r = ref()
+                if r is None:
+                    return None  # counters() drops dead providers
+                return FleetMetrics._ROUTER_GAUGES[name](r)
+            return get
+
+        for g in self.GAUGES:
+            cname = f"fleet/{g}#{id(router)}"
+            profiler.register_counter_provider(cname, provider(g))
+            self._registered.append(cname)
+        weakref.finalize(router, _unregister_all,
+                         list(self._registered))
+
+
+def _unregister_all(names):
+    from paddle_tpu import profiler
+
+    for n in names:
+        profiler.unregister_counter_provider(n)

@@ -1,0 +1,208 @@
+"""Replica handles — the router's transport seam.
+
+The :class:`FleetRouter` never touches an :class:`LLMEngine` directly;
+it speaks the :class:`ReplicaHandle` verb set, which is deliberately
+small and serializable-shaped (ids, token lists, plain dicts) so a
+process-per-replica backend can implement the same verbs over an RPC
+channel later without changing the router. :class:`InProcessReplica`
+is the first backend: one engine per handle, same process.
+
+Seam notes for a future remote backend:
+
+* ``rng_state``/``add_request(rng_state=...)`` carry a numpy
+  bit-generator state dict across the hand-off — a remote replica
+  would ship it in the drain notification instead of being queried
+  post-mortem;
+* ``step()`` returning structured :class:`RequestOutput`\\ s (including
+  drain/error aborts) is the only result channel — there is no
+  callback registration across the seam;
+* engine step failures are absorbed here (``alive`` flips False, the
+  structured abort outputs are RETURNED, not raised) because a dead
+  remote replica can't raise into the router either.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from paddle_tpu.serving.engine import EngineConfig, EngineStepError, LLMEngine
+from paddle_tpu.serving.request import RequestOutput, SamplingParams
+
+__all__ = ["ReplicaHandle", "ReplicaLoad", "InProcessReplica"]
+
+
+class ReplicaLoad:
+    """One replica's dispatch signals, snapshotted at a step boundary."""
+
+    def __init__(self, queue_depth: int = 0, num_running: int = 0,
+                 waiting_tokens: int = 0, kv_utilization: float = 0.0):
+        self.queue_depth = queue_depth
+        self.num_running = num_running
+        self.waiting_tokens = waiting_tokens
+        self.kv_utilization = kv_utilization
+
+    @property
+    def occupancy(self) -> int:
+        """Least-loaded tiebreak key: requests on the replica."""
+        return self.queue_depth + self.num_running
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"queue_depth": self.queue_depth,
+                "num_running": self.num_running,
+                "waiting_tokens": self.waiting_tokens,
+                "kv_utilization": round(self.kv_utilization, 4)}
+
+
+class ReplicaHandle:
+    """The verbs the router needs from a replica. Implementations must
+    keep every argument/return JSON-shaped (plus the numpy RNG state
+    dict) so the set can move onto a wire protocol unchanged."""
+
+    replica_id: str
+    alive: bool
+    retiring: bool  # scale-down: drain, then detach once empty
+
+    # -- dispatch-side reads ---------------------------------------------
+    def admission_verdict(self, prompt_tokens: int) -> Optional[str]:
+        raise NotImplementedError
+
+    def estimated_ttft_ms(self, prompt_tokens: int) -> Optional[float]:
+        raise NotImplementedError
+
+    def load(self) -> ReplicaLoad:
+        raise NotImplementedError
+
+    @property
+    def is_draining(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def drained(self) -> bool:
+        raise NotImplementedError
+
+    def has_unfinished(self) -> bool:
+        raise NotImplementedError
+
+    # -- request lifecycle -----------------------------------------------
+    def add_request(self, request_id: str, prompt_ids: Sequence[int],
+                    sampling: SamplingParams, *,
+                    rng_state=None) -> None:
+        raise NotImplementedError
+
+    def abort_request(self, request_id: str) -> bool:
+        raise NotImplementedError
+
+    def release_request(self, request_id: str) -> None:
+        raise NotImplementedError
+
+    def rng_state(self, request_id: str):
+        """Best-effort sampling-stream state for a hand-off; None when
+        unavailable (request unknown, or the replica is unreachable)."""
+        raise NotImplementedError
+
+    # -- stepping / drain -------------------------------------------------
+    def step(self) -> List[RequestOutput]:
+        raise NotImplementedError
+
+    def start_drain(self, reason: str = "manual") -> List[RequestOutput]:
+        raise NotImplementedError
+
+
+class InProcessReplica(ReplicaHandle):
+    """One :class:`LLMEngine` behind the handle seam, same process.
+
+    Pass ``monitor`` (a
+    :class:`~paddle_tpu.distributed.watchdog.PreemptionMonitor`) to give
+    THIS replica its own preemption signal — fleet tests drain one
+    replica of a pair by calling ``monitor.request()``; a real
+    deployment shares the process-global monitor across co-resident
+    replicas (SIGTERM preempts the host, not one engine)."""
+
+    def __init__(self, model, config: Optional[EngineConfig] = None,
+                 replica_id: Optional[str] = None, monitor=None):
+        self.replica_id = replica_id or f"replica-{id(self):x}"
+        self.engine = LLMEngine(model, config)
+        self.alive = True
+        self.retiring = False
+        self.created_at = time.monotonic()
+        if monitor is not None:
+            self.engine.install_preemption_handler(monitor)
+
+    # -- dispatch-side reads ---------------------------------------------
+    def admission_verdict(self, prompt_tokens: int) -> Optional[str]:
+        if not self.alive:
+            return "replica is dead"
+        if self.engine.is_draining:
+            return "replica is draining"
+        return self.engine.admission.verdict(
+            self.engine, prompt_tokens=prompt_tokens)
+
+    def estimated_ttft_ms(self, prompt_tokens: int) -> Optional[float]:
+        eng = self.engine
+        return eng.metrics.estimated_ttft_ms(
+            eng.scheduler.num_waiting,
+            queued_prefill_tokens=eng.scheduler.num_waiting_tokens,
+            prompt_tokens=prompt_tokens,
+            tokens_per_step=eng.cfg.max_batched_tokens)
+
+    def load(self) -> ReplicaLoad:
+        sched = self.engine.scheduler
+        return ReplicaLoad(
+            queue_depth=sched.num_waiting,
+            num_running=sched.num_running + sched.num_swapped,
+            waiting_tokens=sched.num_waiting_tokens,
+            kv_utilization=self.engine.block_manager.utilization())
+
+    @property
+    def is_draining(self) -> bool:
+        return self.engine.is_draining
+
+    @property
+    def drained(self) -> bool:
+        return self.engine.drained
+
+    def has_unfinished(self) -> bool:
+        return self.alive and self.engine.has_unfinished()
+
+    # -- request lifecycle -----------------------------------------------
+    def add_request(self, request_id: str, prompt_ids: Sequence[int],
+                    sampling: SamplingParams, *, rng_state=None) -> None:
+        self.engine.add_request(request_id, list(prompt_ids),
+                                sampling=sampling, rng_state=rng_state)
+
+    def abort_request(self, request_id: str) -> bool:
+        return self.engine.abort_request(request_id)
+
+    def release_request(self, request_id: str) -> None:
+        try:
+            self.engine.release_request(request_id)
+        except (KeyError, ValueError):
+            pass  # already released, or still in flight on a dead engine
+
+    def rng_state(self, request_id: str):
+        try:
+            return self.engine.get_request(
+                request_id)._rng.bit_generator.state
+        except KeyError:
+            return None
+
+    # -- stepping / drain -------------------------------------------------
+    def step(self) -> List[RequestOutput]:
+        if not self.alive:
+            return []
+        try:
+            return self.engine.step()
+        except EngineStepError as e:
+            # the engine already drained itself and attached structured
+            # aborts; across the seam a dead replica returns its last
+            # outputs rather than raising into the router
+            self.alive = False
+            return e.outputs
+
+    def start_drain(self, reason: str = "manual") -> List[RequestOutput]:
+        if not self.alive:
+            return []
+        return self.engine.start_drain(reason)
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.engine.metrics.snapshot()

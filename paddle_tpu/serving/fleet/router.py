@@ -1,0 +1,530 @@
+"""FleetRouter — SLO-aware multi-replica dispatch with drain hand-off.
+
+The front end above :class:`~paddle_tpu.serving.LLMEngine`: clients
+talk to the router, the router owns a set of replica handles and
+
+* **dispatches** each request to the replica with the best estimated
+  TTFT (the per-engine :class:`AdmissionController` estimator, prompt-
+  length-aware), falling back to least-loaded while estimates are cold;
+* **admits fleet-wide**: a request is rejected only when EVERY
+  dispatchable replica's admission verdict rejects it — one overloaded
+  replica sheds to its peers instead of to the client;
+* **is fair across tenants**: requests queue per ``tenant_id`` and
+  dispatch in weighted deficit-round-robin order (:class:`TenantQueue`),
+  so one tenant's burst cannot starve the others;
+* **hands off on drain/death**: when a replica drains (SIGTERM /
+  preemption via the PR-6 machinery) or dies mid-step, its unfinished
+  requests re-enqueue on a peer and resume by recompute —
+  token-identical to an uninterrupted run (the sampling-stream state
+  rides along) — and the client never sees the abort. The PR-6
+  ``aborted:drain`` / ``aborted:error`` outputs surface only when no
+  peer exists (the single-replica behavior, unchanged);
+* **tracks liveness** through a store-backed
+  :class:`~paddle_tpu.distributed.replica_registry.ReplicaRegistry`:
+  replicas heartbeat via the router while in-process; a replica whose
+  record goes stale is treated as dead and its requests re-enqueued.
+
+Fault points (``PADDLE_FAULTS`` flag faults, queried once per router
+step — the arg selects a replica by id or index, empty = first alive):
+
+=====================  ==================================================
+``fleet.kill_replica``  mark the replica dead without drain outputs —
+                        the harshest loss mode; recovery runs entirely
+                        from router-side bookkeeping
+``fleet.drain_replica`` start a graceful drain on the replica (the
+                        SIGTERM path, minus the signal)
+``fleet.slow_replica``  sleep ``arg`` seconds in the router step —
+                        models a straggling replica stalling the loop
+=====================  ==================================================
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from paddle_tpu.distributed.replica_registry import ReplicaRegistry
+from paddle_tpu.serving.fleet.metrics import FleetMetrics
+from paddle_tpu.serving.fleet.replica import ReplicaHandle
+from paddle_tpu.serving.fleet.tenant import TenantQueue
+from paddle_tpu.serving.request import RequestOutput, SamplingParams
+from paddle_tpu.testing import faults
+
+__all__ = ["FleetConfig", "FleetRouter"]
+
+# terminal reasons that mean "the replica failed the request", not
+# "the request failed" — these hand off to a peer when one exists
+HANDOFF_REASONS = ("aborted:drain", "aborted:error")
+
+
+@dataclass
+class FleetConfig:
+    """Router knobs. ``handoff=False`` degrades to PR-6 semantics on
+    every replica (aborts surface to the client)."""
+
+    tenant_quantum_tokens: int = 256
+    tenant_weights: Optional[Dict[str, float]] = None
+    heartbeat_interval_s: float = 0.0   # 0 = every router step
+    registry_ttl_s: float = 30.0
+    handoff: bool = True
+    # a request that keeps landing on dying replicas eventually surfaces
+    # its abort rather than bouncing forever
+    max_handoffs: int = 8
+
+    def __post_init__(self):
+        if self.heartbeat_interval_s < 0:
+            raise ValueError("heartbeat_interval_s must be >= 0")
+        if self.max_handoffs < 0:
+            raise ValueError("max_handoffs must be >= 0")
+
+
+@dataclass
+class _FleetRequest:
+    """Router-side bookkeeping for one client request. ``progress`` is
+    the full generated-token list observed so far (across replicas);
+    ``base_generated`` is the prefix produced before the current
+    dispatch — a hand-off folds ``progress`` into it and re-prompts the
+    peer with prompt+prefix (resume by recompute)."""
+
+    request_id: str
+    prompt_ids: List[int]
+    sampling: SamplingParams
+    callback: Optional[Callable]
+    arrival: float
+    deadline_abs: Optional[float]
+    tenant: str
+    cost: int
+    base_generated: List[int] = field(default_factory=list)
+    progress: List[int] = field(default_factory=list)
+    rng_state: Optional[dict] = None
+    replica_id: Optional[str] = None
+    dispatch_t: Optional[float] = None
+    dispatches: int = 0
+    handoffs: int = 0
+    rejects: int = 0
+    finished: bool = False
+    finish_reason: Optional[str] = None
+
+    @property
+    def generated(self) -> List[int]:
+        return list(self.progress)
+
+
+class FleetRouter:
+    def __init__(self, replicas: Sequence[ReplicaHandle],
+                 config: Optional[FleetConfig] = None,
+                 registry: Optional[ReplicaRegistry] = None):
+        self.cfg = config or FleetConfig()
+        self.registry = registry if registry is not None else \
+            ReplicaRegistry(ttl_s=self.cfg.registry_ttl_s)
+        self.replicas: List[ReplicaHandle] = []
+        self._assigned: Dict[str, Set[str]] = {}
+        self._queue = TenantQueue(
+            quantum_tokens=self.cfg.tenant_quantum_tokens,
+            weights=self.cfg.tenant_weights)
+        self._requests: Dict[str, _FleetRequest] = {}
+        self._open: Dict[str, _FleetRequest] = {}
+        self._pending_outputs: List[RequestOutput] = []
+        self._auto_id = itertools.count()
+        self._last_hb: Optional[float] = None
+        self._dead_counted: Set[str] = set()
+        self.start_time = time.monotonic()
+        # lifetime counters (surfaced as fleet/* profiler gauges)
+        self.num_dispatched = 0
+        self.num_handoffs = 0
+        self.num_rejected_fleetwide = 0
+        self.num_replicas_dead = 0
+        self.num_scale_ups = 0
+        self.num_scale_downs = 0
+        self.num_autoscale_decisions = 0
+        self.num_tokens_emitted = 0
+        # client-visible terminal histogram (the fleet-level aggregate:
+        # per-replica engines keep their own serving/finish/* view,
+        # which double-counts handed-off attempts by design)
+        self.finish_counts: Dict[str, int] = {}
+        self.tenant_wait_s: Dict[str, List[float]] = {}
+        for h in replicas:
+            self.attach_replica(h)
+        self.metrics = FleetMetrics(self)
+
+    # -- replica set ------------------------------------------------------
+    def attach_replica(self, handle: ReplicaHandle) -> None:
+        if any(h.replica_id == handle.replica_id for h in self.replicas):
+            raise ValueError(
+                f"duplicate replica id {handle.replica_id!r}")
+        self.replicas.append(handle)
+        self._assigned.setdefault(handle.replica_id, set())
+        self.registry.register(handle.replica_id)
+
+    def retire_replica(self, handle: ReplicaHandle,
+                       reason: str = "scale-down") -> None:
+        """Begin removing a replica: graceful drain now, detach once
+        empty. Its drain aborts flow through the normal hand-off path,
+        so in-flight requests migrate to peers invisibly."""
+        handle.retiring = True
+        for out in handle.start_drain(reason):
+            self._handle_output(handle, out, self._pending_outputs)
+
+    def kill_replica(self, replica_id: str, why: str = "killed",
+                     outputs: Optional[List[RequestOutput]] = None) -> None:
+        """Hard replica loss: no drain outputs, no engine cooperation.
+        Every request assigned to it re-enqueues from router-side
+        bookkeeping (or surfaces ``aborted:error`` when no peer is
+        left)."""
+        handle = self._by_id(replica_id)
+        if handle is None:
+            return
+        outs = self._pending_outputs if outputs is None else outputs
+        stranded = self._assigned.get(replica_id, set())
+        if replica_id not in self._dead_counted:
+            self._dead_counted.add(replica_id)
+            self.num_replicas_dead += 1
+        handle.alive = False
+        self.registry.deregister(replica_id)
+        frs = sorted((self._open[rid] for rid in stranded
+                      if rid in self._open), key=lambda fr: fr.arrival)
+        self._assigned[replica_id] = set()
+        # re-enqueue at the FRONT preserving arrival order (reversed:
+        # each push_front lands ahead of the previous)
+        for fr in reversed(frs):
+            state = handle.rng_state(fr.request_id)
+            if state is not None:
+                fr.rng_state = state
+            if (self.cfg.handoff and fr.handoffs < self.cfg.max_handoffs
+                    and self._has_peer(handle)):
+                self._requeue(fr)
+                self.num_handoffs += 1
+            else:
+                self._finalize(fr, "aborted:error", None, outs)
+
+    def dispatchable(self) -> List[ReplicaHandle]:
+        return [h for h in self.replicas
+                if h.alive and not h.retiring and not h.is_draining]
+
+    def _by_id(self, replica_id: str) -> Optional[ReplicaHandle]:
+        for h in self.replicas:
+            if h.replica_id == replica_id:
+                return h
+        return None
+
+    def _has_peer(self, excluding: ReplicaHandle) -> bool:
+        return any(h is not excluding for h in self.dispatchable())
+
+    # -- client API -------------------------------------------------------
+    def add_request(self, request_id=None,
+                    prompt_ids: Sequence[int] = None,
+                    sampling: Optional[SamplingParams] = None,
+                    callback: Optional[Callable] = None) -> str:
+        """Admit a request fleet-wide. Argument forms mirror
+        ``LLMEngine.add_request`` (id optional, prompt-first). Rejected
+        only when EVERY dispatchable replica's verdict rejects — the
+        terminal ``finish_reason='rejected'`` output is emitted from
+        the next :meth:`step`, like the engine's."""
+        if isinstance(prompt_ids, SamplingParams):
+            if sampling is not None:
+                raise TypeError("sampling passed twice")
+            prompt_ids, sampling = None, prompt_ids
+        if prompt_ids is None:
+            request_id, prompt_ids = None, request_id
+        if request_id is None:
+            request_id = f"fleet-{next(self._auto_id)}"
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id!r}")
+        sampling = sampling or SamplingParams()
+        prompt = [int(t) for t in prompt_ids]
+        now = time.monotonic()
+        fr = _FleetRequest(
+            request_id=request_id, prompt_ids=prompt, sampling=sampling,
+            callback=callback, arrival=now,
+            deadline_abs=(None if sampling.deadline_ms is None
+                          else now + sampling.deadline_ms / 1e3),
+            tenant=sampling.tenant_id,
+            cost=len(prompt) + sampling.max_new_tokens)
+        self._requests[request_id] = fr
+        self._open[request_id] = fr
+        live = self.dispatchable()
+        verdicts = [h.admission_verdict(len(prompt)) for h in live]
+        if not live or all(v is not None for v in verdicts):
+            self.num_rejected_fleetwide += 1
+            self._finalize(fr, "rejected", None, self._pending_outputs)
+            return request_id
+        self._queue.push(fr.tenant, request_id, fr.cost)
+        return request_id
+
+    def abort_request(self, request_id: str) -> bool:
+        fr = self._open.get(request_id)
+        if fr is None:
+            return False
+        if fr.replica_id is not None:
+            h = self._by_id(fr.replica_id)
+            if h is not None and h.alive:
+                h.abort_request(request_id)
+                h.release_request(request_id)
+                self._assigned[fr.replica_id].discard(request_id)
+        self._finalize(fr, "aborted:user", None, self._pending_outputs)
+        return True
+
+    def get_request(self, request_id: str) -> _FleetRequest:
+        return self._requests[request_id]
+
+    def release_request(self, request_id: str) -> Optional[_FleetRequest]:
+        fr = self._requests.get(request_id)
+        if fr is None:
+            return None
+        if not fr.finished:
+            raise ValueError(f"request {request_id!r} is not finished")
+        return self._requests.pop(request_id)
+
+    def has_unfinished(self) -> bool:
+        return bool(self._open) or bool(self._pending_outputs)
+
+    # -- one router iteration --------------------------------------------
+    def step(self) -> List[RequestOutput]:
+        """Pump faults, heartbeats, health, dispatch, then one engine
+        iteration per live replica. Returns this step's client-visible
+        outputs (hand-offs emit nothing — the request continues)."""
+        outputs, self._pending_outputs = self._pending_outputs, []
+        self._fire_fault_points(outputs)
+        self._heartbeat()
+        self._health_sweep(outputs)
+        self._dispatch_queue(outputs)
+        for h in list(self.replicas):
+            if not h.alive:
+                continue
+            for out in h.step():
+                self._handle_output(h, out, outputs)
+            if not h.alive:
+                # the engine died mid-step (EngineStepError absorbed at
+                # the handle): outputs above carried its structured
+                # aborts; anything still assigned re-enqueues now
+                self.kill_replica(h.replica_id, "step failure", outputs)
+        self._reap_retired()
+        return outputs
+
+    def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
+        outs: List[RequestOutput] = []
+        steps = 0
+        while self.has_unfinished():
+            outs.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return outs
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingParams] = None
+                 ) -> List[List[int]]:
+        rids = [self.add_request(list(p), sampling=sampling)
+                for p in prompts]
+        self.run()
+        return [self.release_request(rid).generated for rid in rids]
+
+    # -- internals --------------------------------------------------------
+    def _fire_fault_points(self, outputs: List[RequestOutput]) -> None:
+        for arg in faults.check("fleet.kill_replica"):
+            h = self._fault_target(arg)
+            if h is not None:
+                self.kill_replica(h.replica_id, "fault", outputs)
+        for arg in faults.check("fleet.drain_replica"):
+            h = self._fault_target(arg)
+            if h is not None:
+                for out in h.start_drain("fault"):
+                    self._handle_output(h, out, outputs)
+        for arg in faults.check("fleet.slow_replica"):
+            time.sleep(float(arg) if arg else 0.01)
+
+    def _fault_target(self, arg) -> Optional[ReplicaHandle]:
+        alive = [h for h in self.replicas if h.alive]
+        if not alive:
+            return None
+        if arg in (None, ""):
+            return alive[0]
+        for h in alive:
+            if h.replica_id == arg:
+                return h
+        try:
+            return self.replicas[int(arg)]
+        except (ValueError, IndexError):
+            return None
+
+    def _heartbeat(self) -> None:
+        now = time.monotonic()
+        if (self._last_hb is not None
+                and now - self._last_hb < self.cfg.heartbeat_interval_s):
+            return
+        self._last_hb = now
+        for h in self.replicas:
+            if h.alive:
+                self.registry.heartbeat(h.replica_id,
+                                        load=h.load().as_dict())
+
+    def _health_sweep(self, outputs: List[RequestOutput]) -> None:
+        view = self.registry.alive()
+        for h in list(self.replicas):
+            if h.alive and h.replica_id not in view:
+                self.kill_replica(h.replica_id, "heartbeat lost", outputs)
+            elif not h.alive and self._assigned.get(h.replica_id):
+                # the handle died outside the router's sight (an
+                # external monitor flipped it between steps): same
+                # recovery as a mid-step death
+                self.kill_replica(h.replica_id, "found dead", outputs)
+
+    def _dispatch_queue(self, outputs: List[RequestOutput]) -> None:
+        while True:
+            popped = self._queue.pop()
+            if popped is None:
+                return
+            tenant, rid, cost = popped
+            fr = self._open.get(rid)
+            if fr is None or fr.finished:
+                continue  # aborted while queued
+            now = time.monotonic()
+            if fr.deadline_abs is not None and now >= fr.deadline_abs:
+                self._finalize(fr, "expired", None, outputs)
+                continue
+            prompt = fr.prompt_ids + fr.base_generated
+            cands = [h for h in self.dispatchable()
+                     if h.admission_verdict(len(prompt)) is None]
+            if not cands:
+                # head-of-line blocks (DRR order is the fairness
+                # contract — skipping ahead would let cheap requests
+                # overtake a starved tenant)
+                self._queue.unpop(tenant, rid, cost)
+                return
+            handle = self._pick(cands, len(prompt))
+            handle.add_request(rid, prompt,
+                               self._effective_sampling(fr, now),
+                               rng_state=fr.rng_state)
+            self._assigned.setdefault(handle.replica_id, set()).add(rid)
+            fr.replica_id = handle.replica_id
+            fr.dispatches += 1
+            self.num_dispatched += 1
+            if fr.dispatch_t is None:
+                fr.dispatch_t = now
+                self.tenant_wait_s.setdefault(tenant, []).append(
+                    now - fr.arrival)
+
+    def _pick(self, cands: List[ReplicaHandle],
+              prompt_tokens: int) -> ReplicaHandle:
+        """Best estimated TTFT; least-loaded while estimates are cold
+        (fresh replicas have no step history, so their estimator
+        abstains rather than guess)."""
+        ests = [(h.estimated_ttft_ms(prompt_tokens), h) for h in cands]
+        warm = [(e, h) for e, h in ests if e is not None]
+        if len(warm) == len(ests) and warm:
+            return min(warm, key=lambda p: (p[0], p[1].load().occupancy,
+                                            p[1].replica_id))[1]
+        return min(cands, key=lambda h: (h.load().occupancy,
+                                         h.load().kv_utilization,
+                                         h.replica_id))
+
+    def _effective_sampling(self, fr: _FleetRequest,
+                            now: float) -> SamplingParams:
+        """The sampling params the ENGINE sees this dispatch: max_new
+        shrinks by the tokens already produced before a hand-off, and
+        the deadline becomes the REMAINING budget (engine TTLs run from
+        engine-side arrival, which resets on re-enqueue)."""
+        repl = {}
+        if fr.base_generated:
+            repl["max_new_tokens"] = (fr.sampling.max_new_tokens
+                                      - len(fr.base_generated))
+        if fr.deadline_abs is not None:
+            repl["deadline_ms"] = max(
+                (fr.deadline_abs - now) * 1e3, 1e-3)
+        return dataclasses.replace(fr.sampling, **repl) if repl \
+            else fr.sampling
+
+    def _requeue(self, fr: _FleetRequest) -> None:
+        fr.base_generated = list(fr.progress)
+        fr.replica_id = None
+        fr.handoffs += 1
+        # cost 0, front: the tenant already paid when first dispatched
+        self._queue.push(fr.tenant, fr.request_id, 0, front=True)
+
+    def _handle_output(self, handle: ReplicaHandle, out: RequestOutput,
+                       outputs: List[RequestOutput]) -> None:
+        fr = self._open.get(out.request_id)
+        if fr is None:
+            return  # not router-owned (or already finalized)
+        fr.progress = fr.base_generated + list(out.generated)
+        if out.token is not None:
+            self.num_tokens_emitted += 1
+        if not out.finished:
+            outputs.append(RequestOutput(
+                request_id=fr.request_id, token=out.token, finished=False,
+                generated=list(fr.progress)))
+            if fr.callback is not None:
+                fr.callback(fr.request_id, out.token, False)
+            return
+        self._assigned.get(handle.replica_id, set()).discard(
+            fr.request_id)
+        reason = out.finish_reason
+        if (reason in HANDOFF_REASONS and self.cfg.handoff
+                and fr.handoffs < self.cfg.max_handoffs
+                and self._has_peer(handle)):
+            state = handle.rng_state(fr.request_id)
+            if state is not None:
+                fr.rng_state = state
+            handle.release_request(fr.request_id)
+            self._requeue(fr)
+            self.num_handoffs += 1
+            return  # invisible to the client: the request continues
+        if (reason == "rejected" and fr.dispatches > 0 and fr.rejects < 3
+                and self.dispatchable()):
+            # dispatch-time race: the engine's state moved between the
+            # router's verdict check and the add — requeue, don't
+            # surface a rejection the router never decided
+            fr.rejects += 1
+            handle.release_request(fr.request_id)
+            self._requeue(fr)
+            return
+        handle.release_request(fr.request_id)
+        self._finalize(fr, reason, out.token, outputs)
+
+    def _finalize(self, fr: _FleetRequest, reason: Optional[str],
+                  token: Optional[int],
+                  outputs: List[RequestOutput]) -> None:
+        fr.finished = True
+        fr.finish_reason = reason
+        if reason is not None:
+            self.finish_counts[reason] = \
+                self.finish_counts.get(reason, 0) + 1
+        self._open.pop(fr.request_id, None)
+        outputs.append(RequestOutput(
+            request_id=fr.request_id, token=token, finished=True,
+            generated=list(fr.progress), finish_reason=reason))
+        if fr.callback is not None:
+            fr.callback(fr.request_id, token, True)
+
+    def _reap_retired(self) -> None:
+        for h in list(self.replicas):
+            done = (not h.alive) or (h.is_draining
+                                     and not h.has_unfinished())
+            if h.retiring and done and not self._assigned.get(
+                    h.replica_id):
+                self.replicas.remove(h)
+                self._assigned.pop(h.replica_id, None)
+                self.registry.deregister(h.replica_id)
+
+    # -- observability ----------------------------------------------------
+    def load(self) -> float:
+        """Fleet load in [0, 1]: the dispatchable replicas' mean of
+        max(KV utilization, request occupancy / max_num_seqs-ish) —
+        what :class:`LoadThresholdPolicy` thresholds on. 1.0 when
+        nothing is dispatchable but work remains."""
+        live = self.dispatchable()
+        if not live:
+            return 1.0 if self.has_unfinished() else 0.0
+        vals = []
+        for h in live:
+            ld = h.load()
+            cap = getattr(getattr(h, "engine", None), "cfg", None)
+            seqs = cap.max_num_seqs if cap is not None else 8
+            vals.append(max(ld.kv_utilization,
+                            min(1.0, ld.occupancy / max(seqs, 1))))
+        return sum(vals) / len(vals)
+
+    def snapshot(self) -> Dict:
+        return self.metrics.snapshot()

@@ -20,6 +20,8 @@ import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
+from paddle_tpu.serving.request import FINISH_REASONS
+
 __all__ = ["ServingMetrics"]
 
 
@@ -45,6 +47,11 @@ class ServingMetrics:
               "num_swapped", "swapped_out", "swapped_in", "expired",
               "rejected", "step_retries", "poisoned_aborts",
               "drain_started", "drain_aborted", "drain_completed")
+
+    # per-terminal-reason histogram (ISSUE 8): every request's end state
+    # lands in exactly one bucket — `serving/finish/<reason>` counters,
+    # `serving_finish/<reason>` snapshot keys
+    FINISH_GAUGES = tuple(f"finish/{r}" for r in FINISH_REASONS)
 
     # gauges read straight off the engine/scheduler (they outlive
     # reset_metrics, like `preemptions` always has)
@@ -95,16 +102,26 @@ class ServingMetrics:
             self._occupancy_sum += n_seqs / max_num_seqs
             self._occupancy_n += 1
 
-    def estimated_ttft_ms(self, queue_depth: int) -> Optional[float]:
+    def estimated_ttft_ms(self, queue_depth: int,
+                          queued_prefill_tokens: int = 0,
+                          prompt_tokens: int = 0,
+                          tokens_per_step: Optional[int] = None
+                          ) -> Optional[float]:
         """Predicted time-to-first-token for a request arriving behind
         ``queue_depth`` waiting peers: each needs roughly one engine
-        iteration before this one prefills. None while the engine has
-        no step history (cold start — admission abstains rather than
-        reject on a guess)."""
+        iteration before this one prefills, PLUS the prefill work those
+        peers (and this prompt itself) queue up — token counts divided
+        by the per-iteration token budget ``tokens_per_step`` — so a
+        burst of long prompts raises the estimate even at a shallow
+        queue depth. None while the engine has no step history (cold
+        start — admission abstains rather than reject on a guess)."""
         if not self._step_times_s:
             return None
         avg = sum(self._step_times_s) / len(self._step_times_s)
-        return (queue_depth + 1) * avg * 1e3
+        steps = queue_depth + 1.0
+        if tokens_per_step:
+            steps += (queued_prefill_tokens + prompt_tokens) / tokens_per_step
+        return steps * avg * 1e3
 
     def record_token(self):
         self.num_generated_tokens += 1
@@ -161,6 +178,9 @@ class ServingMetrics:
             # poisoned-row aborts, drain lifecycle
             out.update({f"serving_{name}": int(get(eng))
                         for name, get in self._ENGINE_GAUGES.items()})
+            out.update({f"serving_finish/{r}":
+                        int(eng.finish_counts.get(r, 0))
+                        for r in FINISH_REASONS})
         return out
 
     # -- profiler counter providers --------------------------------------
@@ -177,6 +197,8 @@ class ServingMetrics:
                     return None  # counters() drops dead providers
                 if name in ServingMetrics._ENGINE_GAUGES:
                     return ServingMetrics._ENGINE_GAUGES[name](eng)
+                if name.startswith("finish/"):
+                    return eng.finish_counts.get(name[len("finish/"):], 0)
                 if name == "queue_depth":
                     return eng.scheduler.num_waiting
                 if name == "num_running":
@@ -198,7 +220,7 @@ class ServingMetrics:
                 return None
             return get
 
-        for g in self.GAUGES:
+        for g in self.GAUGES + self.FINISH_GAUGES:
             cname = f"serving/{g}#{id(engine)}"
             profiler.register_counter_provider(cname, provider(g))
             self._registered.append(cname)

@@ -31,7 +31,12 @@ class SamplingParams:
     iteration boundary after arrival+deadline, wherever it is in its
     lifecycle. ``priority`` orders admission and protects against
     preemption: LOWER values are MORE important (scheduled first,
-    evicted last); default 0, ties broken FCFS by arrival."""
+    evicted last); default 0, ties broken FCFS by arrival.
+
+    ``tenant_id`` names the traffic source for fleet-level fairness:
+    the multi-replica router (``paddle_tpu.serving.fleet``) runs
+    weighted deficit-round-robin across tenants so one tenant's burst
+    cannot starve the others. A single engine ignores it."""
 
     max_new_tokens: int = 32
     temperature: float = 0.0
@@ -41,6 +46,7 @@ class SamplingParams:
     seed: Optional[int] = None
     deadline_ms: Optional[float] = None
     priority: int = 0
+    tenant_id: str = "default"
 
     def __post_init__(self):
         if self.max_new_tokens < 1:

@@ -122,6 +122,13 @@ class Scheduler:
         return len(self.waiting)
 
     @property
+    def num_waiting_tokens(self) -> int:
+        """Uncached tokens queued for prefill — the work ahead of a new
+        arrival, which the admission controller's TTFT estimate weighs
+        so long prompts can't sneak past the SLO gate."""
+        return sum(len(r.tokens_to_run()) for r in self.waiting)
+
+    @property
     def num_running(self) -> int:
         return len(self.running)
 

@@ -18,7 +18,10 @@ worker where to die. Spec grammar (specs separated by ``;``)::
     ckpt.data_written:sleep:60*1       sleep 60s, first hit only
     ckpt.data_written:touch:/tmp/f     create /tmp/f and continue
 
-``@skip`` ignores the first N hits; ``*times`` fires at most N times.
+``@skip`` ignores the first N hits; ``*times`` fires at most N times
+(for per-step points like ``serving.step`` or the router's
+``fleet.kill_replica`` / ``fleet.drain_replica`` / ``fleet.slow_replica``
+— queried once per step — ``@skip`` counts steps).
 Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
 the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
 ``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test

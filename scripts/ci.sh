@@ -4,6 +4,8 @@
 #
 #   scripts/ci.sh            tier-1 suite, then lint
 #   scripts/ci.sh --lint     lint only (fast pre-push check)
+#   scripts/ci.sh --fleet    fleet serving smoke only (2 tiny replicas
+#                            + a mid-run replica kill; ~1 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -29,8 +31,19 @@ run_lint() {
     fi
 }
 
+run_fleet() {
+    echo "== fleet smoke =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/fleet_smoke.py
+}
+
 if [[ "${1:-}" == "--lint" ]]; then
     run_lint
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fleet" ]]; then
+    run_fleet
     exit 0
 fi
 

@@ -1,0 +1,70 @@
+"""Fleet serving smoke: 2 tiny replicas + a mid-run replica kill.
+
+The ``scripts/ci.sh --fleet`` stage: boots a two-replica
+:class:`FleetRouter` on XLA:CPU, admits 8 requests across two tenants,
+kills replica r0 through the ``fleet.kill_replica`` fault four router
+steps in, and asserts the fleet absorbs the loss — every request
+finishes ``'length'`` token-complete, at least one hand-off happened,
+and the fleet counters say exactly one replica died. Exit 0 on
+success; any broken invariant raises.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, SamplingParams
+from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
+from paddle_tpu.testing import faults
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    router = FleetRouter([
+        InProcessReplica(
+            model,
+            EngineConfig(block_size=4, max_num_seqs=4, max_model_len=64),
+            replica_id=f"r{i}")
+        for i in range(2)])
+
+    rng = np.random.default_rng(5)
+    max_new = 8
+    rids = [router.add_request(
+        list(map(int, rng.integers(0, model.config.vocab_size,
+                                   size=3 + (i % 4)))),
+        SamplingParams(max_new_tokens=max_new,
+                       tenant_id=("a" if i % 2 else "b")))
+        for i in range(8)]
+
+    faults.install("fleet.kill_replica:flag:r0@4*1")
+    steps = 0
+    try:
+        while router.has_unfinished():
+            router.step()
+            steps += 1
+            assert steps < 500, "router failed to converge"
+    finally:
+        faults.clear()
+
+    for rid in rids:
+        fr = router.get_request(rid)
+        assert fr.finish_reason == "length", (rid, fr.finish_reason)
+        assert len(fr.generated) == max_new, (rid, len(fr.generated))
+    snap = router.snapshot()
+    assert snap["fleet_replicas_dead"] == 1, snap
+    assert snap["fleet_handoffs"] >= 1, snap
+    assert snap["fleet_finish"] == {"length": 8}, snap
+    assert router._by_id("r0").alive is False
+    assert set(snap["fleet_tenants"]) == {"a", "b"}
+    print("FLEET_SMOKE_OK steps=%d handoffs=%d dead=%d"
+          % (steps, snap["fleet_handoffs"], snap["fleet_replicas_dead"]),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Two-replica fleet worker (driven by tests/test_fault_e2e.py).
+
+Boots a tiny-Llama :class:`FleetRouter` with two in-process replicas.
+Replica r0 owns the SIGTERM preemption monitor with zero drain grace,
+so the signal the driving test delivers mid-run drains r0 immediately
+and its in-flight requests hand off to r1. Before serving, the worker
+computes the single-engine reference generations for the same request
+ids (the per-request sampling stream seeds from the id), so the result
+file carries a self-contained token-parity verdict: hand-off must be
+invisible AND bit-identical.
+
+Env protocol:
+  RESULT_FILE    json written on exit: {finished: {rid: reason},
+                 n_tokens: {rid: n}, parity, handoffs,
+                 r0_drain_aborted, replicas_dead}
+  PROGRESS_FILE  rewritten with the router step number every step
+                 (only during the FLEET phase — the parent keys its
+                 SIGTERM off this, so the reference run is never hit)
+  N_REQUESTS     total requests to admit (default 6)
+  MAX_NEW        max_new_tokens per request (default 8)
+  STEP_SLEEP     host sleep per router step, widens the SIGTERM window
+                 (default 0.05)
+"""
+import json
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.watchdog import PreemptionMonitor
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
+
+result_file = os.environ.get("RESULT_FILE")
+progress_file = os.environ.get("PROGRESS_FILE")
+n_requests = int(os.environ.get("N_REQUESTS", "6"))
+max_new = int(os.environ.get("MAX_NEW", "8"))
+step_sleep = float(os.environ.get("STEP_SLEEP", "0.05"))
+
+paddle.seed(0)
+model = LlamaForCausalLM(LlamaConfig.tiny())
+model.eval()
+
+
+def ecfg():
+    return EngineConfig(block_size=4, max_num_seqs=4, max_model_len=64,
+                        drain_grace_s=0.0)
+
+
+rng = np.random.default_rng(21)
+prompts = [list(map(int, rng.integers(0, model.config.vocab_size,
+                                      size=3 + (i % 4))))
+           for i in range(n_requests)]
+ids = [f"q{i}" for i in range(n_requests)]
+sp = SamplingParams(max_new_tokens=max_new)
+
+# -- phase 1: uninterrupted single-engine reference (the oracle) ----------
+ref_eng = LLMEngine(model, ecfg())
+for rid, p in zip(ids, prompts):
+    ref_eng.add_request(rid, p, sampling=sp)
+while ref_eng.has_unfinished():
+    ref_eng.step()
+ref = {rid: list(ref_eng.get_request(rid).generated) for rid in ids}
+
+# -- phase 2: the fleet run the parent SIGTERMs mid-flight ----------------
+monitor = PreemptionMonitor()
+router = FleetRouter([
+    InProcessReplica(model, ecfg(), replica_id="r0", monitor=monitor),
+    InProcessReplica(model, ecfg(), replica_id="r1"),
+])
+for rid, p in zip(ids, prompts):
+    router.add_request(rid, p, sampling=sp)
+
+outs = []
+steps = 0
+while router.has_unfinished():
+    outs.extend(router.step())
+    steps += 1
+    if progress_file:
+        with open(progress_file, "w") as f:
+            f.write(str(steps))
+    if step_sleep:
+        time.sleep(step_sleep)
+
+final = {o.request_id: o for o in outs if o.finished}
+r0 = router._by_id("r0")
+payload = {
+    "finished": {r: final[r].finish_reason for r in ids if r in final},
+    "n_tokens": {r: len(final[r].generated) for r in ids if r in final},
+    "parity": all(r in final and final[r].generated == ref[r]
+                  for r in ids),
+    "handoffs": router.num_handoffs,
+    "r0_drain_aborted": r0.engine.num_drain_aborted,
+    "replicas_dead": router.num_replicas_dead,
+}
+if result_file:
+    with open(result_file + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(result_file + ".tmp", result_file)
+print("FLEET_WORKER_DONE parity=%s handoffs=%d"
+      % (payload["parity"], payload["handoffs"]), flush=True)

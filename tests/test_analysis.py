@@ -212,6 +212,130 @@ class TestHostSync:
         """)
         assert fs == []
 
+    def test_cross_method_self_attr_fetch_flagged(self):
+        # self._last parked in step(), fetched host-side in result() —
+        # the None placeholder in __init__ must not clear the bind
+        fs = run("""
+            import jax
+            import numpy as np
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+                    self._last = None
+
+                def step(self, ids):
+                    self._last = self._jstep(ids)
+
+                def result(self):
+                    return np.asarray(self._last)
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+        assert "self._last" in fs[0].message
+        assert "step()" in fs[0].message
+
+    def test_cross_method_self_attr_via_local_flagged(self):
+        # the dispatch result routes through a local before parking on
+        # self — the local's live bind must propagate to the attribute
+        fs = run("""
+            import jax
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+
+                def step(self, ids):
+                    out = self._jstep(ids)
+                    self._logits = out
+
+                def sample(self):
+                    return self._logits.numpy()
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"]
+        assert "self._logits" in fs[0].message
+
+    def test_near_miss_self_attr_reassigned_non_dispatch_clean(self):
+        # an attribute REBOUND from host data anywhere in the class is
+        # conservatively cleared: method order is unknowable statically
+        fs = run("""
+            import jax
+            import numpy as np
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+                    self._last = None
+
+                def step(self, ids):
+                    self._last = self._jstep(ids)
+
+                def reset(self, ids):
+                    self._last = list(ids)
+
+                def result(self):
+                    return np.asarray(self._last)
+        """)
+        assert fs == []
+
+    def test_near_miss_self_attr_never_dispatch_clean(self):
+        # host-only attributes fetched with numpy stay clean
+        fs = run("""
+            import jax
+            import numpy as np
+
+            class Eng:
+                def __init__(self, f, table):
+                    self._jstep = jax.jit(f)
+                    self._table = table
+
+                def lookup(self):
+                    return np.asarray(self._table)
+        """)
+        assert fs == []
+
+    def test_near_miss_other_class_attr_clean(self):
+        # the dispatch-carrying attribute lives on Eng; a different
+        # class fetching its own same-named attribute is unrelated
+        fs = run("""
+            import jax
+            import numpy as np
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+
+                def step(self, ids):
+                    self._last = self._jstep(ids)
+
+            class Logger:
+                def __init__(self, rows):
+                    self._last = rows
+
+                def flush(self):
+                    return np.asarray(self._last)
+        """)
+        assert fs == []
+
+    def test_cross_method_tuple_elementwise_tracked(self):
+        # `self._k, self._v = k, v` with dispatch-carrying locals binds
+        # both attributes elementwise
+        fs = run("""
+            import jax
+
+            class Eng:
+                def __init__(self, f):
+                    self._jstep = jax.jit(f)
+
+                def step(self, ids):
+                    logits, k, v = self._jstep(ids)
+                    self._k, self._v = k, v
+                    return logits
+
+                def swap_out(self):
+                    return self._k.numpy(), self._v.numpy()
+        """)
+        assert rules_of(fs) == ["host-sync-in-traced"] * 2
+
 
 # ---------------------------------------------------------------------------
 # use-after-donate

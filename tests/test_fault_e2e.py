@@ -25,6 +25,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPTS = os.path.join(os.path.dirname(__file__), "mp_scripts")
 WORKER = os.path.join(SCRIPTS, "ckpt_train_worker.py")
 SERVING_WORKER = os.path.join(SCRIPTS, "serving_worker.py")
+FLEET_WORKER = os.path.join(SCRIPTS, "fleet_worker.py")
 
 pytestmark = pytest.mark.slow
 
@@ -226,3 +227,37 @@ def test_launcher_forwards_sigterm_to_serving_worker(tmp_path):
     assert "forwarding to workers" in out
     assert "SERVING_WORKER_DONE drained=True" in out
     _assert_drained_result(tmp_path, 8)
+
+
+def test_fleet_sigterm_hands_off_with_token_parity(tmp_path):
+    """SIGTERM to a 2-replica fleet process mid-batch: replica r0
+    (which owns the signal monitor, zero drain grace) drains and its
+    requests hand off to r1 — every request still finishes
+    'stop'/'length' with generations BIT-IDENTICAL to the uninterrupted
+    single-engine reference the worker computed up front. The hand-off
+    must be invisible: no aborted:drain reaches the client."""
+    env = _env(tmp_path, N_REQUESTS=6, MAX_NEW=8, STEP_SLEEP="0.05")
+    p = subprocess.Popen([sys.executable, FLEET_WORKER], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        # progress only appears once the FLEET phase is stepping, so
+        # the signal can never land on the reference run
+        assert faults.wait_for_path(str(tmp_path / "progress"),
+                                    timeout=300)
+        time.sleep(0.3)                      # a few fleet steps pass
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=180)
+    finally:
+        p.kill()
+    assert p.returncode == 0, out
+    assert "FLEET_WORKER_DONE parity=True" in out
+    with open(tmp_path / "result.json") as f:
+        res = json.load(f)
+    assert res["parity"] is True
+    assert len(res["finished"]) == 6         # nobody vanished
+    assert set(res["finished"].values()) <= {"stop", "length"}
+    assert all(n == 8 for n in res["n_tokens"].values())
+    # every request r0's drain aborted was re-dispatched to the peer
+    assert res["handoffs"] >= res["r0_drain_aborted"]
+    assert res["replicas_dead"] == 0         # drain, not death

@@ -1,0 +1,741 @@
+"""Fleet router acceptance pins (ISSUE 8).
+
+Two layers, matching the router's transport seam:
+
+* model-free tests drive :class:`FleetRouter` against deterministic
+  ``FakeReplica`` handles — dispatch policy, fleet-wide admission,
+  weighted-DRR tenant fairness (including the randomized storm with
+  bounded per-tenant skew), registry liveness, hand-off bookkeeping,
+  autoscale decisions;
+* tiny-Llama e2e tests pin the headline guarantee: drain hand-off is
+  LOSSLESS and TOKEN-IDENTICAL — a 2-replica fleet preempted mid-run
+  produces bit-identical generations (greedy AND sampled) to an
+  uninterrupted single engine, and with one replica the PR-6
+  ``aborted:drain`` contract is unchanged.
+
+The slow subprocess SIGTERM version lives in test_fault_e2e.py
+(fleet_worker.py); single-engine serving pins in
+test_serving_resilience.py.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.distributed.replica_registry import MemStore, ReplicaRegistry
+from paddle_tpu.distributed.watchdog import PreemptionMonitor
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineConfig, LLMEngine, RequestOutput, SamplingParams,
+)
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetController, FleetRouter, InProcessReplica,
+    LoadThresholdPolicy, ReplicaHandle, ReplicaLoad, TenantQueue,
+)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# FakeReplica: deterministic, model-free handle — one token per request
+# per step, value = 1000 + position, so generations are predictable
+# ---------------------------------------------------------------------------
+class FakeReplica(ReplicaHandle):
+    def __init__(self, replica_id, ttft=None, capacity=4):
+        self.replica_id = replica_id
+        self.alive = True
+        self.retiring = False
+        self.ttft = ttft            # None = cold estimator (abstains)
+        self.capacity = capacity
+        self.reqs = {}              # rid -> [sampling, generated]
+        self.rng_seen = {}          # rid -> rng_state passed at add
+        self.dispatch_log = []      # rids in dispatch order (test hook)
+        self._draining = False
+
+    def admission_verdict(self, prompt_tokens):
+        if not self.alive:
+            return "replica is dead"
+        if self._draining:
+            return "replica is draining"
+        if len(self.reqs) >= self.capacity:
+            return "queue full"
+        return None
+
+    def estimated_ttft_ms(self, prompt_tokens):
+        return self.ttft
+
+    def load(self):
+        return ReplicaLoad(
+            queue_depth=0, num_running=len(self.reqs),
+            kv_utilization=min(1.0, len(self.reqs)
+                               / max(self.capacity, 1)))
+
+    @property
+    def is_draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        return self._draining and not self.reqs
+
+    def has_unfinished(self):
+        return self.alive and bool(self.reqs)
+
+    def add_request(self, request_id, prompt_ids, sampling, *,
+                    rng_state=None):
+        self.reqs[request_id] = [sampling, []]
+        self.rng_seen[request_id] = rng_state
+        self.dispatch_log.append(request_id)
+
+    def abort_request(self, request_id):
+        return self.reqs.pop(request_id, None) is not None
+
+    def release_request(self, request_id):
+        self.reqs.pop(request_id, None)
+
+    def rng_state(self, request_id):
+        return {"fake_state_for": request_id}
+
+    def step(self):
+        if not self.alive:
+            return []
+        outs = []
+        for rid in list(self.reqs):
+            sp, gen = self.reqs[rid]
+            gen.append(1000 + len(gen))
+            done = len(gen) >= sp.max_new_tokens
+            outs.append(RequestOutput(
+                request_id=rid, token=gen[-1], finished=done,
+                generated=list(gen),
+                finish_reason="length" if done else None))
+            if done:
+                del self.reqs[rid]
+        return outs
+
+    def start_drain(self, reason="manual"):
+        self._draining = True
+        outs = []
+        for rid in list(self.reqs):
+            sp, gen = self.reqs.pop(rid)
+            outs.append(RequestOutput(
+                request_id=rid, token=None, finished=True,
+                generated=list(gen), finish_reason="aborted:drain"))
+        return outs
+
+
+def _drain_router(router, max_steps=200):
+    outs = []
+    for _ in range(max_steps):
+        if not router.has_unfinished():
+            return outs
+        outs.extend(router.step())
+    raise AssertionError("router failed to converge")
+
+
+# ---------------------------------------------------------------------------
+# dispatch policy
+# ---------------------------------------------------------------------------
+class TestDispatch:
+    def test_prefers_lowest_estimated_ttft_when_all_warm(self):
+        fast = FakeReplica("fa", ttft=10.0)
+        mid = FakeReplica("fb", ttft=30.0)
+        slow = FakeReplica("fc", ttft=90.0)
+        router = FleetRouter([slow, fast, mid])
+        router.add_request([1, 2, 3], SamplingParams(max_new_tokens=1))
+        router.step()
+        assert fast.dispatch_log and not mid.dispatch_log \
+            and not slow.dispatch_log
+
+    def test_least_loaded_fallback_while_any_estimate_cold(self):
+        # rb is cold (no step history -> estimator abstains): the
+        # router must not trust ra's number against a blind peer
+        busy = FakeReplica("ra", ttft=1.0)
+        busy.reqs = {"pre-%d" % i: [SamplingParams(max_new_tokens=99), []]
+                     for i in range(3)}
+        idle = FakeReplica("rb", ttft=None)
+        router = FleetRouter([busy, idle])
+        router.add_request([1, 2], SamplingParams(max_new_tokens=1))
+        router.step()
+        assert idle.dispatch_log == ["fleet-0"]
+
+    def test_fleet_admits_when_any_replica_admits(self):
+        full = FakeReplica("ra", capacity=0)       # always rejects
+        open_ = FakeReplica("rb", capacity=4)
+        router = FleetRouter([full, open_])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=2))
+        outs = _drain_router(router)
+        final = [o for o in outs if o.finished]
+        assert [o.request_id for o in final] == [rid]
+        assert final[0].finish_reason == "length"
+        assert router.num_rejected_fleetwide == 0
+        assert open_.dispatch_log == [rid]
+
+    def test_fleet_rejects_only_when_every_replica_rejects(self):
+        router = FleetRouter([FakeReplica("ra", capacity=0),
+                              FakeReplica("rb", capacity=0)])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=2))
+        outs = _drain_router(router)
+        assert [(o.request_id, o.finish_reason) for o in outs] \
+            == [(rid, "rejected")]
+        assert router.num_rejected_fleetwide == 1
+        assert router.finish_counts == {"rejected": 1}
+
+    def test_empty_fleet_rejects(self):
+        router = FleetRouter([])
+        router.add_request([1], SamplingParams(max_new_tokens=2))
+        outs = _drain_router(router)
+        assert [o.finish_reason for o in outs] == ["rejected"]
+
+    def test_queued_deadline_expires_in_queue(self):
+        # capacity-1 replica: the second request waits in the ROUTER
+        # queue past its deadline and must expire there, first-class
+        r = FakeReplica("ra", capacity=1)
+        router = FleetRouter([r])
+        r1 = router.add_request([1], SamplingParams(max_new_tokens=6))
+        r2 = router.add_request([2], SamplingParams(max_new_tokens=1,
+                                                    deadline_ms=5.0))
+        router.step()                      # r1 dispatched, r2 blocked
+        time.sleep(0.02)
+        outs = _drain_router(router)
+        final = {o.request_id: o.finish_reason
+                 for o in outs if o.finished}
+        assert final == {r1: "length", r2: "expired"}
+        assert r.dispatch_log == [r1]      # r2 never reached a replica
+
+    def test_abort_queued_request(self):
+        r = FakeReplica("ra", capacity=1)
+        router = FleetRouter([r])
+        r1 = router.add_request([1], SamplingParams(max_new_tokens=4))
+        r2 = router.add_request([2], SamplingParams(max_new_tokens=4))
+        router.step()
+        assert router.abort_request(r2)
+        outs = _drain_router(router)
+        final = {o.request_id: o.finish_reason
+                 for o in outs if o.finished}
+        assert final[r1] == "length"
+        assert router.get_request(r2).finish_reason == "aborted:user"
+        assert r.dispatch_log == [r1]
+
+    def test_duplicate_ids_raise(self):
+        router = FleetRouter([FakeReplica("ra")])
+        with pytest.raises(ValueError):
+            router.attach_replica(FakeReplica("ra"))
+        router.add_request("x", [1], SamplingParams(max_new_tokens=1))
+        with pytest.raises(ValueError):
+            router.add_request("x", [1], SamplingParams(max_new_tokens=1))
+        with pytest.raises(ValueError):
+            router.release_request("x")    # not finished yet
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness (weighted DRR)
+# ---------------------------------------------------------------------------
+class TestTenantFairness:
+    def test_drr_weighted_share(self):
+        # quantum 8, cost 16: weight-2 A affords every visit, weight-1
+        # B every second visit -> exact A,A,B cadence (2:1 share)
+        q = TenantQueue(quantum_tokens=8, weights={"A": 2.0})
+        for i in range(8):
+            q.push("A", f"a{i}", 16)
+            q.push("B", f"b{i}", 16)
+        order = [q.pop()[0] for _ in range(9)]
+        assert order.count("A") == 6 and order.count("B") == 3
+
+    def test_drr_unpop_refunds_deficit(self):
+        q = TenantQueue(quantum_tokens=10)
+        q.push("A", "a0", 10)
+        t, item, cost = q.pop()
+        q.unpop(t, item, cost)
+        assert len(q) == 1
+        assert q.pop() == ("A", "a0", 10)   # still affordable, same head
+
+    def test_idle_tenant_forfeits_banked_deficit(self):
+        q = TenantQueue(quantum_tokens=10)
+        q.push("A", "a0", 10)
+        q.pop()
+        assert q.pop() is None              # A left the rotation
+        q.push("A", "a1", 30)
+        # a fresh join banks from zero: 3 visits to afford cost 30
+        assert q.pop() == ("A", "a1", 30)
+
+    def test_storm_bounded_wait_skew(self):
+        """Randomized arrival storm: a 4x heavier tenant must not push
+        the light tenant's dispatches to the back — DRR alternates, so
+        light-tenant positions stay within a small constant of ideal."""
+        rng = np.random.default_rng(7)
+        arrivals = ["heavy"] * 24 + ["light"] * 6
+        rng.shuffle(arrivals)
+        replica = FakeReplica("ra", capacity=2)
+        # quantum == request cost (4 prompt + 2 max_new): one dispatch
+        # per DRR visit. The default 256 quantum would let one visit
+        # burst ~40 of these small requests — fairness granularity IS
+        # the quantum, so storms must size it to their traffic
+        router = FleetRouter([replica],
+                             FleetConfig(tenant_quantum_tokens=6))
+        sp = {t: SamplingParams(max_new_tokens=2, tenant_id=t)
+              for t in ("heavy", "light")}
+        by_tenant = {"heavy": [], "light": []}
+        for i, t in enumerate(arrivals):
+            by_tenant[t].append(
+                router.add_request(f"{t}-{i}", [1, 2, 3, 4], sp[t]))
+        _drain_router(router)
+        pos = {rid: i for i, rid in enumerate(replica.dispatch_log)}
+        assert len(pos) == 30               # everyone dispatched once
+        light_pos = sorted(pos[r] for r in by_tenant["light"])
+        heavy_pos = sorted(pos[r] for r in by_tenant["heavy"])
+        # equal weights + equal costs => near-alternation while both
+        # queues are non-empty: the k-th light dispatch sits near 2k
+        assert light_pos[-1] <= 2 * len(light_pos) + 4
+        assert np.mean(light_pos) < np.mean(heavy_pos)
+        snap = router.snapshot()
+        assert snap["fleet_tenants"]["light"]["dispatched"] == 6
+        assert snap["fleet_tenants"]["heavy"]["dispatched"] == 24
+
+    def test_per_tenant_wait_recorded(self):
+        router = FleetRouter([FakeReplica("ra")])
+        router.add_request([1], SamplingParams(max_new_tokens=1,
+                                               tenant_id="t1"))
+        _drain_router(router)
+        assert len(router.tenant_wait_s["t1"]) == 1
+        assert router.snapshot()["fleet_tenants"]["t1"]["wait_ms_avg"] \
+            >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry liveness + health sweep
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_heartbeat_ttl_driven_clock(self):
+        reg = ReplicaRegistry(MemStore(), ttl_s=5.0)
+        reg.register("ra", now=100.0)
+        reg.register("rb", now=100.0)
+        assert set(reg.alive(now=104.0)) == {"ra", "rb"}
+        reg.heartbeat("ra", now=106.0)
+        assert set(reg.alive(now=106.0)) == {"ra"}   # rb stale
+        assert reg.is_alive("rb", now=106.0) is False
+        reg.heartbeat("rb", now=107.0)               # resumes -> back
+        assert set(reg.alive(now=107.0)) == {"ra", "rb"}
+        reg.deregister("ra")
+        assert reg.members() == ["rb"]
+
+    def test_garbage_record_reads_as_absent(self):
+        store = MemStore()
+        reg = ReplicaRegistry(store, ttl_s=5.0)
+        reg.register("ra", now=100.0)
+        store.set("serving_fleet/hb/ra", b"\xff not json")
+        assert reg.record("ra") is None
+        assert reg.alive(now=100.0) == {}
+
+    def test_slash_in_replica_id_rejected(self):
+        reg = ReplicaRegistry(MemStore())
+        with pytest.raises(ValueError):
+            reg.register("a/b")
+        with pytest.raises(ValueError):
+            reg.register("a__b")
+
+    def test_stale_heartbeat_kills_replica_and_hands_off(self):
+        # freeze router heartbeats after the first so rb's record can
+        # go stale underneath it -> health sweep treats rb as dead and
+        # its request finishes on ra, invisibly to the client
+        ra, rb = FakeReplica("ra", ttft=5.0), FakeReplica("rb", ttft=1.0)
+        reg = ReplicaRegistry(MemStore(), ttl_s=5.0)
+        router = FleetRouter(
+            [ra, rb], FleetConfig(heartbeat_interval_s=1e6),
+            registry=reg)
+        rid = router.add_request([1], SamplingParams(max_new_tokens=4))
+        router.step()                           # dispatched to rb
+        assert rb.dispatch_log == [rid]
+        reg.heartbeat("rb", now=time.time() - 999.0)
+        outs = _drain_router(router)
+        final = {o.request_id: o.finish_reason
+                 for o in outs if o.finished}
+        assert final == {rid: "length"}
+        assert rb.alive is False
+        assert router.num_replicas_dead == 1
+        assert router.num_handoffs == 1
+        assert ra.dispatch_log == [rid]
+
+    def test_externally_dead_handle_recovered(self):
+        ra, rb = FakeReplica("ra", ttft=5.0), FakeReplica("rb", ttft=1.0)
+        router = FleetRouter([ra, rb])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=4))
+        router.step()
+        rb.alive = False                        # flipped outside router
+        outs = _drain_router(router)
+        assert {o.request_id: o.finish_reason
+                for o in outs if o.finished} == {rid: "length"}
+        assert router.num_replicas_dead == 1
+        assert len(router.get_request(rid).generated) == 4
+
+
+# ---------------------------------------------------------------------------
+# drain hand-off bookkeeping (model-free)
+# ---------------------------------------------------------------------------
+class TestHandoff:
+    def test_drain_fault_hands_off_invisibly(self):
+        ra, rb = FakeReplica("ra", ttft=1.0), FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb])
+        rids = [router.add_request([1, 2], SamplingParams(
+            max_new_tokens=6)) for _ in range(2)]
+        # fire after 2 router steps, once: ra has partial generations
+        faults.install("fleet.drain_replica:flag:ra@2*1")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert set(final) == set(rids)
+        assert all(final[r].finish_reason == "length" for r in rids)
+        # total token count is exact across the hand-off seam
+        assert all(len(final[r].generated) == 6 for r in rids)
+        assert router.num_handoffs == 2
+        assert ra.is_draining
+        assert all(rb.rng_seen[r] == {"fake_state_for": r}
+                   for r in rids)            # sampling state rode along
+        assert router.finish_counts == {"length": 2}
+
+    def test_handoff_disabled_surfaces_pr6_abort(self):
+        ra, rb = FakeReplica("ra", ttft=1.0), FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb], FleetConfig(handoff=False))
+        rid = router.add_request([1], SamplingParams(max_new_tokens=8))
+        router.step()
+        router.step()
+        router.retire_replica(ra)
+        outs = _drain_router(router)
+        final = [o for o in outs if o.finished]
+        assert [o.finish_reason for o in final] == ["aborted:drain"]
+        assert final[0].generated != []      # partial progress kept
+        assert router.num_handoffs == 0
+        assert not rb.dispatch_log
+
+    def test_max_handoffs_bounds_bouncing(self):
+        # every replica drains the moment it's dispatched to: the
+        # request must surface its abort after max_handoffs bounces,
+        # not ping-pong forever
+        class DrainOnStep(FakeReplica):
+            def step(self):
+                if self.reqs and not self._draining:
+                    return self.start_drain("unstable")
+                return super().step()
+
+        router = FleetRouter(
+            [DrainOnStep("ra"), DrainOnStep("rb"), DrainOnStep("rc")],
+            FleetConfig(max_handoffs=2))
+        rid = router.add_request([1], SamplingParams(max_new_tokens=4))
+        outs = _drain_router(router)
+        final = [o for o in outs if o.finished]
+        assert [o.request_id for o in final] == [rid]
+        assert final[0].finish_reason == "aborted:drain"
+        assert router.num_handoffs == 2
+
+    def test_kill_fault_reenqueues_in_arrival_order(self):
+        ra, rb = FakeReplica("ra", ttft=1.0, capacity=8), \
+            FakeReplica("rb", ttft=9.0, capacity=8)
+        router = FleetRouter([ra, rb])
+        rids = [router.add_request([1], SamplingParams(max_new_tokens=9))
+                for _ in range(3)]
+        router.step()
+        assert ra.dispatch_log == rids
+        faults.install("fleet.kill_replica:flag:ra*1")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert all(final[r].finish_reason == "length" for r in rids)
+        assert all(len(final[r].generated) == 9 for r in rids)
+        assert rb.dispatch_log == rids       # arrival order preserved
+        assert router.num_replicas_dead == 1
+        assert router.num_handoffs == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic scaling
+# ---------------------------------------------------------------------------
+class TestScaling:
+    def test_policy_hysteresis(self):
+        p = LoadThresholdPolicy(high=0.8, low=0.2, min_replicas=1,
+                                max_replicas=3)
+        assert p.decide(0.9, 2, 0) == 3
+        assert p.decide(0.9, 3, 0) is None      # at max
+        assert p.decide(0.5, 2, 0) is None      # in band
+        assert p.decide(0.1, 2, 0) == 1
+        assert p.decide(0.1, 1, 0) is None      # at min
+        assert p.decide(0.0, 0, 5) == 1         # queued, nothing live
+        with pytest.raises(ValueError):
+            LoadThresholdPolicy(high=0.2, low=0.8)
+
+    def test_scale_to_up_and_down(self):
+        router = FleetRouter([FakeReplica("f0")])
+        ctl = FleetController(
+            router, lambda i: FakeReplica(f"f{i}", capacity=4))
+        ctl.scale_to(3)
+        assert sorted(h.replica_id for h in router.dispatchable()) \
+            == ["f0", "f1", "f2"]
+        assert router.num_scale_ups == 2
+        ctl.scale_to(1)
+        assert router.num_scale_downs == 2
+        router.step()                           # reap drained victims
+        assert len(router.replicas) == 1
+        assert len(router.registry.alive()) == 1
+
+    def test_scale_down_drains_victims_losslessly(self):
+        ra = FakeReplica("ra", capacity=8)
+        router = FleetRouter([ra])
+        ctl = FleetController(
+            router, lambda i: FakeReplica(f"auto-{i}", capacity=8))
+        rids = [router.add_request([1], SamplingParams(max_new_tokens=6))
+                for _ in range(3)]
+        router.step()                           # all running on ra
+        ctl.scale_to(2)                         # peer appears
+        router.step()
+        ctl.scale_to(1)                         # ra or peer retires
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert all(final[r].finish_reason == "length" for r in rids)
+        assert all(len(final[r].generated) == 6 for r in rids)
+        assert len(router.replicas) == 1
+
+    def test_autoscale_tick_counters(self):
+        busy = FakeReplica("ra", capacity=16)
+        busy.reqs = {f"x{i}": [SamplingParams(max_new_tokens=99), []]
+                     for i in range(8)}         # occupancy 8 -> load 1.0
+        router = FleetRouter([busy])
+        ctl = FleetController(
+            router, lambda i: FakeReplica(f"auto-{i}"),
+            policy=LoadThresholdPolicy(high=0.8, low=0.2,
+                                       max_replicas=2))
+        assert ctl.tick() == 2                  # scaled up
+        assert router.num_scale_ups == 1
+        busy.reqs.clear()
+        assert ctl.tick() == 1                  # scaled back down
+        assert router.num_scale_downs == 1
+        assert router.num_autoscale_decisions == 2
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+class TestFleetMetrics:
+    def test_profiler_gauges_and_snapshot(self):
+        router = FleetRouter([FakeReplica("ra")])
+        router.add_request([1, 2], SamplingParams(max_new_tokens=3,
+                                                  tenant_id="t"))
+        _drain_router(router)
+        tag = f"#{id(router)}"
+        cs = {k: v for k, v in profiler.counters().items()
+              if k.endswith(tag)}
+        assert cs[f"fleet/dispatched{tag}"] == 1
+        assert cs[f"fleet/replicas_live{tag}"] == 1
+        assert cs[f"fleet/tenant_waiting{tag}"] == 0
+        snap = router.snapshot()
+        for key in ("fleet_dispatched", "fleet_handoffs",
+                    "fleet_rejected_fleetwide", "fleet_replicas_live",
+                    "fleet_replicas_dead", "fleet_tokens_emitted",
+                    "fleet_tokens_per_sec", "fleet_load",
+                    "fleet_finish", "fleet_tenants", "replicas"):
+            assert key in snap, key
+        assert snap["fleet_finish"] == {"length": 1}
+        assert snap["fleet_tokens_emitted"] == 3
+        assert snap["replicas"]["ra"]["alive"] is True
+
+    def test_dropped_router_unregisters_providers(self):
+        router = FleetRouter([FakeReplica("ra")])
+        tag = f"#{id(router)}"
+        assert any(k.endswith(tag) for k in profiler.counters())
+        del router
+        import gc
+        gc.collect()
+        assert not any(k.endswith(tag) for k in profiler.counters())
+
+
+# ---------------------------------------------------------------------------
+# tiny-Llama e2e: the token-identity acceptance pins
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _ecfg(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("max_model_len", 64)
+    return EngineConfig(**kw)
+
+
+def _prompts(seed, vocab, lens):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, vocab, size=n))) for n in lens]
+
+
+def _reference(model, prompts, sp, ids):
+    """Uninterrupted single-engine run: the token-identity oracle.
+    Request ids matter — the per-request sampling stream seeds from
+    the id."""
+    eng = LLMEngine(model, _ecfg())
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        assert steps < 500
+    return {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+
+class TestFleetE2E:
+    def test_two_replica_parity_with_single_engine(self, tiny_model):
+        m = tiny_model
+        prompts = _prompts(11, m.config.vocab_size, [3, 5, 7, 4, 6, 2])
+        sp = SamplingParams(max_new_tokens=6)
+        ids = [f"p{i}" for i in range(len(prompts))]
+        ref = _reference(m, prompts, sp, ids)
+        router = FleetRouter([
+            InProcessReplica(m, _ecfg(), replica_id=f"r{i}")
+            for i in range(2)])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router, max_steps=500)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert set(final) == set(ids)
+        assert {o.finish_reason for o in final.values()} == {"length"}
+        for rid in ids:
+            assert final[rid].generated == ref[rid], rid
+        assert router.num_dispatched == 6
+        assert router.num_handoffs == 0
+        # both engines saw work (6 requests over 4-seat replicas)
+        assert all(router._by_id(f"r{i}").engine.finish_counts
+                   .get("length", 0) > 0 for i in range(2))
+        snap = router.snapshot()
+        assert snap["fleet_finish"] == {"length": 6}
+        # per-replica engine histograms sum to the client view here
+        # (no hand-offs happened, so no double counting)
+        engine_lengths = sum(
+            rec.get("serving_finish/length", 0)
+            for rec in snap["replicas"].values())
+        assert engine_lengths == 6
+
+    @pytest.mark.parametrize("sp", [
+        SamplingParams(max_new_tokens=8),
+        SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9),
+    ], ids=["greedy", "sampled"])
+    def test_drain_handoff_token_identical(self, tiny_model, sp):
+        """THE acceptance pin: preempt one replica of two mid-run with
+        zero drain grace — every request finishes 'stop'/'length' with
+        generations bit-identical to an uninterrupted single engine,
+        and the client never sees aborted:drain."""
+        m = tiny_model
+        prompts = _prompts(12, m.config.vocab_size, [3, 5, 4, 6, 2, 5])
+        ids = [f"q{i}" for i in range(len(prompts))]
+        ref = _reference(m, prompts, sp, ids)
+        mon = PreemptionMonitor()
+        router = FleetRouter([
+            InProcessReplica(m, _ecfg(drain_grace_s=0.0),
+                             replica_id="r0", monitor=mon),
+            InProcessReplica(m, _ecfg(drain_grace_s=0.0),
+                             replica_id="r1")])
+        try:
+            for rid, p in zip(ids, prompts):
+                router.add_request(rid, p, sampling=sp)
+            outs = []
+            for _ in range(3):
+                outs.extend(router.step())
+            r0 = router._by_id("r0")
+            assert r0.engine.scheduler.num_running > 0  # mid-generation
+            mon.request()          # preemption notice -> r0 drains
+            outs.extend(_drain_router(router, max_steps=500))
+        finally:
+            mon.uninstall()
+        final = {o.request_id: o for o in outs if o.finished}
+        assert set(final) == set(ids)
+        assert all(final[r].finish_reason in ("stop", "length")
+                   for r in ids)
+        for rid in ids:
+            assert final[rid].generated == ref[rid], rid
+        assert router.num_handoffs >= 1
+        # at least one hand-off was mid-generation (resume-by-recompute
+        # actually exercised, not just a queued-request migration)
+        assert any(router.get_request(r).handoffs > 0
+                   and len(final[r].generated) == 8 for r in ids)
+        assert "aborted:drain" not in router.finish_counts
+
+    def test_single_replica_drain_keeps_pr6_semantics(self, tiny_model):
+        """No peer -> the PR-6 contract is unchanged: waiting/running
+        requests abort structured with partial progress kept."""
+        m = tiny_model
+        prompts = _prompts(13, m.config.vocab_size, [3, 4, 5, 3])
+        mon = PreemptionMonitor()
+        router = FleetRouter([InProcessReplica(
+            m, _ecfg(drain_grace_s=0.0), replica_id="solo",
+            monitor=mon)])
+        try:
+            rids = [router.add_request(p, sampling=SamplingParams(
+                max_new_tokens=8)) for p in prompts]
+            outs = []
+            for _ in range(3):
+                outs.extend(router.step())
+            mon.request()
+            outs.extend(_drain_router(router, max_steps=500))
+        finally:
+            mon.uninstall()
+        final = {o.request_id: o for o in outs if o.finished}
+        assert set(final) == set(rids)
+        drained = [r for r in rids
+                   if final[r].finish_reason == "aborted:drain"]
+        assert drained                         # aborts SURFACED
+        assert router.num_handoffs == 0
+        # mid-generation victims keep their partial progress
+        assert any(final[r].generated for r in drained)
+        assert router.finish_counts.get("aborted:drain") == len(drained)
+
+    def test_kill_replica_fault_recovers_with_parity(self, tiny_model):
+        m = tiny_model
+        prompts = _prompts(14, m.config.vocab_size, [3, 5, 4, 6, 2, 5])
+        sp = SamplingParams(max_new_tokens=6)
+        ids = [f"k{i}" for i in range(len(prompts))]
+        ref = _reference(m, prompts, sp, ids)
+        router = FleetRouter([
+            InProcessReplica(m, _ecfg(), replica_id=f"r{i}")
+            for i in range(2)])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install("fleet.kill_replica:flag:r0@4*1")
+        outs = _drain_router(router, max_steps=500)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert set(final) == set(ids)
+        for rid in ids:
+            assert final[rid].generated == ref[rid], rid
+        assert router.num_replicas_dead == 1
+        assert router._by_id("r0").alive is False
+        assert router.num_handoffs >= 1
+        assert "aborted:error" not in router.finish_counts
+
+    def test_scale_up_down_e2e(self, tiny_model):
+        m = tiny_model
+        router = FleetRouter([InProcessReplica(m, _ecfg(),
+                                               replica_id="e0")])
+        ctl = FleetController(
+            router, lambda i: InProcessReplica(m, _ecfg(),
+                                               replica_id=f"e{i}"))
+        prompts = _prompts(15, m.config.vocab_size, [3, 4, 5, 4])
+        rids = [router.add_request(p, sampling=SamplingParams(
+            max_new_tokens=4)) for p in prompts]
+        router.step()
+        ctl.scale_to(2)
+        outs = _drain_router(router, max_steps=500)
+        ctl.scale_to(1)
+        for _ in range(20):
+            router.step()
+            if len(router.replicas) == 1:
+                break
+        final = {o.request_id: o for o in outs if o.finished}
+        assert all(final[r].finish_reason == "length" for r in rids)
+        assert router.num_scale_ups == 1
+        assert router.num_scale_downs == 1
+        assert len(router.replicas) == 1
