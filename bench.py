@@ -237,8 +237,12 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
     them through the paged-KV engine to completion, report tokens/s,
     TTFT, TPOT and batch occupancy. A compile-warmup pass runs first so
     the measured window reports steady-state serving, not XLA compiles
-    (the bucketed shapes compile once each). ``tiny=True`` is the
-    XLA:CPU smoke config the slow-marked tier test runs."""
+    (the default engine is now the ragged single-shape step, so warmup
+    compiles exactly one step function). ``tiny=True`` is the XLA:CPU
+    smoke config the slow-marked tier test runs. A trailing comparison
+    phase (ISSUE 9) runs one shared-prefix workload through a bucketed
+    AND a ragged engine and reports the padding/prefix-cache/compile
+    deltas as ``extra["ragged_comparison"]``."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -325,6 +329,69 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
     resilience = {k: v for k, v in r_snap.items()
                   if k.startswith("serving_") or k == "preemptions"}
 
+    # ragged hot-path comparison (ISSUE 9): the SAME shared-prefix
+    # two-wave workload through a bucketed engine and a ragged one
+    # (single compiled step + chunked prefill + COW prefix cache).
+    # Wave 1 is each engine's compile warmup; wave 2 is timed, and on
+    # the ragged engine its re-sent shared prefix takes real COW
+    # prefix-cache hits. Token parity is asserted first, so the
+    # speedup column never compares different outputs.
+    cmp_rng = np.random.RandomState(seed + 1)
+    shared = list(cmp_rng.randint(0, cfg.vocab_size, size=24))
+    cmp_prompts = [
+        shared + list(cmp_rng.randint(0, cfg.vocab_size, size=8)),
+        list(cmp_rng.randint(0, cfg.vocab_size, size=3)),
+        shared + list(cmp_rng.randint(0, cfg.vocab_size, size=5)),
+        list(cmp_rng.randint(0, cfg.vocab_size, size=6)),
+    ]
+    cmp_sp = [
+        SamplingParams(max_new_tokens=6),
+        SamplingParams(max_new_tokens=5, temperature=0.8, seed=7),
+        SamplingParams(max_new_tokens=6),
+        SamplingParams(max_new_tokens=4),
+    ]
+
+    def run_cmp(ragged):
+        e = LLMEngine(model, EngineConfig(
+            block_size=4, max_num_seqs=4, max_model_len=64,
+            max_batched_tokens=16,   # < the long prompts: forces chunks
+            ragged=ragged, chunked_prefill=ragged, prefix_cache=ragged))
+        outs, dt_wave = [], 0.0
+        for wave in range(2):
+            rids = [e.add_request(p, sampling=s)
+                    for p, s in zip(cmp_prompts, cmp_sp)]
+            t = time.perf_counter()
+            while e.has_unfinished():
+                e.step()
+            dt_wave = time.perf_counter() - t   # keep wave 2's time
+            outs.append([e.get_request(r).generated for r in rids])
+        return e, outs, dt_wave
+
+    c_eng_r, c_outs_r, c_dt_r = run_cmp(True)
+    c_eng_b, c_outs_b, c_dt_b = run_cmp(False)
+    assert c_outs_r == c_outs_b, "ragged != bucketed token streams"
+    c_snap_r = c_eng_r.metrics.snapshot()
+    c_snap_b = c_eng_b.metrics.snapshot()
+    assert c_snap_r["padded_token_frac"] == 0.0, c_snap_r
+    assert c_snap_b["padded_token_frac"] > 0.0, c_snap_b
+    assert c_snap_r["serving_prefix_cache_hits"] > 0, c_snap_r
+    assert len(c_eng_r._seen_shapes) == 1, c_eng_r._seen_shapes
+    c_gen = sum(len(toks) for toks in c_outs_r[1])
+    ragged_cmp = {
+        "ragged_tokens_per_sec": round(c_gen / c_dt_r, 2),
+        "bucketed_tokens_per_sec": round(c_gen / c_dt_b, 2),
+        "ragged_vs_bucketed": round(c_dt_b / c_dt_r, 3),
+        "ragged_compiled_step_shapes": len(c_eng_r._seen_shapes),
+        "bucketed_compiled_step_shapes": len(c_eng_b._seen_shapes),
+        "ragged_padded_token_frac": c_snap_r["padded_token_frac"],
+        "bucketed_padded_token_frac": c_snap_b["padded_token_frac"],
+        "prefix_cache_hits": c_snap_r["serving_prefix_cache_hits"],
+        "prefix_cache_hit_tokens":
+            c_snap_r["serving_prefix_cache_hit_tokens"],
+        "prefill_chunks": c_snap_r["serving_prefill_chunks"],
+        "mixed_steps": c_snap_r["mixed_steps"],
+    }
+
     return {
         "metric": "serving_tokens_per_sec",
         "value": round(snap["num_generated_tokens"] / dt, 2),
@@ -339,6 +406,7 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
             "wall_s": round(dt, 3),
             **snap,
             "resilience_smoke": resilience,
+            "ragged_comparison": ragged_cmp,
         },
     }
 

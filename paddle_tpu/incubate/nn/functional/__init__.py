@@ -15,13 +15,14 @@ from paddle_tpu.ops import registry as _registry
 from paddle_tpu.ops.registry import register_emitter as _register
 
 from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: F401
-    block_multihead_attention, paged_attention,
+    block_multihead_attention, paged_attention, ragged_paged_attention,
     variable_length_memory_efficient_attention,
 )
 
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm", "swiglu",
            "variable_length_memory_efficient_attention",
-           "paged_attention", "block_multihead_attention"]
+           "paged_attention", "block_multihead_attention",
+           "ragged_paged_attention"]
 
 
 @_register(name="swiglu")

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["variable_length_memory_efficient_attention",
-           "paged_attention", "block_multihead_attention"]
+           "paged_attention", "block_multihead_attention",
+           "ragged_paged_attention"]
 
 
 def _data(x):
@@ -116,6 +117,26 @@ def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return _wrap(jnp.einsum("bht,bthd->bhd", probs.astype(v_seq.dtype),
                             v_seq))
+
+
+def ragged_paged_attention(q, k_new, v_new, key_cache, value_cache,
+                           block_tables, cu_seqlens, context_lens,
+                           num_seqs, scale=None):
+    """Unpadded prefill+decode attention over a concatenated token stream
+    (ops/pallas/ragged_paged_attention.py; arxiv 2604.15464). q/k_new/
+    v_new: (T, H|KH, D) ragged-packed rows; cu_seqlens (S+1,) delimits
+    sequence slots, context_lens (S,) is the post-step cache length per
+    slot, block_tables (S, MB) the paged-cache indirection. Returns
+    (out (T, H, D), key_cache', value_cache') — caches are returned, not
+    mutated (in-place on TPU is buffer donation at the jit boundary)."""
+    from paddle_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention as _rpa)
+
+    out, kc, vc = _rpa(
+        _data(q), _data(k_new), _data(v_new), _data(key_cache),
+        _data(value_cache), _data(block_tables), _data(cu_seqlens),
+        _data(context_lens), _data(num_seqs), scale=scale)
+    return _wrap(out), _wrap(kc), _wrap(vc)
 
 
 def _write_cache(cache, blocks, block_tables, positions):

@@ -226,6 +226,30 @@ class LlamaAttention(nn.Layer):
         out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
         return self.o_proj(out), kc, vc
 
+    def forward_ragged(self, x, cos, sin, key_cache, value_cache,
+                       block_tables, cu_seqlens, context_lens, num_seqs):
+        """Serving attention over a ragged-packed token stream. ``x``
+        (1,T,h) — the whole step's tokens concatenated with no per-row
+        padding; ``cos``/``sin`` (1,T,D) gathered at absolute positions;
+        ``cu_seqlens`` (S+1,) delimits sequence slots. Returns
+        (out (1,T,h), key_cache', value_cache')."""
+        from paddle_tpu.incubate.nn import functional as F
+
+        b, t, _ = x.shape
+        q = ops.reshape(self.q_proj(x),
+                        [b, t, self.n_heads, self.head_dim])._data
+        k = ops.reshape(self.k_proj(x),
+                        [b, t, self.n_kv, self.head_dim])._data
+        v = ops.reshape(self.v_proj(x),
+                        [b, t, self.n_kv, self.head_dim])._data
+        q, k = _rope_apply_at(q, k, cos, sin)
+        out, kc, vc = F.ragged_paged_attention(
+            q[0], k[0], v[0], key_cache, value_cache,
+            block_tables=block_tables, cu_seqlens=cu_seqlens,
+            context_lens=context_lens, num_seqs=num_seqs)
+        out = ops.reshape(out, [1, t, self.n_heads * self.head_dim])
+        return self.o_proj(out), kc, vc
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -289,6 +313,21 @@ class LlamaDecoderLayer(nn.Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, kc, vc
 
+    def forward_ragged(self, x, positions, key_cache, value_cache,
+                       block_tables, cu_seqlens, context_lens, num_seqs):
+        """One decoder block over the ragged stream. ``positions`` (T,)
+        absolute token positions (pad rows hold any in-range value — the
+        attention op zeroes their outputs)."""
+        pos = jnp.clip(positions, 0, self.rope_cos.shape[0] - 1)
+        cos = self.rope_cos._data[pos][None]   # (1, T, D)
+        sin = self.rope_sin._data[pos][None]
+        attn_out, kc, vc = self.self_attn.forward_ragged(
+            self.input_layernorm(x), cos, sin, key_cache, value_cache,
+            block_tables, cu_seqlens, context_lens, num_seqs)
+        h = x + attn_out
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, kc, vc
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -347,6 +386,47 @@ class LlamaModel(nn.Layer):
         return (self.norm(x), jnp.stack(new_k, axis=0),
                 jnp.stack(new_v, axis=0))
 
+    def forward_ragged(self, input_ids, key_caches, value_caches,
+                       block_tables, cu_seqlens, context_lens, num_seqs):
+        """Ragged-packed KV-cache forward: ``input_ids`` (T,) is every
+        sequence's new tokens concatenated (no padding rows between
+        sequences); ``cu_seqlens`` (S+1,) delimits slots and
+        ``context_lens`` (S,) is each slot's post-step cache length.
+        Prefill, chunked prefill and decode rows are all the same shape
+        here — ONE compiled step covers a whole continuous batch.
+        Returns (hidden (1,T,h), key_caches', value_caches')."""
+        kcs = key_caches._data if isinstance(key_caches, Tensor) \
+            else jnp.asarray(key_caches)
+        vcs = value_caches._data if isinstance(value_caches, Tensor) \
+            else jnp.asarray(value_caches)
+        cu = (cu_seqlens._data if isinstance(cu_seqlens, Tensor)
+              else jnp.asarray(cu_seqlens)).astype(jnp.int32)
+        ctx = (context_lens._data if isinstance(context_lens, Tensor)
+               else jnp.asarray(context_lens)).astype(jnp.int32)
+        if not isinstance(input_ids, Tensor):
+            input_ids = Tensor(input_ids)
+        ids2 = ops.reshape(input_ids, [1, -1])
+        t = ids2.shape[1]
+        s_slots = ctx.shape[0]
+        # absolute position of token row r of slot i:
+        # ctx[i] - (cu[i+1]-cu[i]) + r — pad rows clamp into range and
+        # are masked downstream by cu_seqlens/num_seqs
+        tok = jnp.arange(t, dtype=jnp.int32)
+        seg = jnp.clip(jnp.searchsorted(cu, tok, side="right") - 1,
+                       0, s_slots - 1).astype(jnp.int32)
+        positions = jnp.maximum(
+            ctx[seg] - (cu[seg + 1] - cu[seg]) + (tok - cu[seg]), 0)
+        x = self.embed_tokens(ids2)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            x, kc, vc = layer.forward_ragged(
+                x, positions, kcs[i], vcs[i], block_tables,
+                cu, ctx, num_seqs)
+            new_k.append(kc._data if isinstance(kc, Tensor) else kc)
+            new_v.append(vc._data if isinstance(vc, Tensor) else vc)
+        return (self.norm(x), jnp.stack(new_k, axis=0),
+                jnp.stack(new_v, axis=0))
+
 
 class LlamaPretrainingCriterion(nn.Layer):
     """Shift-label LM loss (vocab-parallel aware)."""
@@ -397,6 +477,29 @@ class LlamaForCausalLM(nn.Layer):
         b = hd.shape[0]
         last = jnp.clip(now - 1, 0, hd.shape[1] - 1)
         h_last = hd[jnp.arange(b), last]              # (B, hidden)
+        logits = self.lm_head(Tensor._from_data(h_last))
+        return logits, kcs, vcs
+
+    def forward_ragged(self, input_ids, key_caches, value_caches,
+                       block_tables, cu_seqlens, context_lens, num_seqs):
+        """Ragged serving step: one unpadded forward over the packed
+        token stream + lm_head on each slot's LAST packed token (the
+        sampling position; for a mid-prompt prefill chunk the engine
+        discards the row). Returns (logits (S, vocab), key_caches',
+        value_caches') — S is the fixed number of sequence slots, so a
+        mixed prefill/decode continuous batch has exactly ONE compiled
+        shape (the bucket lattice collapses to this function)."""
+        h, kcs, vcs = self.llama.forward_ragged(
+            input_ids, key_caches, value_caches, block_tables,
+            cu_seqlens, context_lens, num_seqs)
+        cu = (cu_seqlens._data if isinstance(cu_seqlens, Tensor)
+              else jnp.asarray(cu_seqlens)).astype(jnp.int32)
+        hd = h._data if isinstance(h, Tensor) else h
+        t = hd.shape[1]
+        # pad slots point at cu[num_seqs]-1 (a real row) — harmless, the
+        # engine never samples them
+        last = jnp.clip(cu[1:] - 1, 0, t - 1)
+        h_last = hd[0, last]                           # (S, hidden)
         logits = self.lm_head(Tensor._from_data(h_last))
         return logits, kcs, vcs
 

@@ -5,3 +5,4 @@ Kernels run natively on TPU; everywhere else (CPU tests) they run in
 Pallas interpret mode so numerics are verifiable without hardware.
 """
 from paddle_tpu.ops.pallas import flash_attention  # noqa: F401
+from paddle_tpu.ops.pallas import ragged_paged_attention  # noqa: F401

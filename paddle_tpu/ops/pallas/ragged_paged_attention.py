@@ -1,0 +1,306 @@
+"""Ragged paged attention: one kernel over a concatenated token stream.
+
+Reference capability: the serving hot path the reference covers with fused
+CUDA block-attention kernels; the TPU-native design follows "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for TPU"
+(arxiv 2604.15464) — prefill and decode rows of a continuous batch are
+packed into ONE unpadded token stream and attended in a single invocation
+against the paged KV cache, so a mixed step has exactly one compiled
+shape: (token_budget, num_seq_slots).
+
+Contract (all data-level jnp arrays):
+
+* ``q``:            (T, H, D)   new-token queries, ragged-packed; rows in
+                                [cu_seqlens[i], cu_seqlens[i+1]) belong to
+                                sequence slot i; rows >= cu_seqlens[num_seqs]
+                                are padding.
+* ``k_new/v_new``:  (T, KH, D)  new K/V for the same rows (GQA: KH <= H).
+* ``key_cache/value_cache``: (num_blocks, block_size, KH, D) paged cache.
+* ``block_tables``: (S, MB) int32 physical block ids per slot (-1 pads).
+* ``cu_seqlens``:   (S+1,) int32 exclusive prefix sum of per-slot new-token
+                    counts (cu_seqlens[0] == 0).
+* ``context_lens``: (S,) int32 total tokens in cache per slot AFTER this
+                    step's new tokens are written (prefix + new).
+* ``num_seqs``:     int32 scalar — live slots; trailing slots are padding.
+
+Returns ``(out (T, H, D), key_cache', value_cache')``: new K/V scattered
+into their paged slots (functional update — in-place on TPU is buffer
+donation at the jit boundary), and each query row attends causally to its
+sequence's cache prefix up to and including its own absolute position.
+A decode row is simply a 1-token sequence (cu delta 1, context > 1); a
+prefill chunk is an n-token sequence whose positions start mid-context —
+both are the same code path, which is what makes chunked prefill free.
+
+Two implementations, shape-identical:
+
+* ``_ragged_attend_ref`` — pure jnp gather/einsum. The semantics oracle
+  and the CI path (the CPU container cannot execute TPU Pallas natively).
+* ``_ragged_attend_pallas`` — Pallas TPU kernel, grid (S, q_blocks,
+  kv_blocks) with scalar-prefetched cu_seqlens/context_lens/block_tables;
+  online-softmax accumulators in VMEM scratch; out-of-range and
+  post-causal blocks are skipped entirely, so padded slots cost zero.
+
+Selection: Pallas on TPU, reference elsewhere; override with ``impl=`` or
+``PADDLE_RAGGED_ATTN_IMPL=ref|pallas|interpret`` (interpret runs the
+kernel through the Pallas interpreter — slow, test-only).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu import works even on CPU; kernels then need interpret=True
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+__all__ = ["ragged_paged_attention", "available"]
+
+
+def available():
+    """Whether the Pallas kernel path can be built (native on TPU,
+    interpret elsewhere)."""
+    return pltpu is not None
+
+
+def _pick_block_q(t):
+    for b in (128, 64, 32, 16, 8):
+        if b <= t:
+            return b
+    return t
+
+
+# ---------------------------------------------------------------------------
+# shared prelude: token layout + cache scatter
+# ---------------------------------------------------------------------------
+def _token_layout(t_total, s_slots, cu, ctx, num_seqs):
+    """Per-token (segment id, absolute position, validity) for the packed
+    stream. Padding tokens get pos == -1."""
+    t = jnp.arange(t_total, dtype=jnp.int32)
+    seg = jnp.clip(jnp.searchsorted(cu, t, side="right") - 1,
+                   0, s_slots - 1).astype(jnp.int32)
+    valid = (t < cu[num_seqs]) & (seg < num_seqs)
+    nq = cu[seg + 1] - cu[seg]
+    pos = ctx[seg] - nq + (t - cu[seg])
+    pos = jnp.where(valid & (pos >= 0), pos, -1)
+    return seg, pos, valid
+
+
+def _write_kv(cache, new, block_tables, seg, pos):
+    """Scatter packed new K/V rows into their paged slots; pos == -1 rows
+    (and rows whose block-table entry is -1) scatter out of range and are
+    DROPPED — routing them to slot 0 would clobber real cached tokens."""
+    bs = cache.shape[1]
+    blk = jnp.where(pos >= 0, pos // bs, 0)
+    off = jnp.where(pos >= 0, pos % bs, 0)
+    entry = block_tables[seg, blk]                       # (T,)
+    valid = (pos >= 0) & (entry >= 0)
+    flat = jnp.maximum(entry, 0) * bs + off
+    cache_flat = cache.reshape(-1, *cache.shape[2:])
+    fi = jnp.where(valid, flat, cache_flat.shape[0])
+    cache_flat = cache_flat.at[fi].set(new.astype(cache.dtype),
+                                       mode="drop")
+    return cache_flat.reshape(cache.shape)
+
+
+# ---------------------------------------------------------------------------
+# reference implementation (semantics oracle; the CI path)
+# ---------------------------------------------------------------------------
+def _ragged_attend_ref(q, kc, vc, bt, ctx, seg, pos, valid, scale):
+    t_total, h, d = q.shape
+    nb, bs, kh, _ = kc.shape
+    mb = bt.shape[1]
+    bt_tok = bt[seg]                                     # (T, MB)
+    safe = jnp.maximum(bt_tok, 0)
+    k_seq = kc[safe].reshape(t_total, mb * bs, kh, d)
+    v_seq = vc[safe].reshape(t_total, mb * bs, kh, d)
+    if kh != h:
+        rep = h // kh
+        k_seq = jnp.repeat(k_seq, rep, axis=2)
+        v_seq = jnp.repeat(v_seq, rep, axis=2)
+    logits = jnp.einsum("thd,tlhd->thl", q, k_seq) * scale
+    lpos = jnp.arange(mb * bs, dtype=jnp.int32)[None, :]
+    att = ((lpos <= pos[:, None])
+           & (bt_tok >= 0).repeat(bs, axis=1)
+           & valid[:, None])                             # (T, L)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    logits = jnp.where(att[:, None, :], logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("thl,tlhd->thd", probs.astype(v_seq.dtype), v_seq)
+    # where, not multiply: padded q rows may be NaN and NaN * 0 == NaN
+    return jnp.where(valid[:, None, None], out, 0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _ragged_kernel(cu_ref, ctx_ref, ns_ref, bt_ref,   # scalar prefetch
+                   q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *,
+                   scale, block_q, block_size, t_total, n_heads, kv_heads):
+    i = pl.program_id(0)          # sequence slot
+    qb = pl.program_id(1)         # q block within the slot's token window
+    j = pl.program_id(2)          # kv block (position within block table)
+
+    nq = cu_ref[i + 1] - cu_ref[i]
+    ctx = ctx_ref[i]
+    # last absolute position covered by this q block (causal upper bound)
+    hi = ctx - nq + jnp.minimum(nq, (qb + 1) * block_q) - 1
+    last_j = jnp.maximum(hi, 0) // block_size
+    run = ((i < ns_ref[0]) & (qb * block_q < nq)
+           & (j * block_size <= hi))
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # q window start, clamped so the block stays in bounds; `shift` rows at
+    # the front of the loaded window belong to earlier (already-stored)
+    # tokens and are masked out of both the math and the store
+    raw_start = cu_ref[i] + qb * block_q
+    qs = jnp.minimum(raw_start, t_total - block_q)
+    shift = raw_start - qs
+    rep = n_heads // kv_heads
+
+    @pl.when(run)
+    def _():
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_size), 0)
+        col = (j * block_size
+               + jax.lax.broadcasted_iota(jnp.int32,
+                                          (block_q, block_size), 1))
+        local = qb * block_q + (row - shift)             # seq-local q index
+        qpos = ctx - nq + local                          # absolute position
+        mask = (row >= shift) & (local < nq) & (col <= qpos)
+        for h in range(n_heads):
+            qh = pl.load(q_ref,
+                         (pl.ds(qs, block_q), pl.ds(h, 1),
+                          slice(None)))[:, 0, :]
+            kh_blk = k_ref[0, :, h // rep, :]
+            s = jax.lax.dot_general(
+                qh, kh_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, s, _NEG_INF)
+            m_prev = m_scr[h, :, :1]
+            l_prev = l_scr[h, :, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            vh_blk = v_ref[0, :, h // rep, :]
+            acc_scr[h] = acc_scr[h] * alpha + jax.lax.dot_general(
+                p.astype(vh_blk.dtype), vh_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[h] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+            l_scr[h] = jnp.broadcast_to(l_new, l_scr.shape[1:])
+
+    @pl.when(run & (j == last_j))
+    def _():
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        ok = (row >= shift) & ((qb * block_q + row - shift) < nq)
+        for h in range(n_heads):
+            l = l_scr[h, :, :1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            val = (acc_scr[h] / l_safe).astype(o_ref.dtype)
+            # read-modify-write: rows outside this window (clamp overlap)
+            # must keep the values earlier grid steps stored
+            idx = (pl.ds(qs, block_q), pl.ds(h, 1), slice(None))
+            cur = pl.load(o_ref, idx)[:, 0, :]
+            pl.store(o_ref, idx, jnp.where(ok, val, cur)[:, None, :])
+
+
+def _ragged_attend_pallas(q, kc, vc, bt, cu, ctx, num_seqs, valid, scale,
+                          interpret):
+    t_total, h, d = q.shape
+    nb, bs, kh, _ = kc.shape
+    s_slots, mb = bt.shape
+    block_q = _pick_block_q(t_total)
+    n_qb = -(-t_total // block_q)
+    ns = jnp.reshape(num_seqs.astype(jnp.int32), (1,))
+    bt_flat = jnp.maximum(bt, 0).reshape(-1).astype(jnp.int32)
+
+    def kv_map(i, qb, j, cu_r, ctx_r, ns_r, bt_r):
+        return (bt_r[i * mb + j], 0, 0, 0)
+
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, block_q=block_q, block_size=bs,
+        t_total=t_total, n_heads=h, kv_heads=kh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(s_slots, n_qb, mb),
+        in_specs=[
+            pl.BlockSpec(memory_space=_VMEM),            # q, whole array
+            pl.BlockSpec((1, bs, kh, d), kv_map, memory_space=_VMEM),
+            pl.BlockSpec((1, bs, kh, d), kv_map, memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=_VMEM),      # out, whole array
+        scratch_shapes=[
+            _VMEM((h, block_q, 128), jnp.float32),
+            _VMEM((h, block_q, 128), jnp.float32),
+            _VMEM((h, block_q, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_total, h, d), q.dtype),
+        interpret=interpret,
+    )(cu.astype(jnp.int32), ctx.astype(jnp.int32), ns, bt_flat, q, kc, vc)
+    # padded rows were never visited by the grid and hold uninitialized
+    # garbage: force them to zero (where, not multiply — NaN * 0 == NaN)
+    return jnp.where(valid[:, None, None], out, 0)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+def ragged_paged_attention(q, k_new, v_new, key_cache, value_cache,
+                           block_tables, cu_seqlens, context_lens,
+                           num_seqs, *, scale=None, impl=None):
+    """See module docstring for the contract. Returns (out, kc', vc')."""
+    q = jnp.asarray(q)
+    k_new = jnp.asarray(k_new)
+    v_new = jnp.asarray(v_new)
+    key_cache = jnp.asarray(key_cache)
+    value_cache = jnp.asarray(value_cache)
+    t_total, h, d = q.shape
+    s_slots, _ = block_tables.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if impl is None:
+        impl = os.environ.get("PADDLE_RAGGED_ATTN_IMPL") or (
+            "pallas" if (jax.default_backend() == "tpu" and available())
+            else "ref")
+    cu = jnp.asarray(cu_seqlens).astype(jnp.int32)
+    ctx = jnp.asarray(context_lens).astype(jnp.int32)
+    bt = jnp.asarray(block_tables).astype(jnp.int32)
+    ns = jnp.asarray(num_seqs).astype(jnp.int32)
+
+    seg, pos, valid = _token_layout(t_total, s_slots, cu, ctx, ns)
+    kc = _write_kv(key_cache, k_new, bt, seg, pos)
+    vc = _write_kv(value_cache, v_new, bt, seg, pos)
+
+    if impl == "ref":
+        out = _ragged_attend_ref(q, kc, vc, bt, ctx, seg, pos, valid,
+                                 scale)
+    elif impl in ("pallas", "interpret"):
+        if pltpu is None:  # pragma: no cover
+            raise RuntimeError("Pallas TPU backend is unavailable")
+        out = _ragged_attend_pallas(
+            q, kc, vc, bt, cu, ctx, ns, valid, scale,
+            interpret=(impl == "interpret"
+                       or jax.default_backend() != "tpu"))
+    else:
+        raise ValueError(f"unknown ragged attention impl: {impl!r}")
+    return out, kc, vc

@@ -10,10 +10,17 @@ the TPU-native execution model:
   paged attention is the right TPU kernel shape), allocated by
   :class:`BlockManager` and attended through
   ``incubate.nn.functional.block_multihead_attention``;
-* prefill and decode are the SAME compiled function (the op's per-
-  sequence mode select), jitted over a bounded set of bucketed padded
-  shapes so XLA recompiles O(log max_len * log max_batch) times, not
-  per request;
+* prefill and decode are the SAME compiled function. On models exposing
+  ``forward_ragged`` (the default path) every iteration is ONE unpadded
+  ragged step — a packed (T,) token stream over S sequence slots, so a
+  mixed chunked-prefill/decode continuous batch has exactly one
+  compiled shape and zero attention-path padding; the legacy bucketed
+  path (``ragged=False``) jits over a bounded set of padded shapes
+  (O(log max_len * log max_batch) compiles);
+* prompt prefixes are cached: full prompt blocks register in the
+  BlockManager's content-keyed trie after the step that writes them,
+  later requests share them by refcount, and the first divergent write
+  copy-on-writes (``_apply_cow`` lands the block copies pre-step);
 * cache buffers are donated at the jit boundary on TPU (the functional
   update aliases in place — the divergence note in block_attention.py);
 * scheduling is iteration-level (:class:`Scheduler`): late arrivals
@@ -115,6 +122,17 @@ class EngineConfig:
     # slots (default: num_blocks) and restores them on re-admission
     swap_mode: str = "recompute"
     num_host_blocks: Optional[int] = None
+    # -- ragged serving hot path ----------------------------------------
+    # ragged=None auto-enables the unpadded single-shape step when the
+    # model exposes ``forward_ragged``: every iteration dispatches ONE
+    # compiled shape (token budget T x seq slots S), whatever mix of
+    # prefill chunks and decode rows fills it. chunked_prefill rides
+    # with it (a lone over-budget prompt must chunk to fit the fixed
+    # stream), as does prefix_cache (COW block sharing) unless
+    # explicitly disabled.
+    ragged: Optional[bool] = None
+    prefix_cache: Optional[bool] = None
+    chunked_prefill: Optional[bool] = None
     # admission control: reject (first-class 'rejected' output) when the
     # waiting queue is this deep, or when the estimated TTFT for a new
     # arrival exceeds the SLO (None = unbounded / no SLO)
@@ -211,12 +229,21 @@ class AdmissionController:
 class _KVSwapper:
     """Engine-side block mover for swap-based preemption: copies the
     stacked (L, nblocks, BS, KH, D) device cache slices to/from the
-    host pool. ``copy_out`` runs synchronously inside the scheduler's
-    eviction (the freed device blocks' bytes are intact until the next
-    compiled step writes them); ``copy_in`` is one scatter dispatch."""
+    host pool.
+
+    ``copy_out`` is ASYNC: it enqueues a device gather of the victim's
+    blocks (a fresh buffer, so the freed blocks may be rewritten by the
+    very next compiled step) and starts the device->host transfer
+    without blocking the scheduler; :meth:`fence` lands every pending
+    spill into the numpy host pool, and runs before any host slot is
+    read back (``copy_in``). Insertion order makes a reused host slot's
+    last writer win, so an abort-while-spilling needs no bookkeeping."""
 
     def __init__(self, engine: "LLMEngine"):
         self._eng = engine
+        # request_id -> (host slot ids, gathered K slice, gathered V
+        # slice); the device slices pin their buffers until fenced
+        self._pending: Dict[str, tuple] = {}
 
     def copy_out(self, request: Request, dev_table: List[int],
                  host_table: List[int]):
@@ -226,11 +253,30 @@ class _KVSwapper:
         # the blocks the host table covers
         dev = np.asarray(dev_table[:len(host_table)], np.int32)
         host = np.asarray(host_table, np.int32)
-        eng._host_k[:, host] = np.asarray(eng._kcs[:, dev])  # tpulint: disable=host-sync-in-traced (swap-out IS the device->host spill; a handful of KV blocks, off the step's critical path)
-        eng._host_v[:, host] = np.asarray(eng._vcs[:, dev])
+        k_slice = eng._kcs[:, dev]   # functional gather: its own buffer
+        v_slice = eng._vcs[:, dev]
+        for buf in (k_slice, v_slice):
+            start = getattr(buf, "copy_to_host_async", None)
+            if start is not None:
+                start()             # overlap D2H with the next step
+        self._pending[request.request_id] = (host, k_slice, v_slice)
+
+    def fence(self):
+        """Land every in-flight spill in the host pool (blocking). Must
+        run before host slots are read or handed to a new victim whose
+        write should win — dict insertion order already serializes the
+        latter."""
+        if not self._pending:
+            return
+        eng = self._eng
+        for host, k_slice, v_slice in self._pending.values():
+            eng._host_k[:, host] = np.asarray(k_slice)  # tpulint: disable=host-sync-in-traced (landing the async swap-out spill; a handful of KV blocks, off the step's critical path)
+            eng._host_v[:, host] = np.asarray(v_slice)
+        self._pending.clear()
 
     def copy_in(self, request: Request, host_table: List[int],
                 dev_table: List[int]):
+        self.fence()                # the spill may still be in flight
         eng = self._eng
         host = np.asarray(host_table, np.int32)
         dev = np.asarray(dev_table, np.int32)
@@ -279,14 +325,51 @@ class LLMEngine:
         if self.cfg.num_host_blocks is None:
             self.cfg.num_host_blocks = (
                 self.cfg.num_blocks if self.cfg.swap_mode == "host" else 0)
+
+        # -- ragged-path resolution (model-dependent, so not in
+        # EngineConfig.__post_init__): ragged auto-enables on models
+        # exposing forward_ragged; chunked prefill is inseparable from
+        # it (the fixed token stream cannot hold an over-budget prompt
+        # whole), prefix caching defaults on with it but may be opted
+        # out
+        if self.cfg.ragged is None:
+            self.cfg.ragged = hasattr(model, "forward_ragged")
+        elif self.cfg.ragged and not hasattr(model, "forward_ragged"):
+            raise ValueError(
+                "ragged=True needs a model exposing forward_ragged "
+                "(fall back to the bucketed path with ragged=False)")
+        if self.cfg.chunked_prefill is None:
+            self.cfg.chunked_prefill = self.cfg.ragged
+        if self.cfg.prefix_cache is None:
+            self.cfg.prefix_cache = self.cfg.ragged
+        if self.cfg.chunked_prefill != self.cfg.ragged:
+            raise ValueError(
+                "chunked_prefill rides the ragged step: a lone "
+                "over-budget prompt must chunk to fit the fixed token "
+                "stream, and the bucketed op cannot run a mid-prefill "
+                "continuation — set both or neither")
+        if self.cfg.prefix_cache and not self.cfg.ragged:
+            raise ValueError(
+                "prefix_cache needs the ragged path (the classic "
+                "scheduler never passes prompt tokens to allocate)")
+        self._ragged = bool(self.cfg.ragged)
+        # the ONE compiled token-stream width: the configured budget,
+        # clamped to the most tokens a full batch could ever schedule
+        self._ragged_T = min(self.cfg.max_batched_tokens,
+                             self.cfg.max_num_seqs * self.cfg.max_model_len)
+
         self.block_manager = BlockManager(
             self.cfg.num_blocks, self.cfg.block_size,
-            num_host_blocks=self.cfg.num_host_blocks)
+            num_host_blocks=self.cfg.num_host_blocks,
+            enable_prefix_cache=self.cfg.prefix_cache)
         self._swapper = _KVSwapper(self)
         self.scheduler = Scheduler(
             self.block_manager,
             SchedulerConfig(max_num_seqs=self.cfg.max_num_seqs,
-                            max_batched_tokens=self.cfg.max_batched_tokens),
+                            max_batched_tokens=(
+                                self._ragged_T if self._ragged
+                                else self.cfg.max_batched_tokens),
+                            chunked_prefill=self.cfg.chunked_prefill),
             swap_mode=self.cfg.swap_mode, kv_swapper=self._swapper)
         self.admission = AdmissionController(
             max_queue_depth=self.cfg.max_queue_depth,
@@ -346,6 +429,23 @@ class LLMEngine:
         self._donated = bool(donate)
         self._jstep = jax.jit(
             raw_step, donate_argnums=(4, 5) if donate else ())
+
+        if self._ragged:
+            apply_r, _, _ = functionalize(model.forward_ragged)
+
+            def raw_step_ragged(param_datas, buffer_datas, key, ids, kcs,
+                                vcs, bt, cu, ctx, nseq):
+                (logits, k2, v2), _ = apply_r(
+                    param_datas, buffer_datas, key, ids, kcs, vcs, bt,
+                    cu, ctx, nseq)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                finite = jnp.isfinite(logits).all(axis=-1)
+                return logits, greedy, finite, k2, v2
+
+            self._jstep_ragged = jax.jit(
+                raw_step_ragged, donate_argnums=(4, 5) if donate else ())
+        else:
+            self._jstep_ragged = None
         self._key = jax.random.key(0)
 
         self._requests: Dict[str, Request] = {}
@@ -634,31 +734,58 @@ class LLMEngine:
                     "request (admission validation should prevent this)")
             return outputs
         reqs = batch.requests
-        is_prefill = batch.kind == "prefill"
-        n_run = [len(r.tokens_to_run()) for r in reqs]
-        S = self._seq_bucket(max(n_run)) if is_prefill else 1
-        B = self._batch_bucket(len(reqs))
+        n_run = (list(batch.num_scheduled) if batch.num_scheduled
+                 else [len(r.tokens_to_run()) for r in reqs])
+        if self._ragged:
+            # ONE shape for every batch kind: the packed token stream
+            # (T,) plus S sequence slots — prefill chunks and decode
+            # rows differ only in their cu_seqlens deltas
+            B, S = self._ragged_T, self.cfg.max_num_seqs
+            ids = np.zeros((B,), np.int32)
+            cu = np.zeros((S + 1,), np.int32)
+            ctx = np.zeros((S,), np.int32)
+            bt = np.full((S, self.max_blocks_per_seq), -1, np.int32)
+            off = 0
+            for i, r in enumerate(reqs):
+                n = n_run[i]
+                ids[off:off + n] = r.tokens[r.num_cached:r.num_cached + n]
+                off += n
+                cu[i + 1] = off
+                ctx[i] = r.num_cached + n
+                table = self.block_manager.block_table(r.request_id)
+                bt[i, :len(table)] = table
+            cu[len(reqs) + 1:] = off
+            arrays = (ids, bt, cu, ctx, np.int32(len(reqs)))
+            padded = 0
+        else:
+            is_prefill = batch.kind == "prefill"
+            S = self._seq_bucket(max(n_run)) if is_prefill else 1
+            B = self._batch_bucket(len(reqs))
 
-        ids = np.zeros((B, S), np.int32)
-        enc = np.zeros((B,), np.int32)
-        dec = np.zeros((B,), np.int32)
-        now = np.zeros((B,), np.int32)
-        bt = np.full((B, self.max_blocks_per_seq), -1, np.int32)
-        for i, r in enumerate(reqs):
-            run = r.tokens_to_run()
-            ids[i, :len(run)] = run
-            now[i] = len(run)
-            if is_prefill:
-                enc[i] = len(run)
-            dec[i] = r.num_cached
-            table = self.block_manager.block_table(r.request_id)
-            bt[i, :len(table)] = table
+            ids = np.zeros((B, S), np.int32)
+            enc = np.zeros((B,), np.int32)
+            dec = np.zeros((B,), np.int32)
+            now = np.zeros((B,), np.int32)
+            bt = np.full((B, self.max_blocks_per_seq), -1, np.int32)
+            for i, r in enumerate(reqs):
+                run = r.tokens_to_run()
+                ids[i, :len(run)] = run
+                now[i] = len(run)
+                if is_prefill:
+                    enc[i] = len(run)
+                dec[i] = r.num_cached
+                table = self.block_manager.block_table(r.request_id)
+                bt[i, :len(table)] = table
+            arrays = (ids, bt, enc, dec, now)
+            padded = B * S - int(sum(n_run))
 
+        # pending copy-on-write block copies (prefix-cache divergence)
+        # must land before the step writes the destination blocks
+        self._apply_cow()
         all_greedy = all(r.sampling.temperature <= 0.0 for r in reqs)
         try:
             tokens_np, logits_np, finite_np = self._dispatch(
-                reqs, batch.kind, (ids, bt, enc, dec, now), B, S,
-                all_greedy)
+                reqs, batch.kind, arrays, B, S, all_greedy)
         except EngineStepError as e:
             # this step's already-produced structured outputs (flushed
             # rejections, expiries) must not vanish with the failure —
@@ -671,9 +798,26 @@ class LLMEngine:
         # logits are independent of the poisoned row)
         poisoned = self._poisoned_rows(reqs, logits_np, finite_np)
 
-        self.metrics.record_step(batch.kind, len(reqs), int(sum(n_run)),
-                                 self.cfg.max_num_seqs,
-                                 time.perf_counter() - t0)
+        if self._ragged:
+            # the mixed batch's split: prompt tokens prefilled this step
+            # vs decode rows (feeds occupancy + prompt throughput the
+            # same way the classic prefill/decode kinds did)
+            prompt_toks = sum(
+                min(n, max(len(r.prompt_ids) - r.num_cached, 0))
+                for r, n in zip(reqs, n_run))
+            decode_rows = sum(1 for r, n in zip(reqs, n_run)
+                              if n == 1 and r.num_generated > 0)
+            self.metrics.record_step(
+                batch.kind, len(reqs), int(sum(n_run)),
+                self.cfg.max_num_seqs, time.perf_counter() - t0,
+                padded_tokens=0, prompt_tokens=prompt_toks,
+                decode_rows=decode_rows)
+        else:
+            self.metrics.record_step(batch.kind, len(reqs),
+                                     int(sum(n_run)),
+                                     self.cfg.max_num_seqs,
+                                     time.perf_counter() - t0,
+                                     padded_tokens=padded)
         for i, r in enumerate(reqs):
             if i in poisoned:
                 self.scheduler.abort(r.request_id, "aborted:nonfinite")
@@ -681,6 +825,15 @@ class LLMEngine:
                 outputs.append(self._terminal_output(r))
                 continue
             r.num_cached += n_run[i]
+            if self.cfg.prefix_cache:
+                # register fully-written prompt blocks AFTER the step
+                # that wrote them (never discoverable before their K/V
+                # bytes exist on device)
+                self.block_manager.commit_prefix(
+                    r.request_id, r.prompt_ids, r.num_cached)
+            if r.num_cached < len(r.tokens):
+                continue  # mid-prefill chunk: its row logit is a prompt
+                # position — never sampled, no output this step
             token = int(tokens_np[i]) if logits_np is None \
                 else self._sample(r, logits_np[i])
             finished = r.append_token(token)
@@ -700,6 +853,18 @@ class LLMEngine:
             self._finish_drain()  # this step emptied the engine
         return outputs
 
+    def _apply_cow(self):
+        """Apply pending copy-on-write block copies (prefix-cache
+        divergence) as one batched device gather/scatter, ahead of the
+        step that writes into the fresh destination blocks."""
+        pairs = self.block_manager.take_cow_pairs()
+        if not pairs:
+            return
+        src = np.asarray([p[0] for p in pairs], np.int32)
+        dst = np.asarray([p[1] for p in pairs], np.int32)
+        self._kcs = self._kcs.at[:, dst].set(self._kcs[:, src])
+        self._vcs = self._vcs.at[:, dst].set(self._vcs[:, src])
+
     # -- the guarded compiled dispatch ----------------------------------
     def _dispatch(self, reqs, kind, arrays, B, S, all_greedy):
         """Run the compiled step under the fault-isolation envelope:
@@ -714,9 +879,15 @@ class LLMEngine:
         ``finish_reason='aborted:error'`` structured outputs and raises
         :class:`EngineStepError` carrying them (drain semantics: no
         request just vanishes)."""
-        ids, bt, enc, dec, now = arrays
-        tag = f"serving.{kind}[B={B},S={S}]"
-        cold = (kind, B, S) not in self._seen_shapes
+        if self._ragged:
+            ids, bt, cu, ctx, nseq = arrays
+            tag = f"serving.ragged[T={B},S={S}]"
+            shape_key = ("ragged", B, S)
+        else:
+            ids, bt, enc, dec, now = arrays
+            tag = f"serving.{kind}[B={B},S={S}]"
+            shape_key = (kind, B, S)
+        cold = shape_key not in self._seen_shapes
         attempt = 0
         while True:
             eid = 0
@@ -732,11 +903,18 @@ class LLMEngine:
                     eid = self._watchdog.arm(
                         tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
                 faults.fire("serving.step")  # slow/raise/sigterm point
-                logits, greedy, finite, kcs, vcs = self._jstep(
-                    [p._data for p in self._params],
-                    [b._data for b in self._buffers],
-                    self._key, ids, self._kcs, self._vcs, bt, enc, dec,
-                    now)
+                if self._ragged:
+                    logits, greedy, finite, kcs, vcs = self._jstep_ragged(
+                        [p._data for p in self._params],
+                        [b._data for b in self._buffers],
+                        self._key, ids, self._kcs, self._vcs, bt, cu,
+                        ctx, nseq)
+                else:
+                    logits, greedy, finite, kcs, vcs = self._jstep(
+                        [p._data for p in self._params],
+                        [b._data for b in self._buffers],
+                        self._key, ids, self._kcs, self._vcs, bt, enc,
+                        dec, now)
                 if self._watchdog is not None:
                     self._watchdog.attach(eid, (logits, greedy))
                 if all_greedy:
@@ -782,7 +960,7 @@ class LLMEngine:
         # commit only after a fully-successful dispatch+fetch, so a
         # retried attempt re-reads the PRE-failure cache state
         self._kcs, self._vcs = kcs, vcs
-        self._seen_shapes.add((kind, B, S))
+        self._seen_shapes.add(shape_key)
         if self._hung_tags is not None:
             # the deadline fired while this (eventually-completed)
             # dispatch was in flight: the device is unhealthy-slow;

@@ -64,7 +64,10 @@ class FleetConfig:
     """Router knobs. ``handoff=False`` degrades to PR-6 semantics on
     every replica (aborts surface to the client)."""
 
-    tenant_quantum_tokens: int = 256
+    # None = adaptive: the DRR quantum tracks the mean observed request
+    # cost, so one visit grants roughly one typical request regardless
+    # of traffic shape; an int pins the granularity explicitly
+    tenant_quantum_tokens: Optional[int] = None
     tenant_weights: Optional[Dict[str, float]] = None
     heartbeat_interval_s: float = 0.0   # 0 = every router step
     registry_ttl_s: float = 30.0

@@ -14,6 +14,14 @@ can demand), not requests, so fairness holds under skewed request
 sizes. Hand-off re-enqueues use ``front=True`` with cost 0: the request
 already paid its tenant cost when first dispatched, and a replica
 failure must not charge (or queue-jump) its tenant twice.
+
+Fairness granularity IS the quantum: a quantum much larger than the
+typical request lets one visit burst many requests from the same
+tenant before rotating. By default the quantum therefore ADAPTS — it
+tracks the mean cost of requests pushed so far (so one DRR visit
+grants roughly one typical request), starting from 256 tokens until
+the first request is observed. Passing an explicit ``quantum_tokens``
+pins it, for workloads that want a fixed granularity.
 """
 from __future__ import annotations
 
@@ -24,11 +32,18 @@ __all__ = ["TenantQueue"]
 
 
 class TenantQueue:
-    def __init__(self, quantum_tokens: int = 256,
+    #: adaptive-quantum cold start, before any request cost is observed
+    DEFAULT_QUANTUM = 256
+
+    def __init__(self, quantum_tokens: Optional[int] = None,
                  weights: Optional[Dict[str, float]] = None):
-        if quantum_tokens < 1:
+        if quantum_tokens is not None and quantum_tokens < 1:
             raise ValueError("quantum_tokens must be >= 1")
-        self.quantum = quantum_tokens
+        self._fixed_quantum = quantum_tokens
+        # observed request costs (tail-pushed, cost > 0): the adaptive
+        # quantum is their running mean
+        self._cost_sum = 0
+        self._cost_n = 0
         self.weights = dict(weights or {})
         for t, w in self.weights.items():
             if w <= 0:
@@ -38,6 +53,17 @@ class TenantQueue:
         self._order: List[str] = []   # active tenants, round-robin
         self._cursor = 0
         self._granted = False  # current tenant already got this visit's quantum
+
+    @property
+    def quantum(self) -> float:
+        """Per-visit deficit grant. Explicit when configured; otherwise
+        the mean observed request cost (one typical request per visit),
+        ``DEFAULT_QUANTUM`` until the first request arrives."""
+        if self._fixed_quantum is not None:
+            return self._fixed_quantum
+        if self._cost_n == 0:
+            return self.DEFAULT_QUANTUM
+        return max(1.0, self._cost_sum / self._cost_n)
 
     def weight(self, tenant: str) -> float:
         return self.weights.get(tenant, 1.0)
@@ -61,9 +87,14 @@ class TenantQueue:
                 self._deficit.setdefault(tenant, 0.0)
         q = self._queues[tenant]
         if front:
+            # unpop refunds and hand-off re-enqueues: the cost was
+            # already observed (or is 0) — must not skew the mean
             q.appendleft((item, int(cost)))
         else:
             q.append((item, int(cost)))
+            if cost > 0:
+                self._cost_sum += int(cost)
+                self._cost_n += 1
 
     def unpop(self, tenant: str, item, cost: int) -> None:
         """Undo a :meth:`pop`: the router pulled a request but no

@@ -46,7 +46,11 @@ class ServingMetrics:
               # resilience (ISSUE 6): lifetime engine/scheduler counters
               "num_swapped", "swapped_out", "swapped_in", "expired",
               "rejected", "step_retries", "poisoned_aborts",
-              "drain_started", "drain_aborted", "drain_completed")
+              "drain_started", "drain_aborted", "drain_completed",
+              # ragged hot path (ISSUE 9): attention-path padding waste
+              # plus prefix-cache and chunked-prefill traffic
+              "padded_token_frac", "prefix_cache_hits",
+              "prefix_cache_hit_tokens", "prefill_chunks")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -66,6 +70,10 @@ class ServingMetrics:
         "drain_started": lambda eng: eng.num_drains_started,
         "drain_aborted": lambda eng: eng.num_drain_aborted,
         "drain_completed": lambda eng: eng.num_drains_completed,
+        "prefix_cache_hits": lambda eng: eng.block_manager.num_prefix_hits,
+        "prefix_cache_hit_tokens":
+            lambda eng: eng.block_manager.num_prefix_hit_tokens,
+        "prefill_chunks": lambda eng: eng.scheduler.num_prefill_chunks,
     }
 
     def __init__(self, engine):
@@ -77,6 +85,14 @@ class ServingMetrics:
         self.engine_steps = 0
         self.prefill_steps = 0
         self.decode_steps = 0
+        self.mixed_steps = 0
+        # attention-path padding: slots the compiled step attended that
+        # held no real token (bucketed rows x longest-row padding; the
+        # ragged step packs, so it contributes zero — its fixed token
+        # budget is dense-MLP headroom, not attention work, and is NOT
+        # counted here)
+        self.num_padded_tokens = 0
+        self.num_slot_tokens = 0          # real + padded
         self.ttfts_s: List[float] = []
         self.tpots_s: List[float] = []
         # batch occupancy: scheduled seqs / max_num_seqs per decode step
@@ -90,16 +106,32 @@ class ServingMetrics:
 
     # -- recording (called by the engine) --------------------------------
     def record_step(self, kind: str, n_seqs: int, n_tokens: int,
-                    max_num_seqs: int, dt_s: Optional[float] = None):
+                    max_num_seqs: int, dt_s: Optional[float] = None,
+                    padded_tokens: int = 0,
+                    prompt_tokens: Optional[int] = None,
+                    decode_rows: Optional[int] = None):
+        """``prompt_tokens``/``decode_rows`` split a MIXED (ragged) batch
+        explicitly; None infers them from ``kind`` (the classic
+        prefill-xor-decode accounting). ``padded_tokens`` counts
+        attention-path pad slots the step attended (0 for ragged)."""
         self.engine_steps += 1
         if dt_s is not None:
             self._step_times_s.append(dt_s)
+        self.num_slot_tokens += n_tokens + padded_tokens
+        self.num_padded_tokens += padded_tokens
+        if prompt_tokens is None:
+            prompt_tokens = n_tokens if kind == "prefill" else 0
+        if decode_rows is None:
+            decode_rows = n_seqs if kind == "decode" else 0
+        self.num_prompt_tokens += prompt_tokens
         if kind == "prefill":
             self.prefill_steps += 1
-            self.num_prompt_tokens += n_tokens
         elif kind == "decode":
             self.decode_steps += 1
-            self._occupancy_sum += n_seqs / max_num_seqs
+        elif kind == "mixed":
+            self.mixed_steps += 1
+        if decode_rows:
+            self._occupancy_sum += decode_rows / max_num_seqs
             self._occupancy_n += 1
 
     def estimated_ttft_ms(self, queue_depth: int,
@@ -147,6 +179,13 @@ class ServingMetrics:
         return (self._occupancy_sum / self._occupancy_n
                 if self._occupancy_n else 0.0)
 
+    @property
+    def padded_token_frac(self) -> float:
+        """Fraction of attended token slots that were padding — the
+        waste the ragged step eliminates by construction."""
+        return (self.num_padded_tokens / self.num_slot_tokens
+                if self.num_slot_tokens else 0.0)
+
     def snapshot(self) -> Dict[str, float]:
         eng = self._engine()
         out = {
@@ -156,6 +195,8 @@ class ServingMetrics:
             "engine_steps": self.engine_steps,
             "prefill_steps": self.prefill_steps,
             "decode_steps": self.decode_steps,
+            "mixed_steps": self.mixed_steps,
+            "padded_token_frac": round(self.padded_token_frac, 4),
             "tokens_per_sec": round(self.tokens_per_sec, 2),
             "ttft_ms_avg": round(_mean(self.ttfts_s) * 1e3, 3),
             "ttft_ms_p90": round(
@@ -217,6 +258,8 @@ class ServingMetrics:
                     return eng.scheduler.num_preemptions
                 if name == "batch_occupancy":
                     return m.batch_occupancy
+                if name == "padded_token_frac":
+                    return m.padded_token_frac
                 return None
             return get
 

@@ -100,6 +100,9 @@ class Request:
     num_preemptions: int = 0
     num_swaps: int = 0
     finish_reason: Optional[str] = None
+    # True once the scheduler ever split this request's prefill into
+    # budget-sized chunks (sticky; drives the prefill_chunks metric)
+    was_chunked: bool = False
 
     def __post_init__(self):
         if not self.prompt_ids:

@@ -55,12 +55,23 @@ class SchedulerConfig:
 
     max_num_seqs: int = 8
     max_batched_tokens: int = 2048
+    # chunked prefill (the ragged engine path): schedule MIXED batches —
+    # decode rows first, then long prompts as budget-sized chunks — under
+    # a RAW token budget (the ragged step pads nothing, so raw token
+    # count is the compiled work). Off: the classic padded-budget
+    # prefill-xor-decode policy above.
+    chunked_prefill: bool = False
 
     def __post_init__(self):
         if self.max_num_seqs < 1:
             raise ValueError("max_num_seqs must be >= 1")
         if self.max_batched_tokens < 1:
             raise ValueError("max_batched_tokens must be >= 1")
+        if self.chunked_prefill and \
+                self.max_batched_tokens < self.max_num_seqs:
+            raise ValueError(
+                "chunked_prefill needs max_batched_tokens >= max_num_seqs "
+                "(every running row must afford its decode token)")
 
 
 @dataclass
@@ -72,11 +83,15 @@ class ScheduledBatch:
     iteration; ``expired`` lists requests whose deadline passed (already
     terminal, blocks freed — the engine emits their outputs)."""
 
-    kind: str                       # "prefill" | "decode" | "idle"
+    kind: str                       # "prefill" | "decode" | "mixed" | "idle"
     requests: List[Request] = field(default_factory=list)
     preempted: List[Request] = field(default_factory=list)
     swapped_in: List[Request] = field(default_factory=list)
     expired: List[Request] = field(default_factory=list)
+    # chunked-prefill mode: tokens scheduled per row (parallel to
+    # ``requests``); empty for the classic path (each row runs its whole
+    # ``tokens_to_run()``)
+    num_scheduled: List[int] = field(default_factory=list)
 
     @property
     def is_empty(self) -> bool:
@@ -108,6 +123,10 @@ class Scheduler:
         self.num_preemptions = 0
         self.num_swap_outs = 0
         self.num_swap_ins = 0
+        # pieces scheduled for prompts the budget ever split (chunked
+        # prefill; every piece of a split prompt counts, including the
+        # final one)
+        self.num_prefill_chunks = 0
 
     # -- queue ops -------------------------------------------------------
     def add(self, request: Request):
@@ -241,6 +260,9 @@ class Scheduler:
         expired = self.expire_deadlines()
         swapped_in = self._swap_in_ready()
 
+        if self.config.chunked_prefill:
+            return self._schedule_mixed(expired, swapped_in)
+
         # Phase 1 — admit waiting requests (priority, then FCFS) when
         # capacity allows. A request is admitted only when its FULL
         # uncached prefix fits the token budget and the free-block
@@ -321,3 +343,131 @@ class Scheduler:
                                   swapped_in=swapped_in, expired=expired)
         return ScheduledBatch(kind="idle", preempted=preempted,
                               swapped_in=swapped_in, expired=expired)
+
+    # -- chunked-prefill mixed scheduling ---------------------------------
+    def _schedule_mixed(self, expired: List[Request],
+                        swapped_in: List[Request]) -> ScheduledBatch:
+        """One MIXED batch under a raw token budget: (A) decode rows
+        first — one token each, bounding TPOT; (B) mid-prefill rows
+        continue with whatever budget remains, chunked; (C) new
+        admissions fill the rest, their prompts chunked too (and served
+        from the prefix cache where full prompt blocks match). Each pass
+        runs the same evict-lowest-priority OOM loop as classic decode,
+        so the starvation guard carries over unchanged."""
+        bm = self.block_manager
+        budget = self.config.max_batched_tokens
+        rows: List[Request] = []
+        nsched: List[int] = []
+        preempted: List[Request] = []
+        used = 0
+        any_prefill = False
+        any_decode = False
+
+        def drop_row(victim: Request):
+            nonlocal used
+            if victim in rows:
+                i = rows.index(victim)
+                rows.pop(i)
+                used -= nsched.pop(i)
+
+        def claim_slots(req: Request, new_len: int,
+                        write_from: int) -> bool:
+            """append_slot with the classic preempt-or-self-evict loop;
+            False means req itself was evicted."""
+            while True:
+                try:
+                    bm.append_slot(req.request_id, new_len,
+                                   write_from=write_from)
+                    return True
+                except NoFreeBlocksError:
+                    victim = self._preempt_one(req)
+                    if victim is None:
+                        self._evict(req)
+                        preempted.append(req)
+                        return False
+                    preempted.append(victim)
+                    drop_row(victim)
+
+        # pass A — decode rows (fully caught-up requests; cost 1 each)
+        running = sorted(self.running, key=lambda r: r.sort_key)
+        decode_rows = [r for r in running
+                       if len(r.tokens) - r.num_cached == 1
+                       and r.num_generated > 0]
+        chunk_rows = [r for r in running if r not in decode_rows]
+        for req in decode_rows:
+            if req not in self.running:
+                continue  # evicted saving a more important row
+            if used >= budget:
+                break
+            if claim_slots(req, len(req.tokens), len(req.tokens) - 1):
+                rows.append(req)
+                nsched.append(1)
+                used += 1
+                any_decode = True
+
+        # pass B — continue mid-prefill rows (chunk = remaining budget);
+        # a preempted/recomputed request catching back up is the same
+        # shape: everything in ``tokens`` past ``num_cached`` is prefill
+        for req in chunk_rows:
+            if req not in self.running:
+                continue
+            left = budget - used
+            if left <= 0:
+                break
+            total = len(req.tokens)
+            remaining = total - req.num_cached
+            n = min(remaining, left)
+            if claim_slots(req, req.num_cached + n, req.num_cached):
+                rows.append(req)
+                nsched.append(n)
+                used += n
+                any_prefill = True
+                if n < remaining:
+                    req.was_chunked = True
+                if req.was_chunked:
+                    self.num_prefill_chunks += 1
+
+        # pass C — admit waiting requests (priority, then FCFS);
+        # head-of-line: the first candidate that doesn't fit ends
+        # admission so a starved high-priority request is never overtaken
+        admitted: List[Request] = []
+        for req in sorted(self.waiting, key=lambda r: r.sort_key):
+            if len(self.running) + len(admitted) >= \
+                    self.config.max_num_seqs:
+                break
+            left = budget - used
+            if left <= 0:
+                break
+            total = len(req.tokens)
+            hit = bm.match_prefix(req.tokens)
+            eff = min(hit, total - 1)
+            n = min(total - eff, left)
+            try:
+                bm.allocate(req.request_id, eff + n, tokens=req.tokens)
+            except NoFreeBlocksError:
+                break  # blocks free up as running requests finish
+            req.num_cached = bm.last_hit_tokens
+            req.status = RequestStatus.RUNNING
+            admitted.append(req)
+            rows.append(req)
+            nsched.append(n)
+            used += n
+            any_prefill = True
+            if n < total - req.num_cached:
+                req.was_chunked = True
+            if req.was_chunked:
+                self.num_prefill_chunks += 1
+        if admitted:
+            taken = set(id(r) for r in admitted)
+            self.waiting = deque(r for r in self.waiting
+                                 if id(r) not in taken)
+            self.running.extend(admitted)
+
+        if not rows:
+            return ScheduledBatch(kind="idle", preempted=preempted,
+                                  swapped_in=swapped_in, expired=expired)
+        kind = ("mixed" if (any_prefill and any_decode)
+                else "prefill" if any_prefill else "decode")
+        return ScheduledBatch(kind=kind, requests=rows,
+                              preempted=preempted, swapped_in=swapped_in,
+                              expired=expired, num_scheduled=nsched)

@@ -6,6 +6,11 @@
 #   scripts/ci.sh --lint     lint only (fast pre-push check)
 #   scripts/ci.sh --fleet    fleet serving smoke only (2 tiny replicas
 #                            + a mid-run replica kill; ~1 min)
+#   scripts/ci.sh --ragged   ragged hot-path smoke only (mixed long/
+#                            short prompts with shared prefixes;
+#                            asserts ONE compiled step shape, zero
+#                            padding, prefix-cache hits, chunked
+#                            prefill, bucketed token parity; ~1 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -42,8 +47,19 @@ if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
 
+run_ragged() {
+    echo "== ragged smoke =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/ragged_smoke.py
+}
+
 if [[ "${1:-}" == "--fleet" ]]; then
     run_fleet
+    exit 0
+fi
+
+if [[ "${1:-}" == "--ragged" ]]; then
+    run_ragged
     exit 0
 fi
 

@@ -273,12 +273,12 @@ class TestTenantFairness:
         arrivals = ["heavy"] * 24 + ["light"] * 6
         rng.shuffle(arrivals)
         replica = FakeReplica("ra", capacity=2)
-        # quantum == request cost (4 prompt + 2 max_new): one dispatch
-        # per DRR visit. The default 256 quantum would let one visit
-        # burst ~40 of these small requests — fairness granularity IS
-        # the quantum, so storms must size it to their traffic
-        router = FleetRouter([replica],
-                             FleetConfig(tenant_quantum_tokens=6))
+        # adaptive default quantum: every request costs 6 tokens
+        # (4 prompt + 2 max_new), so the observed-mean quantum settles
+        # at 6 — one dispatch per DRR visit — without the storm having
+        # to size the granularity to its traffic by hand (the old flat
+        # 256 default would let one visit burst ~40 small requests)
+        router = FleetRouter([replica], FleetConfig())
         sp = {t: SamplingParams(max_new_tokens=2, tenant_id=t)
               for t in ("heavy", "light")}
         by_tenant = {"heavy": [], "light": []}
@@ -297,6 +297,35 @@ class TestTenantFairness:
         snap = router.snapshot()
         assert snap["fleet_tenants"]["light"]["dispatched"] == 6
         assert snap["fleet_tenants"]["heavy"]["dispatched"] == 24
+
+    def test_adaptive_quantum_tracks_mean_cost(self):
+        q = TenantQueue()                   # no explicit quantum
+        assert q.quantum == TenantQueue.DEFAULT_QUANTUM  # cold start
+        q.push("A", "a0", 10)
+        q.push("B", "b0", 30)
+        assert q.quantum == 20.0            # running mean of pushes
+        # refunds and hand-off re-enqueues must not skew the mean
+        t, item, cost = q.pop()
+        q.unpop(t, item, cost)
+        q.push("C", "c0", 0, front=True)    # hand-off: cost already paid
+        assert q.quantum == 20.0
+        # weight-2 A affords cost-40 heads every visit (grant 2*20=40),
+        # weight-1 B (grant 20) every second: same 2:1 cadence the
+        # fixed-quantum share test pins, now from observed costs alone
+        q2 = TenantQueue(weights={"A": 2.0})
+        for i in range(6):
+            q2.push("A", f"a{i}", 40)
+            q2.push("B", f"b{i}", 40)
+        assert q2.quantum == 40.0
+        order = [q2.pop()[0] for _ in range(9)]
+        assert order.count("A") == 6 and order.count("B") == 3
+
+    def test_explicit_quantum_still_pins(self):
+        q = TenantQueue(quantum_tokens=8)
+        q.push("A", "a0", 1000)             # huge observed cost
+        assert q.quantum == 8               # override wins
+        with pytest.raises(ValueError):
+            TenantQueue(quantum_tokens=0)
 
     def test_per_tenant_wait_recorded(self):
         router = FleetRouter([FakeReplica("ra")])

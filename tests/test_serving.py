@@ -495,3 +495,102 @@ def test_randomized_abort_interleaving_never_leaks_blocks():
     assert bm.num_free_blocks == bm.num_blocks
     assert bm.num_free_host_blocks == bm.num_host_blocks
     bm.check_invariants()
+
+
+def test_prefix_cache_cow_refcount_randomized_storm():
+    """ISSUE-9 satellite: randomized storm on the PREFIX-CACHING
+    allocator. Admissions draw from a prompt pool with genuine shared
+    prefixes (so blocks really get refcounted across requests),
+    growth follows the scheduler's chunked-prefill shape (write_from
+    mid-prompt) then decodes, aborts strike at any phase, and host
+    swap in/out interleaves throughout. COW pairs are drained exactly
+    the way the engine drains them (take_cow_pairs before each step)
+    and the exact-accounting invariants must hold after EVERY
+    operation; at the end both free lists return to full."""
+    rng = np.random.default_rng(5)
+    bm = BlockManager(num_blocks=24, block_size=4, num_host_blocks=8,
+                      enable_prefix_cache=True)
+    # three 16-token stems, each with divergent tails; the bare
+    # 8-token stem (2 exactly-full blocks) is the full-prompt-hit
+    # case whose capped write forces COW while a peer holds the block
+    stems = [list(map(int, rng.integers(0, 40, size=16)))
+             for _ in range(3)]
+    pool = [stem[:k] + list(map(int, rng.integers(40, 80, size=t)))
+            for stem in stems
+            for (k, t) in ((16, 3), (16, 6), (12, 5), (8, 0))]
+    live = {}     # rid -> {"tokens", "covered", "target"}
+    swapped = {}  # rid -> same dict, parked on host slots
+
+    def drain_cow():
+        for src, dst in bm.take_cow_pairs():
+            assert src != dst, "COW copied a block onto itself"
+            assert bm.ref_count(dst) >= 1, \
+                "COW destination freed before the copy was drained"
+
+    def pick(d):
+        return list(d)[int(rng.integers(0, len(d)))]
+
+    for it in range(1500):
+        op = int(rng.integers(0, 5))
+        if op == 0:  # admit, scheduler-shaped (match -> eff cap -> chunk)
+            rid = f"s{it}"
+            tokens = list(pool[int(rng.integers(0, len(pool)))])
+            total = len(tokens)
+            hit = bm.match_prefix(tokens)
+            eff = min(hit, total - 1)
+            n = int(rng.integers(1, total - eff + 1))
+            try:
+                bm.allocate(rid, eff + n, tokens=tokens)
+            except NoFreeBlocksError:
+                bm.check_invariants()
+                continue
+            covered = bm.last_hit_tokens + n
+            live[rid] = {"tokens": tokens, "covered": covered,
+                         "target": total + int(rng.integers(1, 6))}
+            bm.commit_prefix(rid, tokens, covered)
+        elif op == 1 and live:  # grow: chunk continuation, then decode
+            rid = pick(live)
+            st = live[rid]
+            if st["covered"] >= st["target"]:
+                bm.free(rid)
+                live.pop(rid)
+            else:
+                remaining_prompt = len(st["tokens"]) - st["covered"]
+                n = (int(rng.integers(1, remaining_prompt + 1))
+                     if remaining_prompt > 0 else 1)
+                try:
+                    bm.append_slot(rid, st["covered"] + n,
+                                   write_from=st["covered"])
+                except NoFreeBlocksError:
+                    bm.check_invariants()
+                    continue
+                st["covered"] += n
+                bm.commit_prefix(rid, st["tokens"], st["covered"])
+        elif op == 2 and live:  # abort/finish at any phase
+            rid = pick(live)
+            bm.free(rid)
+            live.pop(rid)
+        elif op == 3 and live:  # swap out (drops device refs)
+            rid = pick(live)
+            if bm.can_swap_out(rid, live[rid]["covered"]):
+                bm.swap_out(rid, live[rid]["covered"])
+                swapped[rid] = live.pop(rid)
+        elif op == 4 and swapped:  # swap back in, or abort-while-swapped
+            rid = pick(swapped)
+            if rng.random() < 0.25:
+                bm.free(rid)
+                swapped.pop(rid)
+            elif bm.can_swap_in(rid):
+                bm.swap_in(rid)
+                live[rid] = swapped.pop(rid)
+        drain_cow()
+        bm.check_invariants()
+    for rid in list(live) + list(swapped):
+        bm.free(rid)
+    drain_cow()
+    bm.check_invariants()
+    assert bm.num_free_blocks == bm.num_blocks
+    assert bm.num_free_host_blocks == bm.num_host_blocks
+    # the storm actually exercised the machinery it pins
+    assert bm.num_prefix_hits > 0, "no admission ever shared a prefix"
+    assert bm.num_cow_copies > 0, "no write ever copy-on-wrote"

@@ -284,3 +284,12 @@ def test_bench_serving_smoke():
     assert smoke["serving_swapped_out"] > 0
     assert smoke["serving_swapped_in"] == smoke["serving_swapped_out"]
     assert smoke["serving_drain_completed"] == 1
+    # ISSUE-9 ragged-vs-bucketed comparison phase: padding gone, one
+    # compiled step, the shared prefix actually hit the COW cache
+    cmp = ex["ragged_comparison"]
+    assert cmp["ragged_padded_token_frac"] == 0.0
+    assert cmp["bucketed_padded_token_frac"] > 0.0
+    assert cmp["ragged_compiled_step_shapes"] == 1
+    assert cmp["bucketed_compiled_step_shapes"] > 1
+    assert cmp["prefix_cache_hits"] > 0
+    assert cmp["prefill_chunks"] > 0
