@@ -46,6 +46,12 @@ def load_baseline(path: str) -> Dict[str, str]:
 
 
 def write_baseline(path: str, findings: List[Finding]) -> int:
+    # sort before fingerprinting: occurrence numbers for identical
+    # lines depend on finding ORDER, so the same tree must produce
+    # byte-identical baselines no matter how the caller ordered the
+    # findings (rule registration order, path walk order, ...)
+    findings = sorted(findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
     entries = {
         fp: f"{f.rule} {f.path}:{f.line} {f.message[:80]}"
         for fp, f in zip(fingerprints(findings), findings)}

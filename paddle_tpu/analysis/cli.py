@@ -35,6 +35,10 @@ def _build_parser() -> argparse.ArgumentParser:
                         "exit 0")
     p.add_argument("--disable", metavar="RULES", default="",
                    help="comma-separated rule names to skip")
+    p.add_argument("--only", metavar="RULES", default="",
+                   help="comma-separated rule names to run exclusively "
+                        "(meta rules always run); combines with "
+                        "--disable")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -59,12 +63,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("tpulint: no paths given (see --help)", file=sys.stderr)
         return 2
     disabled = [r.strip() for r in args.disable.split(",") if r.strip()]
+    only = [r.strip() for r in args.only.split(",") if r.strip()]
     known = set(get_rules()) | set(META_RULES)
-    unknown = [r for r in disabled if r not in known]
-    if unknown:
-        print(f"tpulint: --disable names unknown rule(s): "
-              f"{', '.join(unknown)}", file=sys.stderr)
-        return 2
+    for flag, names in (("--disable", disabled), ("--only", only)):
+        unknown = [r for r in names if r not in known]
+        if unknown:
+            print(f"tpulint: {flag} names unknown rule(s): "
+                  f"{', '.join(unknown)}", file=sys.stderr)
+            return 2
+    if only:
+        # run exclusively the requested set: disable everything else
+        # (meta rules are engine-emitted, not in get_rules(), so they
+        # stay active — bad suppressions must not hide behind --only)
+        disabled = sorted((set(get_rules()) - set(only))
+                          | set(disabled))
     try:
         findings = analyze_paths(args.paths, disabled=disabled)
     except FileNotFoundError as e:

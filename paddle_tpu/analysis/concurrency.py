@@ -1,0 +1,693 @@
+"""Interprocedural concurrency model: thread roots, locksets, accesses.
+
+tracecheck's PR-5 rules are per-statement; the concurrency rule family
+(unlocked-shared-state, lock-order-cycle, blocking-under-lock,
+signal-handler-unsafe) needs whole-module answers: *which threads can
+execute this function, and what locks does it hold when it touches that
+attribute?* This module computes that once per module and caches it on
+the :class:`~paddle_tpu.analysis.analyzer.ModuleContext`, the same way
+``TraceIndex`` answers "does this run at trace time".
+
+The model, and its deliberate approximations:
+
+* **Thread roots.** An execution root is ``main`` plus every callable
+  registered with a concurrency API found anywhere in the module:
+  ``threading.Thread(target=...)``, ``threading.Timer(_, fn)``,
+  ``weakref.finalize(obj, fn)`` (finalizers run on whichever thread
+  happens to drop the last reference), ``signal.signal(sig, handler)``,
+  callback kwargs matching ``on_*``/``callback`` (the watchdog's
+  ``on_timeout=`` monitor-thread callbacks), and provider registration
+  (``register_counter_provider``, ``add_done_callback``). Targets
+  resolve through bound methods (``self._watch``), bare names (nested
+  worker defs), lambdas, and ONE level of factory call
+  (``register(provider(g))`` marks the nested def ``provider``
+  returns).
+* **Call closure.** Per class, ``self.m()`` calls and bare-name calls
+  to same-class nested defs form the edge set; ``main`` seeds every
+  public method (non-underscore or dunder), each root seeds its entry,
+  and reachability is closed over the edges. Private methods never
+  called from a public one conservatively get NO main root; calls into
+  *other* classes/modules are not chased. ``__init__`` bodies are
+  construction-time (happens-before any thread start) and contribute
+  neither accesses nor edges.
+* **Accesses.** Every ``self.<attr>`` read/write outside ``__init__``
+  is recorded with the lockset held at that statement. Writes include
+  augmented assigns, subscript stores, and mutator method calls
+  (``.append``/``.pop``/``.update``/...). Attrs that *are* methods,
+  properties, class constants, locks, or synchronization objects
+  (Event/Queue/weakref/threading.local assigned anywhere in the class)
+  are exempt — calling ``self._flag.set()`` is the thread-safe idiom,
+  not a race.
+* **Locksets.** ``with self._lock:`` / ``with NAME:`` scopes and
+  linear ``x.acquire()`` ... ``x.release()`` pairs within one function.
+  A lock is an attr/name assigned from ``threading.(R)Lock/Condition/
+  Semaphore`` or whose name contains ``lock``/``mutex``. Lock identity
+  is ``Class.attr`` or ``<module>.name``, so the acquisition-order
+  graph spans classes within a module; cross-MODULE cycles are out of
+  scope.
+* **Signal roots** are tracked separately: CPython delivers handlers on
+  the main thread between bytecodes, so they cannot data-race with main
+  in the lockset sense (``unlocked-shared-state`` ignores them) but CAN
+  deadlock or re-enter — that is ``signal-handler-unsafe``'s job.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.context import FUNC_NODES, dotted_name, walk_own
+
+__all__ = ["get_concurrency", "ModuleConcurrency", "ClassModel",
+           "ThreadRoot", "AttrAccess", "blocking_reason", "MAIN"]
+
+MAIN = "main"
+
+# canonical callable -> (root kind, positional index of the callable,
+# kwarg name of the callable)
+_REG_APIS: Dict[str, Tuple[str, Optional[int], Optional[str]]] = {
+    "threading.Thread": ("thread", 1, "target"),
+    "threading.Timer": ("timer", 1, "function"),
+    "weakref.finalize": ("finalizer", 1, None),
+    "signal.signal": ("signal", 1, None),
+}
+# matched by final path segment: registration surfaces whose callable
+# argument runs on another thread (or an arbitrary one)
+_REG_SUFFIXES: Dict[str, Tuple[str, int]] = {
+    "register_counter_provider": ("callback", 1),
+    "add_done_callback": ("callback", 0),
+}
+_CALLBACK_KWARG = re.compile(r"^(on_[a-z0-9_]+|callback)$")
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
+               "threading.Semaphore", "threading.BoundedSemaphore")
+_SAFE_CTORS = ("threading.Event", "threading.Barrier", "threading.local",
+               "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+               "queue.PriorityQueue", "weakref.ref", "weakref.WeakSet",
+               "weakref.WeakValueDictionary", "weakref.WeakKeyDictionary")
+_LOCKISH_NAME = re.compile(r"lock|mutex", re.I)
+
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "update", "insert", "pop", "popleft", "popitem", "remove",
+             "discard", "clear", "setdefault", "sort", "reverse",
+             "put", "put_nowait"}
+
+# -- blocking-call classification (shared by blocking-under-lock and
+# signal-handler-unsafe) ----------------------------------------------------
+_BLOCKING_CANON = {
+    "time.sleep": "time.sleep parks the thread",
+    "jax.block_until_ready": "device sync",
+    "os.replace": "filesystem op", "os.rename": "filesystem op",
+    "os.makedirs": "filesystem op", "os.remove": "filesystem op",
+    "os.unlink": "filesystem op", "os.fsync": "filesystem op",
+    "os.system": "subprocess", "shutil.rmtree": "filesystem op",
+    "shutil.move": "filesystem op", "shutil.copytree": "filesystem op",
+    "subprocess.run": "subprocess", "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "socket.create_connection": "network connect",
+}
+# method spellings that block regardless of receiver
+_BLOCKING_METHODS = {"block_until_ready": "device sync",
+                     "serve_forever": "network accept loop"}
+# RPC-ish method names, counted only when the receiver LOOKS like a
+# store/channel/socket handle ("self._ch.post", "store.set", ...)
+_RPC_METHODS = {"set", "get", "try_get", "wait", "add", "delete", "list",
+                "post", "send", "recv", "sendall", "connect", "request"}
+_RPC_RECEIVER = re.compile(
+    r"(^|_)(store|channel|chan|ch|sock|socket|conn|client|server|srv|"
+    r"rpc|registry)s?$", re.I)
+
+
+def blocking_reason(module, call: ast.Call) -> Optional[str]:
+    """Why ``call`` blocks the calling thread (device sync, RPC,
+    filesystem, sleep), or None if it is not a known blocking call."""
+    canon = module.canonical(call.func)
+    if canon in _BLOCKING_CANON:
+        return f"{_BLOCKING_CANON[canon]} ({canon})"
+    if canon == "open" or canon == "io.open":
+        return "file open"
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth in _BLOCKING_METHODS:
+            return f"{_BLOCKING_METHODS[meth]} (.{meth}())"
+        if meth in _RPC_METHODS:
+            recv = dotted_name(call.func.value)
+            last = (recv or "").rsplit(".", 1)[-1]
+            if last and _RPC_RECEIVER.search(last):
+                return f"store/RPC call ({recv}.{meth}())"
+    return None
+
+
+@dataclass
+class ThreadRoot:
+    """One non-main execution root discovered in the module."""
+
+    name: str                 # e.g. "thread:_watch", "signal:handler"
+    kind: str                 # thread|timer|finalizer|signal|callback
+    func: ast.AST             # the entry FunctionDef/Lambda
+    reg_node: ast.AST         # the registration call site
+
+    @property
+    def concurrent(self) -> bool:
+        """Roots that run on a genuinely different thread. Signal
+        handlers run on the main thread between bytecodes."""
+        return self.kind != "signal"
+
+
+@dataclass
+class AttrAccess:
+    attr: str
+    kind: str                 # "read" | "write"
+    node: ast.AST
+    unit: ast.AST             # enclosing function unit
+    lockset: frozenset = frozenset()
+
+
+@dataclass
+class ClassModel:
+    cdef: ast.ClassDef
+    name: str
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    properties: Set[str] = field(default_factory=set)
+    lock_attrs: Set[str] = field(default_factory=set)
+    safe_attrs: Set[str] = field(default_factory=set)
+    class_consts: Set[str] = field(default_factory=set)
+    units: List[ast.AST] = field(default_factory=list)
+    roots: List[ThreadRoot] = field(default_factory=list)
+    unit_roots: Dict[int, Set[str]] = field(default_factory=dict)
+    accesses: List[AttrAccess] = field(default_factory=list)
+    root_by_name: Dict[str, ThreadRoot] = field(default_factory=dict)
+
+    def roots_of(self, unit: ast.AST) -> Set[str]:
+        return self.unit_roots.get(id(unit), set())
+
+    def accesses_by_attr(self) -> Dict[str, List[AttrAccess]]:
+        out: Dict[str, List[AttrAccess]] = {}
+        for a in self.accesses:
+            out.setdefault(a.attr, []).append(a)
+        return out
+
+
+@dataclass
+class ModuleConcurrency:
+    classes: List[ClassModel] = field(default_factory=list)
+    # module-level function model (globals instead of self attrs)
+    mod_units: List[ast.AST] = field(default_factory=list)
+    mod_unit_roots: Dict[int, Set[str]] = field(default_factory=dict)
+    mod_roots: List[ThreadRoot] = field(default_factory=list)
+    global_accesses: List[AttrAccess] = field(default_factory=list)
+    module_locks: Set[str] = field(default_factory=set)
+    # lock acquisition order: (held lock id, acquired lock id, site)
+    acq_edges: List[Tuple[str, str, ast.AST]] = field(default_factory=list)
+    # id(node) -> lockset for every statement visited
+    locksets: Dict[int, frozenset] = field(default_factory=dict)
+    # every (root, owning ClassModel or None) pair, incl. signal roots
+    all_roots: List[Tuple[ThreadRoot, Optional[ClassModel]]] = \
+        field(default_factory=list)
+
+    def lockset_at(self, module, node: ast.AST) -> frozenset:
+        cur = node
+        while cur is not None:
+            ls = self.locksets.get(id(cur))
+            if ls is not None:
+                return ls
+            cur = module.parents.get(id(cur))
+        return frozenset()
+
+    def closure_units(self, root: ThreadRoot,
+                      owner: Optional[ClassModel]) -> List[ast.AST]:
+        """Every function unit reachable from ``root``'s entry via the
+        intra-class/module call edges (the root's reach set)."""
+        if owner is not None:
+            return [u for u in owner.units
+                    if root.name in owner.roots_of(u)]
+        return [u for u in self.mod_units
+                if root.name in self.mod_unit_roots.get(id(u), set())]
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+def _enclosing_class(module, node) -> Optional[ast.ClassDef]:
+    cur = module.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = module.parents.get(id(cur))
+    return None
+
+
+def _enclosing_unit(module, node) -> Optional[ast.AST]:
+    cur = module.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, FUNC_NODES):
+            return cur
+        cur = module.parents.get(id(cur))
+    return cur
+
+
+def _resolve_callable(module, arg: ast.AST,
+                      at: ast.AST) -> Optional[ast.AST]:
+    """The function def a registration argument refers to: ``self.m``,
+    a bare name, a lambda, or one level of ``factory(...)``."""
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Attribute) and \
+            isinstance(arg.value, ast.Name) and arg.value.id == "self":
+        cls = _enclosing_class(module, at)
+        if cls is not None:
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        stmt.name == arg.attr:
+                    return stmt
+        return None
+    if isinstance(arg, ast.Name):
+        return module.traces.functions.resolve(arg.id, at)
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+        factory = module.traces.functions.resolve(arg.func.id, arg)
+        if factory is not None and not isinstance(factory, ast.Lambda):
+            for n in ast.walk(factory):
+                if isinstance(n, ast.Return) and \
+                        isinstance(n.value, ast.Name):
+                    return module.traces.functions.resolve(
+                        n.value.id, n)
+    return None
+
+
+def _find_registrations(module) -> List[Tuple[str, ast.AST, ast.Call]]:
+    """(kind, target def, registration call) for every concurrency
+    registration in the module."""
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = module.canonical(node.func)
+        cand: List[Tuple[str, ast.AST]] = []
+        if canon in _REG_APIS:
+            kind, pos, kw = _REG_APIS[canon]
+            if pos is not None and pos < len(node.args):
+                cand.append((kind, node.args[pos]))
+            for k in node.keywords:
+                if kw is not None and k.arg == kw:
+                    cand.append((kind, k.value))
+        elif canon is not None:
+            suffix = canon.rsplit(".", 1)[-1]
+            if suffix in _REG_SUFFIXES:
+                kind, pos = _REG_SUFFIXES[suffix]
+                if pos < len(node.args):
+                    cand.append((kind, node.args[pos]))
+        # callback kwargs anywhere: on_timeout=self._cb et al. — the
+        # registree decides the thread, so treat as concurrent
+        for k in node.keywords:
+            if k.arg and _CALLBACK_KWARG.match(k.arg):
+                cand.append(("callback", k.value))
+        for kind, arg in cand:
+            target = _resolve_callable(module, arg, node)
+            if target is not None:
+                out.append((kind, target, node))
+    return out
+
+
+def _unit_name(unit: ast.AST) -> str:
+    return getattr(unit, "name",
+                   f"<lambda>@L{getattr(unit, 'lineno', 0)}")
+
+
+def _scan_class_attrs(module, cm: ClassModel):
+    """Lock/safe/constant attr classification for one class."""
+    for stmt in cm.cdef.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    cm.class_consts.add(tgt.id)
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            cm.class_consts.add(stmt.target.id)
+    for node in ast.walk(cm.cdef):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        canon = module.canonical(node.value.func)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                if canon in _LOCK_CTORS:
+                    cm.lock_attrs.add(tgt.attr)
+                elif canon in _SAFE_CTORS:
+                    cm.safe_attrs.add(tgt.attr)
+
+
+def _lock_id(module, expr: ast.AST, cls: Optional[ClassModel],
+             module_locks: Set[str]) -> Optional[str]:
+    """Canonical id of the lock an expression denotes, or None."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and cls is not None:
+        if expr.attr in cls.lock_attrs or _LOCKISH_NAME.search(expr.attr):
+            return f"{cls.name}.{expr.attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in module_locks:
+            return f"<module>.{expr.id}"
+        if _LOCKISH_NAME.search(expr.id):
+            return f"<local>.{expr.id}"
+    return None
+
+
+class _LockWalker:
+    """Per-function statement walk that records the lockset at every
+    node and the (held -> acquired) order edges."""
+
+    def __init__(self, module, cls: Optional[ClassModel],
+                 mc: ModuleConcurrency):
+        self.module = module
+        self.cls = cls
+        self.mc = mc
+
+    def walk(self, unit: ast.AST):
+        body = unit.body if not isinstance(unit, ast.Lambda) \
+            else [unit.body]
+        self._stmts(body if isinstance(body, list) else [body],
+                    frozenset())
+
+    def _record(self, node: ast.AST, held: frozenset):
+        self.mc.locksets[id(node)] = held
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_NODES):
+                continue  # nested defs get their own walk
+            if isinstance(child, ast.stmt):
+                continue  # handled by _stmts with possibly-updated held
+            self._record(child, held)
+
+    def _acquire(self, lid: str, held: frozenset,
+                 site: ast.AST) -> frozenset:
+        for h in held:
+            if h != lid:
+                self.mc.acq_edges.append((h, lid, site))
+        return held | {lid}
+
+    def _stmts(self, stmts: List[ast.stmt], held: frozenset):
+        for stmt in stmts:
+            held = self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: frozenset) -> frozenset:
+        if isinstance(stmt, FUNC_NODES):
+            # nested def: a definition, not an execution — its body gets
+            # its own walk with an empty lockset
+            self.mc.locksets[id(stmt)] = held
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.mc.locksets[id(stmt)] = held
+            inner = held
+            for item in stmt.items:
+                self._record(item.context_expr, inner)
+                lid = _lock_id(self.module, item.context_expr, self.cls,
+                               self.mc.module_locks)
+                if lid is not None:
+                    inner = self._acquire(lid, inner, item.context_expr)
+            self._stmts(stmt.body, inner)
+            return held
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            func = call.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in ("acquire", "release"):
+                lid = _lock_id(self.module, func.value, self.cls,
+                               self.mc.module_locks)
+                if lid is not None:
+                    self._record(stmt, held)
+                    if func.attr == "acquire":
+                        return self._acquire(lid, held, call)
+                    return held - {lid}
+        # compound statements: the same lockset flows into every block;
+        # bare acquire()/release() threads through each block's sequence
+        # but does not escape the compound statement (an approximation —
+        # conditional acquire paths are merged pessimistically)
+        self.mc.locksets[id(stmt)] = held
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, FUNC_NODES) or isinstance(child, ast.stmt):
+                continue
+            self._record(child, held)
+        for block in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, block, None)
+            if sub and isinstance(sub, list):
+                self._stmts(sub, held)
+        for h in getattr(stmt, "handlers", None) or []:
+            self.mc.locksets[id(h)] = held
+            self._stmts(h.body, held)
+        return held
+
+
+def _collect_accesses(module, unit: ast.AST, cls: ClassModel,
+                      mc: ModuleConcurrency) -> List[AttrAccess]:
+    skip_names = (set(cls.methods) | cls.properties | cls.class_consts
+                  | cls.lock_attrs | cls.safe_attrs)
+    out: List[AttrAccess] = []
+    for node in walk_own(unit):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            continue
+        if node.attr in skip_names:
+            continue
+        kind = "read"
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        else:
+            parent = module.parents.get(id(node))
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _MUTATORS and \
+                    isinstance(module.parents.get(id(parent)), ast.Call):
+                kind = "write"
+            elif isinstance(parent, ast.Subscript) and \
+                    isinstance(parent.ctx, (ast.Store, ast.Del)):
+                kind = "write"
+            elif isinstance(parent, ast.AugAssign) and \
+                    parent.target is node:
+                kind = "write"
+        out.append(AttrAccess(attr=node.attr, kind=kind, node=node,
+                              unit=unit,
+                              lockset=mc.lockset_at(module, node)))
+    return out
+
+
+def _call_edges(module, unit: ast.AST,
+                cls: Optional[ClassModel]) -> Set[int]:
+    """ids of same-class/same-module units ``unit`` calls."""
+    out: Set[int] = set()
+    for node in walk_own(unit):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if cls is not None and isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and f.attr in cls.methods:
+                out.add(id(cls.methods[f.attr]))
+            elif isinstance(f, ast.Name):
+                target = module.traces.functions.resolve(f.id, node)
+                if target is not None:
+                    out.add(id(target))
+        elif cls is not None and isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in cls.properties:
+            # property READ executes the property body on this thread
+            out.add(id(cls.methods[node.attr]))
+    return out
+
+
+def _close_roots(seeds: Dict[str, Set[int]],
+                 edges: Dict[int, Set[int]],
+                 unit_ids: Set[int]) -> Dict[int, Set[str]]:
+    reach: Dict[int, Set[str]] = {uid: set() for uid in unit_ids}
+    for root, seed in seeds.items():
+        frontier = [uid for uid in seed if uid in unit_ids]
+        seen = set(frontier)
+        while frontier:
+            uid = frontier.pop()
+            reach[uid].add(root)
+            for nxt in edges.get(uid, ()):
+                if nxt in unit_ids and nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+    return reach
+
+
+def _is_public(name: str) -> bool:
+    return not name.startswith("_") or (
+        name.startswith("__") and name.endswith("__"))
+
+
+def build(module) -> ModuleConcurrency:
+    mc = ModuleConcurrency()
+    tree = module.tree
+    # module-level locks & mutable globals
+    mutable_globals: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            val = stmt.value
+            canon = module.canonical(val.func) \
+                if isinstance(val, ast.Call) else None
+            for tgt in stmt.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if canon in _LOCK_CTORS:
+                    mc.module_locks.add(tgt.id)
+                elif isinstance(val, (ast.Dict, ast.List, ast.Set)) or \
+                        (canon or "").rsplit(".", 1)[-1] in (
+                            "dict", "list", "set", "OrderedDict",
+                            "defaultdict", "deque"):
+                    mutable_globals.add(tgt.id)
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                stmt.value is not None:
+            val = stmt.value
+            canon = module.canonical(val.func) \
+                if isinstance(val, ast.Call) else None
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)) or \
+                    (canon or "").rsplit(".", 1)[-1] in (
+                        "dict", "list", "set", "OrderedDict",
+                        "defaultdict", "deque"):
+                mutable_globals.add(stmt.target.id)
+
+    registrations = _find_registrations(module)
+    reg_target_ids = {id(t) for _, t, _ in registrations}
+
+    # -- per-class models -----------------------------------------------
+    all_units_by_class: Dict[int, ClassModel] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = ClassModel(cdef=node, name=node.name)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cm.methods[stmt.name] = stmt
+                for dec in stmt.decorator_list:
+                    dn = dotted_name(dec)
+                    if dn in ("property", "cached_property",
+                              "functools.cached_property") or \
+                            (isinstance(dec, ast.Attribute)
+                             and dec.attr in ("setter", "getter")):
+                        cm.properties.add(stmt.name)
+        _scan_class_attrs(module, cm)
+        mc.classes.append(cm)
+        all_units_by_class[id(node)] = cm
+    for unit in module.traces.functions.defs:
+        cls_def = _enclosing_class(module, unit)
+        if cls_def is not None and id(cls_def) in all_units_by_class:
+            all_units_by_class[id(cls_def)].units.append(unit)
+        else:
+            mc.mod_units.append(unit)
+
+    # lockset walk covers EVERY unit (module-level too) exactly once
+    for cm in mc.classes:
+        walker = _LockWalker(module, cm, mc)
+        for unit in cm.units:
+            walker.walk(unit)
+    mod_walker = _LockWalker(module, None, mc)
+    for unit in mc.mod_units:
+        mod_walker.walk(unit)
+
+    # -- roots + closure per class ---------------------------------------
+    for cm in mc.classes:
+        unit_ids = {id(u) for u in cm.units}
+        edges = {id(u): _call_edges(module, u, cm) for u in cm.units}
+        init = cm.methods.get("__init__")
+        seeds: Dict[str, Set[int]] = {MAIN: set()}
+        for name, m in cm.methods.items():
+            if m is init or id(m) in reg_target_ids:
+                continue
+            if _is_public(name) or name in cm.properties:
+                seeds[MAIN].add(id(m))
+            else:
+                # private methods are main-reachable only via the edges
+                pass
+        # private methods called by nobody in-class but public on the
+        # module surface (rare) stay rootless: an under-approximation
+        for kind, target, reg in registrations:
+            if _enclosing_class(module, target) is not cm.cdef and \
+                    target not in cm.units:
+                continue
+            rname = f"{kind}:{_unit_name(target)}"
+            if rname not in cm.root_by_name:
+                root = ThreadRoot(name=rname, kind=kind, func=target,
+                                  reg_node=reg)
+                cm.roots.append(root)
+                cm.root_by_name[rname] = root
+                mc.all_roots.append((root, cm))
+            seeds.setdefault(rname, set()).add(id(target))
+        cm.unit_roots = _close_roots(seeds, edges, unit_ids)
+        for unit in cm.units:
+            if unit is init:
+                continue  # construction happens-before thread start
+            cm.accesses.extend(_collect_accesses(module, unit, cm, mc))
+
+    # -- module-level functions + globals --------------------------------
+    unit_ids = {id(u) for u in mc.mod_units}
+    edges = {id(u): _call_edges(module, u, None) for u in mc.mod_units}
+    seeds = {MAIN: {id(u) for u in mc.mod_units
+                    if id(u) not in reg_target_ids}}
+    root_names: Dict[str, ThreadRoot] = {}
+    for kind, target, reg in registrations:
+        if id(target) not in unit_ids:
+            continue
+        rname = f"{kind}:{_unit_name(target)}"
+        if rname not in root_names:
+            root = ThreadRoot(name=rname, kind=kind, func=target,
+                              reg_node=reg)
+            root_names[rname] = root
+            mc.mod_roots.append(root)
+            mc.all_roots.append((root, None))
+        seeds.setdefault(rname, set()).add(id(target))
+    mc.mod_unit_roots = _close_roots(seeds, edges, unit_ids)
+    if mutable_globals:
+        for unit in mc.mod_units:
+            mc.global_accesses.extend(
+                _collect_global_accesses(module, unit, mutable_globals,
+                                         mc))
+    return mc
+
+
+def _collect_global_accesses(module, unit: ast.AST,
+                             tracked: Set[str],
+                             mc: ModuleConcurrency) -> List[AttrAccess]:
+    out: List[AttrAccess] = []
+    declared_global: Set[str] = {
+        n for node in walk_own(unit) if isinstance(node, ast.Global)
+        for n in node.names}
+    for node in walk_own(unit):
+        if not isinstance(node, ast.Name) or node.id not in tracked:
+            continue
+        kind = None
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write" if node.id in declared_global else None
+        else:
+            parent = module.parents.get(id(node))
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _MUTATORS and \
+                    isinstance(module.parents.get(id(parent)), ast.Call):
+                kind = "write"
+            elif isinstance(parent, ast.Subscript):
+                sctx = parent.ctx
+                kind = "write" if isinstance(
+                    sctx, (ast.Store, ast.Del)) else "read"
+            elif isinstance(parent, (ast.For, ast.comprehension)) or \
+                    isinstance(parent, ast.Call) or \
+                    isinstance(parent, ast.Attribute):
+                kind = "read"
+        if kind is not None:
+            out.append(AttrAccess(attr=node.id, kind=kind, node=node,
+                                  unit=unit,
+                                  lockset=mc.lockset_at(module, node)))
+    return out
+
+
+def get_concurrency(module) -> ModuleConcurrency:
+    """The (cached) concurrency model for one ModuleContext."""
+    mc = getattr(module, "_concurrency", None)
+    if mc is None:
+        mc = build(module)
+        module._concurrency = mc
+    return mc

@@ -2,8 +2,14 @@
 (the registry imports it lazily from ``get_rules``)."""
 from paddle_tpu.analysis.rules import (  # noqa: F401
     block_sync,
+    blocking_lock,
+    collective_divergence,
     counter_leak,
+    finish_reason,
     host_sync,
+    lock_order,
+    shared_state,
+    signal_safety,
     tensor_bool,
     trace_impurity,
     use_after_donate,

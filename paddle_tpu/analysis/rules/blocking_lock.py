@@ -1,0 +1,75 @@
+"""blocking-under-lock: slow/blocking work while holding a lock.
+
+A lock held across a blocking call turns every other thread that needs
+that lock into a convoy behind the slow operation: a device sync under
+the scheduler lock stalls the serving step; a store RPC under a
+registry lock stalls every heartbeat; ``time.sleep`` under any lock is
+a latency bomb. Worse, if the blocking call itself waits on a thread
+that needs the same lock, it is a deadlock, not just a stall.
+
+Flagged: inside any statement whose lockset is non-empty, calls that
+are known to block —
+
+* device syncs (``jax.block_until_ready`` / ``.block_until_ready()``),
+* ``time.sleep``,
+* filesystem ops (``open``, ``os.replace``/``makedirs``/...,
+  ``shutil.rmtree``/...), subprocess spawns,
+* store/RPC traffic: ``.set/.get/.try_get/.wait/.post/...`` on a
+  receiver whose name looks like a store, channel, socket, or client
+  (``self.store.set(...)``, ``self._ch.post(...)``).
+
+Fix pattern — move the slow call outside, keep only the state flip
+under the lock::
+
+    with self._lock:
+        rec = self._fmt(entry)
+        self.store.set(key, rec)     # BAD: RPC under the lock
+    ...
+    with self._lock:
+        rec = self._fmt(entry)       # GOOD: lock covers state only
+    self.store.set(key, rec)
+
+One-time initialization that exists precisely to serialize a slow build
+(double-checked ``_BUILD_LOCK`` patterns) is a legitimate exception —
+suppress with that reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddle_tpu.analysis.concurrency import blocking_reason, \
+    get_concurrency
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+@register(
+    "blocking-under-lock",
+    "device sync / RPC / filesystem / sleep while holding a lock",
+    _DOC)
+def check(module) -> List[Finding]:
+    mc = get_concurrency(module)
+    if not mc.locksets:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        held = mc.lockset_at(module, node)
+        if not held:
+            continue
+        why = blocking_reason(module, node)
+        if why is None:
+            continue
+        locks = ", ".join(sorted(held))
+        out.append(module.finding(
+            "blocking-under-lock", node,
+            f"{why} while holding [{locks}] — every thread needing the "
+            f"lock convoys behind this call (and if the call waits on "
+            f"such a thread, deadlocks); move the blocking work outside "
+            f"the critical section, or suppress with the reason the "
+            f"hold is intentional (e.g. a one-time double-checked "
+            f"build)"))
+    return out

@@ -1,0 +1,135 @@
+"""collective-divergence: collectives under rank/data-dependent branches.
+
+SPMD collectives (``lax.psum``, ``ppermute``, ``all_gather``, ...) are
+a rendezvous: EVERY participant along the mapped axis must issue the
+same collective in the same order, or the mesh deadlocks — the ranks
+that entered the collective wait forever for the ones that branched
+around it. Inside a ``shard_map``/``pjit`` body that means a collective
+may never sit under a branch whose predicate can differ across ranks:
+
+* a Python ``if`` on a rank source (``lax.axis_index``,
+  ``jax.process_index``, a ``rank``-named value) — each rank traces a
+  DIFFERENT program;
+* a ``lax.cond``/``lax.switch`` branch or a ``lax.while_loop``
+  cond/body — the predicate/trip count is a traced value that can
+  differ per rank at RUNTIME.
+
+``lax.fori_loop``/``scan`` bodies are uniform (same trip count
+everywhere) and are NOT flagged; nor are host-static branches
+(``if causal:`` on a Python bool — every rank takes the same arm).
+
+Fix pattern — hoist the collective above the branch and select::
+
+    def body(x):
+        if lax.axis_index("dp") == 0:   # BAD: rank 0 traces a psum
+            x = lax.psum(x, "dp")       #      the others never issue
+    ...
+    def body(x):
+        s = lax.psum(x, "dp")           # GOOD: every rank participates
+        x = jnp.where(lax.axis_index("dp") == 0, s, x)
+
+A collective that is genuinely uniform despite the branch (predicate
+provably identical on every rank) gets a suppression saying why.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+_COLLECTIVES = {"psum", "psum_scatter", "pmean", "pmax", "pmin",
+                "ppermute", "pshuffle", "all_gather", "all_to_all",
+                "pbroadcast", "pdot"}
+_RANK_CALLS = {"axis_index", "process_index", "get_rank", "local_rank",
+               "device_id"}
+_RANK_NAME = re.compile(r"(^|_)(rank|axis_index|process_index)($|_)")
+_DIVERGENT_WRAPPER = re.compile(
+    r"passed to jax\.lax\.(cond|switch|while_loop)\b")
+
+_BOUNDARIES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _collective_name(module, call: ast.Call) -> Optional[str]:
+    canon = module.canonical(call.func) or ""
+    tail = canon.rsplit(".", 1)[-1]
+    if tail in _COLLECTIVES and (
+            canon.startswith("jax.") or "." not in canon
+            or canon.startswith("lax.")):
+        return tail
+    return None
+
+
+def _rank_dependent(module, test: ast.AST) -> Optional[str]:
+    """Why a branch predicate can differ across ranks, or None."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            canon = module.canonical(node.func) or ""
+            if canon.rsplit(".", 1)[-1] in _RANK_CALLS:
+                return f"calls {canon}"
+        elif isinstance(node, ast.Name) and _RANK_NAME.search(node.id):
+            return f"depends on '{node.id}'"
+        elif isinstance(node, ast.Attribute) and \
+                _RANK_NAME.search(node.attr):
+            return f"depends on '.{node.attr}'"
+    return None
+
+
+def _enclosing_branch(module, node: ast.AST):
+    """(If/While ancestor, its test) chain up to the function boundary."""
+    cur = module.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, _BOUNDARIES):
+            return
+        if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+            yield cur
+        cur = module.parents.get(id(cur))
+
+
+@register(
+    "collective-divergence",
+    "collective under a rank/data-dependent branch in an SPMD body",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        coll = _collective_name(module, node)
+        if coll is None:
+            continue
+        reason = module.trace_reason(node)
+        if reason is None:
+            continue  # host code: not an SPMD body
+        # (b) the innermost traced scope IS a cond/switch/while_loop
+        # branch: the branch predicate is a traced value that can
+        # differ per rank at runtime
+        m = _DIVERGENT_WRAPPER.search(reason)
+        if m:
+            out.append(module.finding(
+                "collective-divergence", node,
+                f"'{coll}' inside a function {reason}: the predicate/"
+                f"trip count is a traced value that can differ across "
+                f"ranks, so some ranks skip the collective and the "
+                f"rest deadlock waiting — hoist '{coll}' out of the "
+                f"branch and select its result, or suppress with the "
+                f"uniformity argument"))
+            continue
+        # (a) a Python if/while on a rank source inside the traced body
+        for branch in _enclosing_branch(module, node):
+            why = _rank_dependent(module, branch.test)
+            if why is None:
+                continue
+            out.append(module.finding(
+                "collective-divergence", node,
+                f"'{coll}' under the branch at line {branch.lineno} "
+                f"whose predicate {why}: each rank traces a DIFFERENT "
+                f"program, so ranks that skip the collective leave the "
+                f"others deadlocked at the rendezvous — hoist the "
+                f"collective above the branch (every rank issues it) "
+                f"and select the result per rank"))
+            break
+    return out

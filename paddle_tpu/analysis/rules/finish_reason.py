@@ -1,0 +1,106 @@
+"""finish-reason-literal: unknown terminal-state literal in serving code.
+
+PR 6 made request terminal states an exhaustive vocabulary:
+``serving.request.FINISH_REASONS`` is the single source of truth, the
+metrics layer emits one ``serving/finish/<reason>`` bucket per entry,
+and the fleet router's hand-off policy dispatches on specific reasons.
+A typo'd or ad-hoc literal (``"expire"``, ``"aborted:oom"``) silently
+escapes all of that: the finish histogram drops it, hand-off never
+matches it, and dashboards show a request that vanished. This rule
+machine-checks the convention: every finish-reason string literal in a
+serving module must be in ``FINISH_REASONS``.
+
+Checked, in any module that imports ``paddle_tpu.serving.request``
+(the marker that the vocabulary applies):
+
+* ``finish_reason="<lit>"`` keyword arguments and
+  ``x.finish_reason = "<lit>"`` assignments,
+* string-literal arguments of terminal-path calls:
+  ``.abort("<lit>")``, ``_finalize(req, "<lit>")``,
+  ``_finish("<lit>")``, ``finish_request(..., "<lit>")``.
+
+Prefix checks (``reason.startswith("aborted:")``) and comparisons are
+out of scope — they read the vocabulary, they don't extend it.
+
+Fix pattern: add the reason to ``FINISH_REASONS`` (and its metrics
+bucket) or use an existing one; never invent a literal at the call
+site.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+_TERMINAL_CALLS = {"abort", "_finish", "_finalize", "finish_request"}
+_MARKER = "paddle_tpu.serving.request"
+
+
+def _vocabulary() -> Tuple[str, ...]:
+    try:
+        from paddle_tpu.serving.request import FINISH_REASONS
+    except Exception:  # analysis must not require the runtime package
+        return ()
+    return tuple(FINISH_REASONS)
+
+
+def _uses_vocabulary(module) -> bool:
+    for canon in module.imports.aliases.values():
+        if canon.startswith(_MARKER) or canon == "paddle_tpu.serving":
+            return True
+    return False
+
+
+def _bad_literal(node: ast.AST, vocab) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value not in vocab:
+        return node.value
+    return None
+
+
+@register(
+    "finish-reason-literal",
+    "finish_reason literal not in serving.request.FINISH_REASONS",
+    _DOC)
+def check(module) -> List[Finding]:
+    vocab = _vocabulary()
+    if not vocab or not _uses_vocabulary(module):
+        return []
+    out: List[Finding] = []
+
+    def flag(node, lit, where):
+        out.append(module.finding(
+            "finish-reason-literal", node,
+            f"{where} uses literal '{lit}' which is not in "
+            f"serving.request.FINISH_REASONS {vocab} — it would skip "
+            f"the finish histogram and every reason-dispatched policy "
+            f"(hand-off, drain); add it to the vocabulary or use an "
+            f"existing reason"))
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "finish_reason":
+                    lit = _bad_literal(kw.value, vocab)
+                    if lit is not None:
+                        flag(kw.value, lit, "finish_reason= keyword")
+            fname = node.func.attr if isinstance(node.func, ast.Attribute)\
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if fname in _TERMINAL_CALLS:
+                for arg in node.args:
+                    lit = _bad_literal(arg, vocab)
+                    if lit is not None:
+                        flag(arg, lit, f"terminal call {fname}(...)")
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "finish_reason":
+                    lit = _bad_literal(node.value, vocab)
+                    if lit is not None:
+                        flag(node.value, lit,
+                             ".finish_reason assignment")
+    return out

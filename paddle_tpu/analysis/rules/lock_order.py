@@ -1,0 +1,90 @@
+"""lock-order-cycle: inconsistent nested lock acquisition order.
+
+Two threads that take the same pair of locks in opposite orders can
+each hold one and wait forever for the other — the textbook deadlock,
+and the single hardest bug to reproduce once replicas leave the
+process. This rule builds the module's lock acquisition-order graph
+(edge ``A -> B`` whenever ``B`` is acquired while ``A`` is held, via
+``with`` nesting or ``acquire()`` scopes, across ALL classes in the
+module) and reports every cycle.
+
+Lock identity is ``Class.attr`` for ``self._lock``-style locks and
+``<module>.name`` for module-level ones, so an engine that takes its
+own lock and then a registry's module lock participates in the same
+graph as the registry helper that nests them the other way round.
+Cross-MODULE cycles are out of scope (documented approximation) — keep
+lock hierarchies within one module, or document the global order.
+
+Fix pattern: pick one order and make every path use it::
+
+    with self._sched_lock:
+        with self._kv_lock: ...      # everywhere: sched -> kv
+    # NEVER: with self._kv_lock: with self._sched_lock: ...
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from paddle_tpu.analysis.concurrency import get_concurrency
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS, deduplicated by node set; each cycle
+    is returned rotated to start at its smallest node (deterministic)."""
+    seen_sets: Set[frozenset] = set()
+    out: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str], on_path: Set[str]):
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    lo = min(range(len(path)), key=lambda i: path[i])
+                    out.append(path[lo:] + path[:lo])
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle found exactly
+                # once, rooted at its smallest node
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return out
+
+
+@register(
+    "lock-order-cycle",
+    "locks acquired in conflicting orders across the module (deadlock)",
+    _DOC)
+def check(module) -> List[Finding]:
+    mc = get_concurrency(module)
+    if not mc.acq_edges:
+        return []
+    graph: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], object] = {}
+    for outer, inner, node in mc.acq_edges:
+        graph.setdefault(outer, set()).add(inner)
+        prev = sites.get((outer, inner))
+        if prev is None or getattr(node, "lineno", 0) < \
+                getattr(prev, "lineno", 1 << 30):
+            sites[(outer, inner)] = node
+    out: List[Finding] = []
+    for cycle in _cycles(graph):
+        pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+        # anchor at the earliest acquisition site participating in the
+        # cycle so the finding (and its suppression) has a stable home
+        anchor = min((sites[p] for p in pairs if p in sites),
+                     key=lambda n: getattr(n, "lineno", 0))
+        order = " -> ".join(cycle + [cycle[0]])
+        where = ", ".join(
+            f"{a}->{b}@L{getattr(sites[(a, b)], 'lineno', '?')}"
+            for a, b in pairs if (a, b) in sites)
+        out.append(module.finding(
+            "lock-order-cycle", anchor,
+            f"lock acquisition cycle {order} ({where}): two threads "
+            f"taking these locks in opposite orders deadlock — pick one "
+            f"global order and make every path follow it"))
+    return out

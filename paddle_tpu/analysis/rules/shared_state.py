@@ -1,0 +1,109 @@
+"""unlocked-shared-state: cross-thread attr access without a common lock.
+
+The classic lockset (Eraser) discipline, applied per class: every
+``self.<attr>`` that more than one thread root can reach — and that at
+least one of them WRITES — must have a non-empty intersection of the
+locksets held across all of its accesses. An empty intersection means
+no single lock consistently protects the attr, i.e. two threads can
+interleave mid-update (lost counter increments, torn check-then-act
+sequences, ``deque mutated during iteration``).
+
+Thread roots come from the module's concurrency model
+(:mod:`paddle_tpu.analysis.concurrency`): ``threading.Thread``/
+``Timer`` targets, ``weakref.finalize`` callbacks, watchdog-style
+``on_*=``/``callback=`` registrations, plus the implicit ``main`` root
+seeded at every public method. Signal handlers are excluded here —
+CPython runs them on the main thread between bytecodes, so they cannot
+data-race with main (their hazards are ``signal-handler-unsafe``'s
+beat). Attrs that hold synchronization objects (Event, Queue, locks,
+weakrefs) are exempt: calling ``self._flag.set()`` from two threads is
+the correct idiom.
+
+Known approximations (see analysis/rules/README.md): construction
+(``__init__``) and ``Thread.start()``/``join()`` are happens-before
+edges the lockset model cannot see — an attr written once before the
+thread starts, or read only after ``join()``, is safe in a way this
+rule cannot prove. Those sites get an inline suppression naming the
+ordering argument.
+
+Fix pattern::
+
+    def _on_timeout(self, expired):          # watchdog-thread callback
+        self._hung = ", ".join(expired)      # BAD: main also swaps it
+    ...
+    def _on_timeout(self, expired):
+        with self._hung_lock:                # GOOD: same lock both sides
+            self._hung = ", ".join(expired)
+"""
+from __future__ import annotations
+
+from typing import List
+
+from paddle_tpu.analysis.concurrency import MAIN, get_concurrency
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _check_group(module, owner_name, attr, accs, signal_roots,
+                 roots_of) -> List[Finding]:
+    involved = set()
+    for a in accs:
+        involved |= roots_of(a.unit)
+    involved -= signal_roots
+    writes = [a for a in accs
+              if a.kind == "write" and (roots_of(a.unit) - signal_roots)]
+    if len(involved) < 2 or not writes:
+        return []
+    non_main = sorted(involved - {MAIN})
+    if not non_main:
+        return []
+    shared = [a for a in accs if roots_of(a.unit) - signal_roots]
+    common = frozenset.intersection(*[a.lockset for a in shared]) \
+        if shared else frozenset()
+    if common:
+        return []
+    # anchor at the first unlocked write (prefer one on a non-main root)
+    def _key(a):
+        on_thread = bool((roots_of(a.unit) - signal_roots) - {MAIN})
+        return (a.lockset != frozenset(), not on_thread,
+                getattr(a.node, "lineno", 0))
+    anchor = sorted(writes, key=_key)[0]
+    locked_some = any(a.lockset for a in shared)
+    detail = ("some accesses hold a lock but no single lock covers "
+              "them all" if locked_some else "no access holds a lock")
+    return [module.finding(
+        "unlocked-shared-state", anchor.node,
+        f"{owner_name}{attr} is written by roots "
+        f"[{', '.join(sorted(involved))}] with no common lock "
+        f"({detail}) — interleaved updates can tear; guard every "
+        f"access with one lock, or suppress with the happens-before "
+        f"argument (started-after-write, joined-before-read) if the "
+        f"ordering makes it safe")]
+
+
+@register(
+    "unlocked-shared-state",
+    "attr written from >=2 thread roots with inconsistent locksets",
+    _DOC)
+def check(module) -> List[Finding]:
+    mc = get_concurrency(module)
+    out: List[Finding] = []
+    for cm in mc.classes:
+        if not any(r.concurrent for r in cm.roots):
+            continue
+        signal_roots = {r.name for r in cm.roots if not r.concurrent}
+        for attr, accs in sorted(cm.accesses_by_attr().items()):
+            out.extend(_check_group(
+                module, f"{cm.name}.", attr, accs, signal_roots,
+                cm.roots_of))
+    if any(r.concurrent for r in mc.mod_roots):
+        signal_roots = {r.name for r in mc.mod_roots if not r.concurrent}
+        by_name = {}
+        for a in mc.global_accesses:
+            by_name.setdefault(a.attr, []).append(a)
+        for name, accs in sorted(by_name.items()):
+            out.extend(_check_group(
+                module, "<module>.", name, accs, signal_roots,
+                lambda u: mc.mod_unit_roots.get(id(u), set())))
+    return out

@@ -1,0 +1,98 @@
+"""signal-handler-unsafe: heavy or non-reentrant work inside handlers.
+
+CPython delivers signal handlers on the main thread BETWEEN bytecodes —
+which means the handler can interrupt the main thread at any point,
+including while main holds a lock or sits inside the very library the
+handler wants to call. A handler that acquires a (non-reentrant) lock
+the interrupted code holds deadlocks the process; a handler that does
+store RPC / file IO / allocation-heavy serialization runs that work at
+an arbitrary interruption point (and a second signal can re-enter it).
+The only robust handler body is: set a flag, chain the previous
+handler, return — every consumer polls the flag from normal code.
+
+Flagged, over the transitive closure of calls reachable from any
+``signal.signal(sig, handler)`` target (same-class/module edges):
+
+* lock acquisition (``with <lock>:`` / ``.acquire()``),
+* known blocking calls (device sync, ``time.sleep``, filesystem,
+  subprocess — the blocking-under-lock call list),
+* store/RPC traffic (``self._ch.post(...)``, ``store.set(...)``).
+
+Fix pattern — the PreemptionMonitor shape::
+
+    def handler(signum, frame):
+        self._flag.set()          # Event.set is async-signal-tolerant
+        self._post()              # BAD: store RPC inside the handler
+    ...
+    def handler(signum, frame):
+        self._flag.set()          # GOOD: flag only; requested() polls
+    def requested(self):          # normal-thread code does the RPC
+        if self._flag.is_set():
+            self._maybe_post()
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddle_tpu.analysis.concurrency import blocking_reason, \
+    get_concurrency
+from paddle_tpu.analysis.context import walk_own
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+@register(
+    "signal-handler-unsafe",
+    "locks / RPC / blocking work reachable from a signal handler",
+    _DOC)
+def check(module) -> List[Finding]:
+    mc = get_concurrency(module)
+    out: List[Finding] = []
+    for root, owner in mc.all_roots:
+        if root.kind != "signal":
+            continue
+        hname = getattr(root.func, "name", "<lambda>")
+        units = mc.closure_units(root, owner)
+        if root.func not in units:
+            units = [root.func] + units
+        seen_lines = set()
+        for unit in units:
+            for node in walk_own(unit):
+                msg = None
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    held_inside = mc.locksets.get(
+                        id(node.body[0])) if node.body else None
+                    before = mc.locksets.get(id(node), frozenset())
+                    if held_inside and held_inside - (before or
+                                                      frozenset()):
+                        lock = ", ".join(sorted(
+                            held_inside - (before or frozenset())))
+                        msg = f"acquires lock [{lock}]"
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "acquire":
+                        msg = "acquires a lock via .acquire()"
+                    else:
+                        why = blocking_reason(module, node)
+                        if why is not None:
+                            msg = why
+                if msg is None:
+                    continue
+                line = getattr(node, "lineno", 0)
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                via = "" if unit is root.func else \
+                    f" (reached via '{getattr(unit, 'name', '?')}')"
+                out.append(module.finding(
+                    "signal-handler-unsafe", node,
+                    f"signal handler '{hname}' (registered at line "
+                    f"{getattr(root.reg_node, 'lineno', '?')}) {msg}"
+                    f"{via} — handlers interrupt the main thread "
+                    f"between bytecodes, so this can deadlock on a "
+                    f"lock the interrupted code holds or re-enter "
+                    f"non-reentrant state; set a flag in the handler "
+                    f"and do the work from a polling thread"))
+    return out

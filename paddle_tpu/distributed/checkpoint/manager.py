@@ -202,7 +202,7 @@ class CheckpointManager:
                 self._write_and_commit(step, arrays, tensors_meta,
                                        data_file, objects, barrier)
             except BaseException as e:  # surfaced on next save()/wait()
-                self._error = e
+                self._error = e  # tpulint: disable=unlocked-shared-state (readers go through wait(), whose Thread.join() is the happens-before edge for this write)
 
         self._thread = threading.Thread(
             target=runner, name=f"ckpt-writer-step{step}", daemon=True)

@@ -75,7 +75,7 @@ class Heartbeat:
         try:
             self._store.set(f"nodes/{self._node_id}", json.dumps(
                 {"ts": time.time(), **self._payload}))
-            self._misses = 0
+            self._misses = 0  # tpulint: disable=unlocked-shared-state (start() runs _beat() once before Thread.start(); afterwards only the heartbeat thread touches _misses)
         except Exception:
             self._misses = getattr(self, "_misses", 0) + 1
             if self._misses == 3:

@@ -396,6 +396,11 @@ class PreemptionMonitor:
         self._prev = {}
         self._ch = _StoreChannel(PREEMPT_KEY)
         self._last_poll = 0.0
+        # the signal handler may ONLY set the Event: store RPC (socket/
+        # file IO + JSON allocation) at an arbitrary interruption point
+        # is signal-handler-unsafe. The broadcast is deferred to the
+        # next requested() poll; _posted keeps it to one record.
+        self._posted = False
 
     @property
     def _store(self):
@@ -417,8 +422,11 @@ class PreemptionMonitor:
         sigs = tuple(signals) if signals else (_signal.SIGTERM,)
 
         def handler(signum, frame):
+            # flag-only by design: handlers interrupt the main thread
+            # between bytecodes, so anything heavier (the store post)
+            # can deadlock on state the interrupted code holds — the
+            # next requested() poll broadcasts the notice instead
             self._flag.set()
-            self._post()
             prev = self._prev.get(signum)
             if callable(prev):
                 prev(signum, frame)
@@ -450,18 +458,28 @@ class PreemptionMonitor:
         self._installed = False
 
     def request(self):
-        """Programmatic preemption (tests, schedulers draining a host)."""
+        """Programmatic preemption (tests, schedulers draining a host).
+        Runs on an ordinary thread, so unlike the signal handler it may
+        post synchronously — peers see the notice before this returns."""
         self._flag.set()
+        self._posted = True
         self._post()
 
     def requested(self) -> bool:
         if self._flag.is_set():
+            if not self._posted:
+                # the deferred half of the signal handler: broadcast the
+                # notice from poll context, where store IO is safe
+                self._posted = True
+                self._post()
             return True
         now = time.monotonic()
         if now - self._last_poll < ABORT_POLL_S:
             return False
         self._last_poll = now
         if self._check_remote():
+            # the peer's record is already in the store — don't echo it
+            self._posted = True
             self._flag.set()
             return True
         return False

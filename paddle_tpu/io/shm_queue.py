@@ -42,17 +42,17 @@ def _build_lib():
         cache = os.environ.get(
             "PADDLE_TPU_CACHE",
             os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
-        os.makedirs(cache, exist_ok=True)
+        os.makedirs(cache, exist_ok=True)  # tpulint: disable=blocking-under-lock (one-time double-checked build: the lock exists precisely to serialize the slow compile)
         so = os.path.join(cache, "libshm_queue.so")
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 tmp = so + f".tmp{os.getpid()}"
-                subprocess.run(
+                subprocess.run(  # tpulint: disable=blocking-under-lock (one-time double-checked build: the lock exists precisely to serialize the slow compile)
                     ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src,
                      "-lpthread"],
                     check=True, capture_output=True)
-                os.replace(tmp, so)
+                os.replace(tmp, so)  # tpulint: disable=blocking-under-lock (one-time double-checked build: the lock exists precisely to serialize the slow compile)
             lib = ctypes.CDLL(so)
             lib.shm_queue_init.restype = ctypes.c_uint64
             lib.shm_queue_init.argtypes = [ctypes.c_void_p,

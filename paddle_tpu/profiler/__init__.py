@@ -628,6 +628,11 @@ def estimate_mfu(flops_per_step: float, step_time_s: float,
 # per step)
 # ---------------------------------------------------------------------------
 _counter_providers: Dict[str, Callable] = {}
+# registrations arrive from arbitrary threads (weakref.finalize callbacks
+# fire on whichever thread drops the last reference); the lock covers the
+# dict, not the providers — counters() calls those outside it because a
+# provider may itself sync device state or take the caller's locks
+_prov_lock = threading.Lock()
 
 
 def register_counter_provider(name: str, fn: Callable) -> None:
@@ -635,23 +640,32 @@ def register_counter_provider(name: str, fn: Callable) -> None:
     :func:`counters` under ``name``. Used by e.g. TrainStep's
     ``skip_nonfinite`` guard to surface its device-carried skip count.
     A provider returning None (dead weakref) is dropped."""
-    _counter_providers[name] = fn
+    with _prov_lock:
+        _counter_providers[name] = fn
 
 
 def unregister_counter_provider(name: str) -> None:
-    _counter_providers.pop(name, None)
+    with _prov_lock:
+        _counter_providers.pop(name, None)
 
 
 def counters() -> Dict[str, float]:
     """Current values of every registered observability counter."""
+    with _prov_lock:
+        providers = list(_counter_providers.items())
     out = {}
-    for name in list(_counter_providers):
+    dead = []
+    for name, fn in providers:
         try:
-            v = _counter_providers[name]()
+            v = fn()
         except Exception:
             continue
         if v is None:  # provider's subject was garbage-collected
-            _counter_providers.pop(name, None)
+            dead.append(name)
             continue
         out[name] = v
+    if dead:
+        with _prov_lock:
+            for name in dead:
+                _counter_providers.pop(name, None)
     return out

@@ -61,6 +61,7 @@ preemption) — see paddle_tpu/testing/faults.py.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
@@ -474,6 +475,10 @@ class LLMEngine:
         self._preempt = None            # PreemptionMonitor once installed
         self._pending_outputs: List[RequestOutput] = []
         self._seen_shapes: set = set()  # (kind, B, S) already compiled
+        # hung-step hand-off: the watchdog MONITOR thread writes the
+        # tags, the dispatching thread swaps them out — one lock covers
+        # both sides (lockcheck: unlocked-shared-state)
+        self._hung_lock = threading.Lock()
         self._hung_tags: Optional[str] = None
         if self.cfg.step_timeout_s > 0:
             from paddle_tpu.distributed.watchdog import StepWatchdog
@@ -653,7 +658,8 @@ class LLMEngine:
         """Watchdog thread callback: note the hang; the dispatching
         thread surfaces it as StepHungError when (if) the step
         completes."""
-        self._hung_tags = ", ".join(ent[0] for ent in expired)
+        with self._hung_lock:
+            self._hung_tags = ", ".join(ent[0] for ent in expired)
 
     def release_request(self, request_id: str) -> Optional[Request]:
         """Drop a FINISHED request's bookkeeping (long-lived engines —
@@ -961,12 +967,13 @@ class LLMEngine:
         # retried attempt re-reads the PRE-failure cache state
         self._kcs, self._vcs = kcs, vcs
         self._seen_shapes.add(shape_key)
-        if self._hung_tags is not None:
+        with self._hung_lock:
+            tags, self._hung_tags = self._hung_tags, None
+        if tags is not None:
             # the deadline fired while this (eventually-completed)
             # dispatch was in flight: the device is unhealthy-slow;
             # fail the engine with drain semantics rather than serve
             # SLO-less
-            tags, self._hung_tags = self._hung_tags, None
             outs = self._abort_running("aborted:error")
             self._fail_closed()
             raise StepHungError(

@@ -147,9 +147,13 @@ class ServingMetrics:
         burst of long prompts raises the estimate even at a shallow
         queue depth. None while the engine has no step history (cold
         start — admission abstains rather than reject on a guess)."""
-        if not self._step_times_s:
+        # snapshot first: the engine thread appends concurrently, and
+        # iterating a deque that grows past maxlen mid-sum raises
+        # "deque mutated during iteration" (tuple() is atomic under the GIL)
+        times = tuple(self._step_times_s)
+        if not times:
             return None
-        avg = sum(self._step_times_s) / len(self._step_times_s)
+        avg = sum(times) / len(times)
         steps = queue_depth + 1.0
         if tokens_per_step:
             steps += (queued_prefill_tokens + prompt_tokens) / tokens_per_step

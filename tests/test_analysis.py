@@ -26,7 +26,10 @@ def test_rule_catalog_has_all_launch_rules():
     names = set(get_rules())
     assert {"host-sync-in-traced", "use-after-donate",
             "trace-time-impurity", "tensor-bool-branch",
-            "counter-provider-leak", "block-until-ready-in-loop"} <= names
+            "counter-provider-leak", "block-until-ready-in-loop",
+            "unlocked-shared-state", "lock-order-cycle",
+            "blocking-under-lock", "signal-handler-unsafe",
+            "collective-divergence", "finish-reason-literal"} <= names
     for r in get_rules().values():
         assert r.summary and r.doc  # per-rule docs are part of the API
 
@@ -971,3 +974,505 @@ class TestBaselineAndCli:
         assert rules_of(fs) == ["parse-error"]
         assert "cannot read" in fs[0].message
         assert cli_main([str(tmp_path)]) == 1  # reported, not crashed
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-state (lockcheck)
+# ---------------------------------------------------------------------------
+class TestUnlockedSharedState:
+    def test_thread_writes_main_reads_no_lock(self):
+        fs = run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def start(self):
+                    self._t.start()
+
+                def _loop(self):
+                    while True:
+                        self.count += 1
+
+                def snapshot(self):
+                    return self.count
+        """)
+        assert rules_of(fs) == ["unlocked-shared-state"]
+        assert "count" in fs[0].message
+        assert "thread:_loop" in fs[0].message
+
+    def test_near_miss_lock_on_both_sides_clean(self):
+        fs = run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def start(self):
+                    self._t.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.count += 1
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.count
+        """)
+        assert fs == []
+
+    def test_near_miss_read_only_shared_attr_clean(self):
+        # both roots only READ the attr: no write, no race
+        fs = run("""
+            import threading
+
+            class Worker:
+                def __init__(self, cfg):
+                    self.cfg = cfg
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def start(self):
+                    self._t.start()
+
+                def _loop(self):
+                    print(self.cfg)
+
+                def snapshot(self):
+                    return self.cfg
+        """)
+        assert fs == []
+
+    def test_near_miss_sync_object_attr_clean(self):
+        # threading.Event is itself a synchronization primitive
+        fs = run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._flag = threading.Event()
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def start(self):
+                    self._t.start()
+
+                def _loop(self):
+                    self._flag.set()
+
+                def done(self):
+                    return self._flag.is_set()
+        """)
+        assert fs == []
+
+    def test_timer_and_finalizer_count_as_roots(self):
+        fs = run("""
+            import threading
+            import weakref
+
+            class Cache:
+                def __init__(self, obj):
+                    self.hits = 0
+                    weakref.finalize(obj, self._evict)
+                    self._timer = threading.Timer(5.0, self._tick)
+
+                def _evict(self):
+                    self.hits = 0
+
+                def _tick(self):
+                    self.hits += 1
+
+                def lookup(self):
+                    self.hits += 1
+        """)
+        assert rules_of(fs) == ["unlocked-shared-state"]
+
+    def test_suppression_with_reason_honored(self):
+        fs = run("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def start(self):
+                    self._t.start()
+
+                def _loop(self):
+                    self.count += 1  # tpulint: disable=unlocked-shared-state (joined before any read)
+
+                def snapshot(self):
+                    return self.count
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+class TestLockOrderCycle:
+    def test_inverted_pair_flagged(self):
+        fs = run("""
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert rules_of(fs) == ["lock-order-cycle"]
+        assert "->" in fs[0].message
+
+    def test_near_miss_consistent_order_clean(self):
+        fs = run("""
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ab2(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+class TestBlockingUnderLock:
+    def test_sleep_inside_with_lock(self):
+        fs = run("""
+            import threading
+            import time
+
+            class Poller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """)
+        assert rules_of(fs) == ["blocking-under-lock"]
+        assert "_lock" in fs[0].message
+
+    def test_store_rpc_inside_registry_lock(self):
+        fs = run("""
+            import threading
+
+            class Registry:
+                def __init__(self, store):
+                    self._lock = threading.Lock()
+                    self._store = store
+
+                def publish(self, k, v):
+                    with self._lock:
+                        self._store.set(k, v)
+        """)
+        assert rules_of(fs) == ["blocking-under-lock"]
+
+    def test_near_miss_sleep_after_release_clean(self):
+        fs = run("""
+            import threading
+            import time
+
+            class Poller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(1.0)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# signal-handler-unsafe
+# ---------------------------------------------------------------------------
+class TestSignalHandlerUnsafe:
+    def test_store_rpc_in_handler(self):
+        fs = run("""
+            import signal
+
+            class Mon:
+                def __init__(self, store):
+                    self._store = store
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self._store.set("preempt", "1")
+        """)
+        assert rules_of(fs) == ["signal-handler-unsafe"]
+        assert "_on_term" in fs[0].message
+
+    def test_lock_acquire_in_handler_callee(self):
+        # reached transitively: handler -> self._record() -> with lock
+        fs = run("""
+            import signal
+            import threading
+
+            class Mon:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self._record()
+
+                def _record(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert "signal-handler-unsafe" in rules_of(fs)
+
+    def test_near_miss_flag_only_handler_clean(self):
+        fs = run("""
+            import signal
+            import threading
+
+            class Mon:
+                def __init__(self):
+                    self._flag = threading.Event()
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self._flag.set()
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# collective-divergence
+# ---------------------------------------------------------------------------
+class TestCollectiveDivergence:
+    def test_psum_under_rank_branch_in_shard_map(self):
+        fs = run("""
+            import jax
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                if lax.axis_index("dp") == 0:
+                    x = lax.psum(x, "dp")
+                return x
+
+            f = shard_map(body, mesh=None, in_specs=None,
+                          out_specs=None)
+        """)
+        assert "collective-divergence" in rules_of(fs)
+        f = [x for x in fs if x.rule == "collective-divergence"][0]
+        assert "psum" in f.message and "deadlock" in f.message
+
+    def test_collective_inside_cond_branch(self):
+        fs = run("""
+            import jax
+            from jax import lax
+
+            @jax.jit
+            def step(x, p):
+                def tru(x):
+                    return lax.psum(x, "dp")
+                def fls(x):
+                    return x
+                return lax.cond(p, tru, fls, x)
+        """)
+        assert rules_of(fs) == ["collective-divergence"]
+        assert "lax.cond" in fs[0].message
+
+    def test_near_miss_hoisted_collective_clean(self):
+        # the fix pattern: every rank issues the collective
+        fs = run("""
+            import jax
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                s = lax.psum(x, "dp")
+                return s
+
+            f = shard_map(body, mesh=None, in_specs=None,
+                          out_specs=None)
+        """)
+        assert fs == []
+
+    def test_near_miss_host_static_branch_clean(self):
+        # `if causal:` is a Python bool closed over at trace time —
+        # every rank traces the same arm
+        fs = run("""
+            import jax
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def make(causal):
+                def body(x):
+                    if causal:
+                        x = lax.psum(x, "dp")
+                    return x
+                return shard_map(body, mesh=None, in_specs=None,
+                                 out_specs=None)
+        """)
+        assert fs == []
+
+    def test_near_miss_host_code_clean(self):
+        # no traced scope at all: a collective name in host code is
+        # someone else's problem (it would fail loudly anyway)
+        fs = run("""
+            from jax import lax
+
+            def host(x, rank):
+                if rank == 0:
+                    return lax.psum(x, "dp")
+                return x
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# finish-reason-literal
+# ---------------------------------------------------------------------------
+class TestFinishReasonLiteral:
+    def test_unknown_literal_in_abort_call(self):
+        fs = run("""
+            from paddle_tpu.serving.request import Request
+
+            def kill(eng, rid):
+                eng.abort(rid, "expire")
+        """)
+        assert rules_of(fs) == ["finish-reason-literal"]
+        assert "'expire'" in fs[0].message
+
+    def test_unknown_literal_in_assignment_and_kwarg(self):
+        fs = run("""
+            from paddle_tpu.serving.request import Request
+
+            def finish(req, eng, rid):
+                req.finish_reason = "aborted:oom"
+                eng._finalize(rid, finish_reason="done")
+        """)
+        assert rules_of(fs) == ["finish-reason-literal"] * 2
+
+    def test_near_miss_vocabulary_literal_clean(self):
+        fs = run("""
+            from paddle_tpu.serving.request import Request
+
+            def kill(eng, rid):
+                eng.abort(rid, "aborted:user")
+        """)
+        assert fs == []
+
+    def test_near_miss_module_without_serving_import_clean(self):
+        # the vocabulary only applies where serving.request is in play
+        fs = run("""
+            def kill(eng, rid):
+                eng.abort(rid, "expire")
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lockcheck rules: baseline + CLI integration
+# ---------------------------------------------------------------------------
+RACY = """import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def _loop(self):
+        self.count += 1
+
+    def snapshot(self):
+        return self.count
+"""
+
+
+class TestLockcheckBaselineAndCli:
+    def test_new_rule_findings_baseline_roundtrip(self, tmp_path, capsys):
+        path = tmp_path / "racy.py"
+        path.write_text(RACY)
+        base = str(tmp_path / "baseline.json")
+        assert cli_main([str(path)]) == 1
+        capsys.readouterr()
+        assert cli_main([str(path), "--baseline", base,
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli_main([str(path), "--baseline", base]) == 0
+
+    def test_only_flag_restricts_rule_set(self, tmp_path, capsys):
+        path = tmp_path / "racy.py"
+        path.write_text(RACY)
+        assert cli_main([str(path), "--only",
+                         "unlocked-shared-state"]) == 1
+        out = capsys.readouterr().out
+        assert "unlocked-shared-state" in out
+        assert cli_main([str(path), "--only", "lock-order-cycle"]) == 0
+        assert cli_main([str(path), "--only", "typo-rule"]) == 2
+
+    def test_only_does_not_hide_bad_suppressions(self, tmp_path):
+        # meta rules stay active under --only: a reasonless suppression
+        # must not sneak in through a narrowed lint run
+        path = tmp_path / "sup.py"
+        path.write_text(
+            "import os\n"
+            "x = os.getpid()  # tpulint: disable=host-sync-in-traced\n")
+        assert cli_main([str(path), "--only", "lock-order-cycle"]) == 1
+
+    def test_write_baseline_order_independent(self, tmp_path):
+        """Identical trees must produce byte-identical baselines no
+        matter how the caller ordered the findings (occurrence
+        numbering is order-sensitive without the internal sort)."""
+        path = tmp_path / "racy.py"
+        # two identical racy lines -> identical snippets -> occurrence
+        # disambiguation kicks in
+        path.write_text(RACY.replace(
+            "        self.count += 1\n",
+            "        self.count += 1\n        self.count += 1\n"))
+        findings = analyze_paths([str(path)])
+        assert len(findings) >= 1
+        b1, b2 = str(tmp_path / "b1.json"), str(tmp_path / "b2.json")
+        write_baseline(b1, findings)
+        write_baseline(b2, list(reversed(findings)))
+        assert open(b1).read() == open(b2).read()
