@@ -242,7 +242,11 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
     smoke config the slow-marked tier test runs. A trailing comparison
     phase (ISSUE 9) runs one shared-prefix workload through a bucketed
     AND a ragged engine and reports the padding/prefix-cache/compile
-    deltas as ``extra["ragged_comparison"]``."""
+    deltas as ``extra["ragged_comparison"]``. Two more trailing phases
+    (ISSUE 11) trend the in-graph sampler and speculative decoding:
+    ``extra["sampled_decode"]`` (seeded sampled requests, zero logits
+    fetches asserted) and ``extra["speculative"]`` (self-draft k=3,
+    acceptance counters + tokens/s vs the sampled baseline)."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -392,6 +396,72 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
         "mixed_steps": c_snap_r["mixed_steps"],
     }
 
+    # in-graph sampled decode (ISSUE 11): seeded sampled requests
+    # through the fused device sampler — the step fetches B packed int32
+    # rows, never logits (asserted, so the bench can't silently regress
+    # to host sampling). Wave 1 warms the compile, wave 2 is timed.
+    smp_rng = np.random.RandomState(seed + 2)
+    s_prompts = [list(smp_rng.randint(0, cfg.vocab_size, size=5 + i % 4))
+                 for i in range(6)]
+    s_sp = [SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9,
+                           seed=50 + i) for i in range(len(s_prompts))]
+    s_eng = LLMEngine(model, EngineConfig(
+        block_size=4, max_num_seqs=4, max_model_len=64))
+    s_dt, s_gen = 0.0, 0
+    for wave in range(2):
+        if wave:
+            s_eng.reset_metrics()   # wave 1 was compile warmup
+        rids = [s_eng.add_request(list(p), sampling=s)
+                for p, s in zip(s_prompts, s_sp)]
+        t = time.perf_counter()
+        while s_eng.has_unfinished():
+            s_eng.step()
+        s_dt = time.perf_counter() - t   # keep wave 2's time
+        s_gen = sum(len(s_eng.get_request(r).generated) for r in rids)
+    assert s_eng.num_logits_fetches == 0, "sampled decode fetched logits"
+    s_snap = s_eng.metrics.snapshot()
+    sampled_cmp = {
+        "tokens_per_sec": round(s_gen / s_dt, 2),
+        "tpot_ms_avg": s_snap["tpot_ms_avg"],
+        "sampled_steps": s_eng.num_sampled_steps,
+        "logits_fetches": s_eng.num_logits_fetches,
+    }
+
+    # speculative decoding (ISSUE 11): the same sampled workload plus a
+    # draft proposing k tokens per decode row, verified inside the one
+    # ragged step. Random-init weights have no distilled draft, so the
+    # target drafts for ITSELF — that pins the mechanism end-to-end and
+    # trends the acceptance counters at their upper bound (a greedy
+    # self-draft verifies ~everything; sampled rows reject whatever the
+    # temperature disagrees with).
+    k_eng = LLMEngine(model, EngineConfig(
+        block_size=4, max_num_seqs=4, max_model_len=64,
+        draft_model=model, num_spec_tokens=3))
+    k_dt, k_gen = 0.0, 0
+    for wave in range(2):
+        if wave:
+            k_eng.reset_metrics()   # wave 1 was compile warmup
+        rids = [k_eng.add_request(list(p), sampling=s)
+                for p, s in zip(s_prompts, s_sp)]
+        t = time.perf_counter()
+        while k_eng.has_unfinished():
+            k_eng.step()
+        k_dt = time.perf_counter() - t   # keep wave 2's time
+        k_gen = sum(len(k_eng.get_request(r).generated) for r in rids)
+    assert k_eng.num_logits_fetches == 0, "spec decode fetched logits"
+    assert k_eng.num_spec_proposed > 0
+    k_snap = k_eng.metrics.snapshot()
+    spec_cmp = {
+        "tokens_per_sec": round(k_gen / k_dt, 2),
+        "tpot_ms_avg": k_snap["tpot_ms_avg"],
+        "num_spec_tokens": 3,
+        "spec_proposed": k_eng.num_spec_proposed,
+        "spec_accepted": k_eng.num_spec_accepted,
+        "spec_acceptance_rate": round(k_eng.spec_acceptance_rate, 4),
+        "vs_sampled_decode": round(s_dt / k_dt, 3),
+        "logits_fetches": k_eng.num_logits_fetches,
+    }
+
     return {
         "metric": "serving_tokens_per_sec",
         "value": round(snap["num_generated_tokens"] / dt, 2),
@@ -407,6 +477,8 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
             **snap,
             "resilience_smoke": resilience,
             "ragged_comparison": ragged_cmp,
+            "sampled_decode": sampled_cmp,
+            "speculative": spec_cmp,
         },
     }
 

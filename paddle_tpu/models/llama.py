@@ -503,6 +503,36 @@ class LlamaForCausalLM(nn.Layer):
         logits = self.lm_head(Tensor._from_data(h_last))
         return logits, kcs, vcs
 
+    def forward_ragged_multi(self, input_ids, key_caches, value_caches,
+                             block_tables, cu_seqlens, context_lens,
+                             num_seqs, gather_offsets):
+        """Ragged serving step with a PER-ROW MULTI-LOGIT gather: lm_head
+        on each slot's last ``R = gather_offsets.shape[0]`` packed tokens
+        (the speculative-verify positions — ``gather_offsets`` is just
+        ``arange(R)``; only its static shape matters). Returns
+        (logits (S, R, vocab), key_caches', value_caches').
+        ``R == 1`` reduces to :meth:`forward_ragged`; rows shorter than R
+        clamp to their own first position (the sampler masks them by
+        ``n_draft``, so the duplicated logits are never consumed)."""
+        h, kcs, vcs = self.llama.forward_ragged(
+            input_ids, key_caches, value_caches, block_tables,
+            cu_seqlens, context_lens, num_seqs)
+        cu = (cu_seqlens._data if isinstance(cu_seqlens, Tensor)
+              else jnp.asarray(cu_seqlens)).astype(jnp.int32)
+        off = (gather_offsets._data if isinstance(gather_offsets, Tensor)
+               else jnp.asarray(gather_offsets)).astype(jnp.int32)
+        r = off.shape[0]
+        hd = h._data if isinstance(h, Tensor) else h
+        t = hd.shape[1]
+        idx = cu[1:, None] - r + off[None, :]          # (S, R)
+        idx = jnp.maximum(idx, cu[:-1, None])
+        idx = jnp.clip(idx, 0, t - 1)
+        h_g = hd[0, idx.reshape(-1)]                   # (S*R, hidden)
+        logits = self.lm_head(Tensor._from_data(h_g))
+        lg = logits._data if isinstance(logits, Tensor) else logits
+        s = cu.shape[0] - 1
+        return Tensor._from_data(lg.reshape(s, r, -1)), kcs, vcs
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k=0, use_cache=None):
         """Decode ``max_new_tokens`` continuations. ``use_cache`` routes

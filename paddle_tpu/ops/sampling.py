@@ -1,0 +1,158 @@
+"""In-graph token sampling + speculative-verify (serving hot path).
+
+Everything here runs INSIDE the engine's compiled step, so a sampled
+decode iteration ships B int32 tokens (plus the per-slot RNG keys) to
+host — never the B×vocab logits. Three layers:
+
+* :func:`filtered_probs` — fused temperature / top-k / top-p transform
+  of a batch of logit rows into sampling distributions. Greedy rows
+  (``temperature <= 0``) become an EXACT one-hot at ``argmax(logits)``
+  (first-occurrence tie-breaking, matching ``np.argmax``), which keeps
+  the greedy path bit-identical to the host oracle and lets one code
+  path serve mixed greedy/sampled batches.
+* :func:`sample_tokens` — one categorical draw per slot from its own
+  PRNG key (the per-request stream the engine persists), returning the
+  advanced keys alongside the tokens.
+* :func:`sample_or_verify` — the general form: each slot carries
+  ``n_draft`` speculative tokens proposed by a draft model and ``R =
+  logits.shape[1]`` gathered logit rows (the last R packed positions of
+  the slot's ragged row). Standard rejection sampling runs per slot:
+  draft token i is accepted with probability ``p_target(t_i)`` (the
+  draft proposes greedily, i.e. ``q`` is a point mass, so ``min(1,
+  p/q) = p(t_i)``), a rejection emits one corrected token drawn from
+  ``p`` with ``t_i`` masked out (``norm(max(0, p - q))`` for a point
+  mass), and a fully-accepted draft earns one bonus token from the last
+  row. The emitted-token marginal is EXACTLY the target distribution at
+  every position (the rejection-sampling guarantee, pinned against the
+  CPU oracle by tests/test_spec_decode.py); a greedy target degenerates
+  to exact prefix match, so speculative greedy decode is token-identical
+  to the non-speculative engine. ``n_draft == 0`` rows reduce to plain
+  :func:`sample_tokens` — ONE code path runs mixed normal/verify
+  batches.
+
+RNG-stream contract: every call advances each slot's key by a FIXED
+number of splits (``2*(R-1) + 1``), independent of the slot's data, so
+a request's stream position is a pure function of how many engine steps
+emitted for it — what makes fleet drain hand-off (which carries the
+key) bit-identical to an uninterrupted engine.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["filtered_probs", "sample_tokens", "sample_or_verify"]
+
+
+def filtered_probs(logits, temperature, top_k, top_p):
+    """Per-row sampling distributions: ``logits`` (S, V); ``temperature``
+    (S,) float (``<= 0`` = greedy one-hot); ``top_k`` (S,) int (0 = off);
+    ``top_p`` (S,) float (1.0 = off). Returns (S, V) probabilities.
+
+    Mirrors the engine's host oracle (``LLMEngine._sample``) transform
+    order — temperature softmax, then top-k renormalized, then the
+    smallest nucleus with cumulative mass >= top_p — in f32 (the oracle
+    runs f64; parity is distributional, pinned statistically)."""
+    lg = logits.astype(jnp.float32)
+    v = lg.shape[-1]
+    greedy = temperature <= 0.0
+    t = jnp.where(greedy, 1.0, temperature)[:, None]
+    x = lg / t
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    p = jnp.exp(x)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    # top-k: zero everything below the k-th largest probability
+    desc = jnp.sort(p, axis=-1)[:, ::-1]
+    k_eff = jnp.where((top_k > 0) & (top_k < v), top_k, v)
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    p = jnp.where(p >= kth, p, 0.0)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    # top-p: keep the smallest descending-order prefix whose cumulative
+    # mass reaches top_p (same keep_n = searchsorted(csum, top_p) + 1
+    # rule as the host oracle)
+    order = jnp.argsort(-p, axis=-1)
+    sp = jnp.take_along_axis(p, order, axis=-1)
+    csum = jnp.cumsum(sp, axis=-1)
+    keep_n = jnp.sum((csum < top_p[:, None]).astype(jnp.int32),
+                     axis=-1) + 1
+    rank = jnp.argsort(order, axis=-1)
+    p = jnp.where(rank < keep_n[:, None], p, 0.0)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(jnp.argmax(lg, axis=-1), v, dtype=p.dtype)
+    return jnp.where(greedy[:, None], onehot, p)
+
+
+def _split_rows(keys):
+    """Advance a (S, 2) uint32 key batch one split: returns
+    ``(chain_keys, draw_keys)``, each (S, 2)."""
+    ks = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return ks[:, 0], ks[:, 1]
+
+
+def sample_or_verify(logits, draft_tokens, n_draft, keys, temperature,
+                     top_k, top_p):
+    """Rejection-sample ``n_draft`` proposed tokens per slot and draw the
+    corrected/bonus token, in one fused pass.
+
+    ``logits`` (S, R, V): row j is the target distribution for the
+    slot's draft token j (relative to its own draft window — the engine
+    gathers the LAST R packed positions of each row, so a slot with
+    ``d < R-1`` drafts finds its window right-aligned: verify rows start
+    at index ``R-1-d``). ``draft_tokens`` (S, R-1) int32 (garbage past
+    ``n_draft``); ``n_draft`` (S,) int32 in [0, R-1]; ``keys`` (S, 2)
+    uint32; sampling params (S,) as in :func:`filtered_probs`.
+
+    Returns ``(tokens (S, R) int32, n_emit (S,) int32, new_keys (S, 2)
+    uint32)`` — tokens[:, :n_emit] are valid: the accepted draft prefix
+    plus exactly one corrected-or-bonus token (``n_emit = accepted +
+    1``)."""
+    s, r, v = logits.shape
+    rows = jnp.arange(s)
+    out = jnp.zeros((s, r), jnp.int32)
+    n_emit = jnp.zeros((s,), jnp.int32)
+    done = jnp.zeros((s,), bool)
+    keys = keys.astype(jnp.uint32)
+    for j in range(r - 1):
+        idx = jnp.clip((r - 1) - n_draft + j, 0, r - 1)
+        lg = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        p = filtered_probs(lg, temperature, top_k, top_p)
+        t = jnp.clip(draft_tokens[:, j], 0, v - 1)
+        p_t = jnp.take_along_axis(p, t[:, None], axis=-1)[:, 0]
+        keys, sub = _split_rows(keys)
+        u = jax.vmap(jax.random.uniform)(sub)
+        keys, sub2 = _split_rows(keys)
+        # corrected draw: p with the rejected proposal masked out —
+        # norm(max(0, p - q)) for the greedy draft's point-mass q;
+        # categorical takes unnormalized log-mass, so no renorm (and no
+        # 0/0) is needed. Computed unconditionally, used only on reject.
+        p_rej = jnp.where(jnp.arange(v)[None, :] == t[:, None], 0.0, p)
+        corr = jax.vmap(jax.random.categorical)(sub2, jnp.log(p_rej))
+        active = (~done) & (j < n_draft)
+        acc = u < p_t
+        emit = jnp.where(acc, t, corr).astype(jnp.int32)
+        out = out.at[:, j].set(jnp.where(active, emit, out[:, j]))
+        n_emit = jnp.where(active, n_emit + 1, n_emit)
+        done = done | (active & ~acc)
+    # bonus (fully-accepted verify rows) == the plain sampling draw
+    # (n_draft == 0 rows): one token from the last gathered position
+    p = filtered_probs(logits[:, r - 1], temperature, top_k, top_p)
+    keys, sub = _split_rows(keys)
+    bonus = jax.vmap(jax.random.categorical)(sub, jnp.log(p))
+    active = ~done
+    slot = jnp.clip(n_emit, 0, r - 1)
+    cur = out[rows, slot]
+    out = out.at[rows, slot].set(
+        jnp.where(active, bonus.astype(jnp.int32), cur))
+    n_emit = jnp.where(active, n_emit + 1, n_emit)
+    return out, n_emit, keys
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """One sampled token per row: ``logits`` (S, V), ``keys`` (S, 2)
+    uint32. Returns ``(tokens (S,) int32, new_keys (S, 2) uint32)`` —
+    the ``n_draft == 0`` special case of :func:`sample_or_verify`."""
+    s = logits.shape[0]
+    out, _, keys2 = sample_or_verify(
+        logits[:, None, :], jnp.zeros((s, 0), jnp.int32),
+        jnp.zeros((s,), jnp.int32), keys, temperature, top_k, top_p)
+    return out[:, 0], keys2

@@ -315,6 +315,24 @@ class BlockManager:
             table.append(self._claim())
         return list(table)
 
+    def trim(self, request_id: str, num_tokens: int) -> int:
+        """Shrink the table to cover exactly ``num_tokens`` tokens,
+        releasing trailing blocks back to the free list — the
+        speculative-decode rollback: slots claimed for draft tokens the
+        target rejected return immediately. Trailing blocks were claimed
+        via :meth:`append_slot` this step (never prefix-registered, which
+        only ever covers the prompt), so ``_release`` just frees them.
+        No-op when the table already fits. Returns blocks released."""
+        table = self._tables.get(request_id)
+        if table is None:
+            return 0
+        keep = max(self.blocks_needed(max(num_tokens, 1)), 1)
+        released = 0
+        while len(table) > keep:
+            self._release(table.pop())
+            released += 1
+        return released
+
     def free(self, request_id: str) -> int:
         """Release every block the request owns — device AND host swap
         slots (completion, preemption, abort-while-swapped). Shared

@@ -27,10 +27,23 @@ the TPU-native execution model:
   join the running batch at the next step, and KV OOM preempts the
   lowest-priority request back to the waiting queue (recompute).
 
-Sampling runs host-side per request (greedy / temperature / top-p /
-top-k) on the last-token logits the compiled step returns — B×vocab is
-tiny next to the model pass, and per-request RNG streams stay
-reproducible across preemptions.
+Sampling runs IN-GRAPH (greedy / temperature / top-p / top-k fused
+with a categorical draw — :mod:`paddle_tpu.ops.sampling`): every step
+ships one packed (S, R+3) int32 row per slot to host — emitted tokens,
+emit count, and the advanced per-request RNG key — never the B×vocab
+logits. Per-request RNG streams are threefry keys held on
+:class:`~paddle_tpu.serving.request.Request` and advanced a fixed
+number of splits per emitting step, so they stay reproducible across
+preemptions AND across fleet drain hand-off; the numpy sampler
+(``LLMEngine._sample``) survives as the CPU oracle the device path is
+pinned against. Speculative decoding rides the same machinery:
+``EngineConfig(draft_model=, num_spec_tokens=k)`` proposes k greedy
+draft tokens per decode row (:class:`paddle_tpu.serving.spec.
+SpecDecoder`), the target verifies them in the SAME ragged step as
+mid-context multi-token rows (R = k+1 logit rows gathered per slot),
+and fused rejection sampling emits the accepted prefix plus one
+corrected/bonus token — token-identical to the plain engine for
+greedy, distribution-correct for sampled.
 
 Resilience layer (the serving analog of PR 3's fault-tolerant
 training):
@@ -139,6 +152,12 @@ class EngineConfig:
     # arrival exceeds the SLO (None = unbounded / no SLO)
     max_queue_depth: Optional[int] = None
     ttft_slo_ms: Optional[float] = None
+    # speculative decoding: a small draft model proposes num_spec_tokens
+    # greedy continuations per decode row each iteration; the target
+    # verifies them inside its one ragged step with fused rejection
+    # sampling. Both knobs or neither; requires the ragged path.
+    draft_model: Optional[object] = None
+    num_spec_tokens: int = 0
     # drain: running requests get this long to finish after a drain
     # starts (SIGTERM / preemption notice); stragglers then abort with
     # finish_reason='aborted:drain'
@@ -175,6 +194,12 @@ class EngineConfig:
             raise ValueError("step_timeout_s must be >= 0")
         if self.max_step_retries < 0:
             raise ValueError("max_step_retries must be >= 0")
+        if self.num_spec_tokens < 0:
+            raise ValueError("num_spec_tokens must be >= 0")
+        if (self.draft_model is None) != (self.num_spec_tokens == 0):
+            raise ValueError(
+                "speculative decoding takes BOTH draft_model and "
+                "num_spec_tokens >= 1, or neither")
         # max_num_seqs / max_batched_tokens validate in SchedulerConfig
 
 
@@ -359,6 +384,36 @@ class LLMEngine:
         self._ragged_T = min(self.cfg.max_batched_tokens,
                              self.cfg.max_num_seqs * self.cfg.max_model_len)
 
+        # -- speculative-decoding resolution ----------------------------
+        if self.cfg.draft_model is not None:
+            if not self._ragged:
+                raise ValueError(
+                    "speculative decoding rides the ragged step (verify "
+                    "rows are mid-context multi-token rows) — it cannot "
+                    "run with ragged=False")
+            dcfg = getattr(self.cfg.draft_model, "config", None)
+            dv = getattr(dcfg, "vocab_size", None)
+            if dv != mcfg.vocab_size:
+                raise ValueError(
+                    f"draft/target tokenizer-width mismatch: draft "
+                    f"vocab_size {dv} != target vocab_size "
+                    f"{mcfg.vocab_size} — the models must share one "
+                    f"tokenizer")
+            if not hasattr(model, "forward_ragged_multi"):
+                raise ValueError(
+                    "speculative decoding needs the target model to "
+                    "expose forward_ragged_multi (the per-row "
+                    "multi-logit gather)")
+            from paddle_tpu.serving.spec import SpecDecoder
+
+            self._spec = SpecDecoder(self.cfg.draft_model,
+                                     self.cfg.num_spec_tokens)
+        else:
+            self._spec = None
+        # R = verify width: logit rows gathered (and token slots packed)
+        # per slot in the compiled step — 1 without speculation
+        self._spec_R = self.cfg.num_spec_tokens + 1
+
         self.block_manager = BlockManager(
             self.cfg.num_blocks, self.cfg.block_size,
             num_host_blocks=self.cfg.num_host_blocks,
@@ -403,26 +458,39 @@ class LLMEngine:
 
         # -- compiled prefill/decode step -------------------------------
         from paddle_tpu.jit.trace import functionalize
+        from paddle_tpu.ops.sampling import sample_or_verify
 
         apply, (_, self._params), (_, self._buffers) = functionalize(
             model.forward_paged)
 
+        def pack_sampled(lg3, sdraft, sndraft, skeys, stemp, stopk,
+                         stopp):
+            # fully in-graph sampling tail (the ROADMAP "in-graph
+            # sampling" arc): fused temperature/top-k/top-p +
+            # categorical draw — rejection-sampling verify when draft
+            # rows ride along — so every step ships ONE packed int32
+            # row per slot ([tokens(R), n_emit, key_hi, key_lo]) to
+            # host, never B×vocab logits. Greedy rows one-hot to the
+            # argmax, keeping the greedy path token-identical to
+            # np.argmax (pinned by tests/test_serving_engine.py); the
+            # per-slot finite bit is the nonfinite guard's observable.
+            finite = jnp.isfinite(lg3).all(axis=-1).all(axis=-1)
+            toks, n_emit, nkeys = sample_or_verify(
+                lg3, sdraft, sndraft, skeys, stemp, stopk, stopp)
+            packed = jnp.concatenate([
+                toks, n_emit[:, None],
+                jax.lax.bitcast_convert_type(nkeys, jnp.int32)], axis=1)
+            return packed, finite
+
         def raw_step(param_datas, buffer_datas, key, ids, kcs, vcs, bt,
-                     enc, dec, now):
+                     enc, dec, now, skeys, stemp, stopk, stopp):
             (logits, k2, v2), _ = apply(param_datas, buffer_datas, key,
                                         ids, kcs, vcs, bt, enc, dec, now)
-            # in-graph greedy sampling (the ROADMAP PR-4 follow-up):
-            # argmax runs on device so an all-greedy step ships B int32s
-            # to host instead of B×vocab logits. jnp.argmax and
-            # np.argmax share first-occurrence tie-breaking, so the two
-            # paths stay token-identical (pinned by
-            # tests/test_serving_engine.py).
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # in-graph non-finite guard: one bool per row, so the
-            # all-greedy path can detect a NaN/Inf-poisoned request
-            # without ever fetching its B×vocab logits
-            finite = jnp.isfinite(logits).all(axis=-1)
-            return logits, greedy, finite, k2, v2
+            b = logits.shape[0]
+            packed, finite = pack_sampled(
+                logits[:, None, :], jnp.zeros((b, 0), jnp.int32),
+                jnp.zeros((b,), jnp.int32), skeys, stemp, stopk, stopp)
+            return packed, finite, k2, v2
 
         donate = self.cfg.donate_cache
         if donate is None:
@@ -432,16 +500,31 @@ class LLMEngine:
             raw_step, donate_argnums=(4, 5) if donate else ())
 
         if self._ragged:
-            apply_r, _, _ = functionalize(model.forward_ragged)
+            spec_r = self._spec_R
+            if spec_r > 1:
+                apply_r, _, _ = functionalize(model.forward_ragged_multi)
+                # only gather_offsets' STATIC shape matters — baked in
+                # as a jit constant, it sets the per-row gather width
+                goff = np.arange(spec_r, dtype=np.int32)
+            else:
+                apply_r, _, _ = functionalize(model.forward_ragged)
+                goff = None
 
             def raw_step_ragged(param_datas, buffer_datas, key, ids, kcs,
-                                vcs, bt, cu, ctx, nseq):
-                (logits, k2, v2), _ = apply_r(
-                    param_datas, buffer_datas, key, ids, kcs, vcs, bt,
-                    cu, ctx, nseq)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                finite = jnp.isfinite(logits).all(axis=-1)
-                return logits, greedy, finite, k2, v2
+                                vcs, bt, cu, ctx, nseq, skeys, stemp,
+                                stopk, stopp, sdraft, sndraft):
+                if goff is None:
+                    (logits, k2, v2), _ = apply_r(
+                        param_datas, buffer_datas, key, ids, kcs, vcs,
+                        bt, cu, ctx, nseq)
+                    lg3 = logits[:, None, :]
+                else:
+                    (lg3, k2, v2), _ = apply_r(
+                        param_datas, buffer_datas, key, ids, kcs, vcs,
+                        bt, cu, ctx, nseq, goff)
+                packed, finite = pack_sampled(
+                    lg3, sdraft, sndraft, skeys, stemp, stopk, stopp)
+                return packed, finite, k2, v2
 
             self._jstep_ragged = jax.jit(
                 raw_step_ragged, donate_argnums=(4, 5) if donate else ())
@@ -451,10 +534,16 @@ class LLMEngine:
 
         self._requests: Dict[str, Request] = {}
         self._auto_id = itertools.count()
-        # steps that pulled the full B×vocab logits to host (sampled
-        # decode only; greedy steps ship B in-graph-argmax'd ints) —
-        # the observable tests/test_serving_engine.py pins
+        # steps that pulled the full B×vocab logits to host. Sampling is
+        # fully in-graph now, so the serving hot path NEVER increments
+        # this — tests pin it at 0 for pure sampled workloads; the
+        # counter survives as the regression observable.
         self.num_logits_fetches = 0
+        # speculative-decode lifetime counters (serving/spec_* gauges)
+        self.num_spec_proposed = 0
+        self.num_spec_accepted = 0
+        # steps whose batch held >= 1 sampled (temperature > 0) request
+        self.num_sampled_steps = 0
 
         # -- resilience state -------------------------------------------
         # lifetime counters (survive reset_metrics, like the
@@ -504,10 +593,14 @@ class LLMEngine:
         or ``add_request(prompt_ids, SamplingParams(...))``. Returns the
         request id.
 
-        ``rng_state`` (a ``np.random.Generator`` bit-generator state
-        dict) resumes the request's sampling stream mid-way — the fleet
-        router's drain hand-off passes the donor replica's stream state
-        so a re-enqueued sampled request continues token-identically."""
+        ``rng_state`` resumes the request's sampling stream mid-way —
+        the fleet router's drain hand-off passes the donor replica's
+        state so a re-enqueued sampled request continues
+        token-identically. Composite form: ``{"numpy": <bit-generator
+        state dict>, "device_key": [hi, lo]}`` — the device key is the
+        half the in-graph sampler actually draws from; a bare
+        bit-generator state dict (the pre-device-sampler wire format)
+        is still accepted."""
         if isinstance(prompt_ids, SamplingParams):
             if sampling is not None:
                 raise TypeError("sampling passed twice")
@@ -535,7 +628,14 @@ class LLMEngine:
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
         if rng_state is not None:
-            req._rng.bit_generator.state = rng_state
+            if "numpy" in rng_state or "device_key" in rng_state:
+                if rng_state.get("numpy") is not None:
+                    req._rng.bit_generator.state = rng_state["numpy"]
+                if rng_state.get("device_key") is not None:
+                    req.device_key = np.asarray(
+                        rng_state["device_key"], np.uint32)
+            else:  # legacy bare numpy bit-generator state dict
+                req._rng.bit_generator.state = rng_state
         self._requests[request_id] = req
         # admission control: a draining engine admits nothing; a live
         # one consults the controller. Rejection is a first-class
@@ -726,6 +826,8 @@ class LLMEngine:
                 self._finish_drain()
                 return outputs
 
+        if self._spec is not None:
+            self._propose_drafts()
         t0 = time.perf_counter()
         batch = self.scheduler.schedule()
         outputs.extend(self._terminal_output(r) for r in batch.expired)
@@ -754,7 +856,12 @@ class LLMEngine:
             off = 0
             for i, r in enumerate(reqs):
                 n = n_run[i]
-                ids[off:off + n] = r.tokens[r.num_cached:r.num_cached + n]
+                # a verify row's stream is its newest committed token
+                # followed by the draft proposals (scheduled as one
+                # 1+d mid-context row)
+                src = (r.tokens + r.draft_tokens if r.draft_tokens
+                       else r.tokens)
+                ids[off:off + n] = src[r.num_cached:r.num_cached + n]
                 off += n
                 cu[i + 1] = off
                 ctx[i] = r.num_cached + n
@@ -788,10 +895,37 @@ class LLMEngine:
         # pending copy-on-write block copies (prefix-cache divergence)
         # must land before the step writes the destination blocks
         self._apply_cow()
-        all_greedy = all(r.sampling.temperature <= 0.0 for r in reqs)
+        # per-slot sampling state for the in-graph sampler: RNG keys,
+        # params, and (ragged only) the draft rows under verification
+        rows_dim = S if self._ragged else B
+        skeys = np.zeros((rows_dim, 2), np.uint32)
+        stemp = np.zeros((rows_dim,), np.float32)
+        stopk = np.zeros((rows_dim,), np.int32)
+        stopp = np.ones((rows_dim,), np.float32)
+        for i, r in enumerate(reqs):
+            skeys[i] = r.device_key
+            stemp[i] = r.sampling.temperature
+            stopk[i] = r.sampling.top_k
+            stopp[i] = r.sampling.top_p
+        if self._ragged:
+            R = self._spec_R
+            sdraft = np.zeros((rows_dim, R - 1), np.int32)
+            sndraft = np.zeros((rows_dim,), np.int32)
+            for i, r in enumerate(reqs):
+                d = len(r.draft_tokens)
+                if d:
+                    sdraft[i, :d] = r.draft_tokens
+                    sndraft[i] = d
+            sampling_arrays = (skeys, stemp, stopk, stopp, sdraft,
+                               sndraft)
+        else:
+            R = 1
+            sampling_arrays = (skeys, stemp, stopk, stopp)
+        if any(r.sampling.temperature > 0.0 for r in reqs):
+            self.num_sampled_steps += 1
         try:
-            tokens_np, logits_np, finite_np = self._dispatch(
-                reqs, batch.kind, arrays, B, S, all_greedy)
+            out_np, finite_np = self._dispatch(
+                reqs, batch.kind, arrays, B, S, sampling_arrays)
         except EngineStepError as e:
             # this step's already-produced structured outputs (flushed
             # rejections, expiries) must not vanish with the failure —
@@ -802,17 +936,19 @@ class LLMEngine:
         # non-finite-logits guard: abort ONLY the poisoned row(s); the
         # rest of the batch continues untouched (their KV blocks and
         # logits are independent of the poisoned row)
-        poisoned = self._poisoned_rows(reqs, logits_np, finite_np)
+        poisoned = self._poisoned_rows(reqs, finite_np)
 
         if self._ragged:
             # the mixed batch's split: prompt tokens prefilled this step
             # vs decode rows (feeds occupancy + prompt throughput the
-            # same way the classic prefill/decode kinds did)
+            # same way the classic prefill/decode kinds did; a verify
+            # row costs 1 + its draft count but is still one decode row)
             prompt_toks = sum(
                 min(n, max(len(r.prompt_ids) - r.num_cached, 0))
                 for r, n in zip(reqs, n_run))
-            decode_rows = sum(1 for r, n in zip(reqs, n_run)
-                              if n == 1 and r.num_generated > 0)
+            decode_rows = sum(
+                1 for r, n in zip(reqs, n_run)
+                if n - len(r.draft_tokens) == 1 and r.num_generated > 0)
             self.metrics.record_step(
                 batch.kind, len(reqs), int(sum(n_run)),
                 self.cfg.max_num_seqs, time.perf_counter() - t0,
@@ -824,13 +960,22 @@ class LLMEngine:
                                      self.cfg.max_num_seqs,
                                      time.perf_counter() - t0,
                                      padded_tokens=padded)
+        # unpack the step's single host fetch: per row [tokens(R),
+        # n_emit, key_hi, key_lo]
+        tokens_mat = out_np[:, :R]
+        n_emit_np = out_np[:, R]
+        keys_np = np.ascontiguousarray(out_np[:, R + 1:]).view(np.uint32)
         for i, r in enumerate(reqs):
             if i in poisoned:
                 self.scheduler.abort(r.request_id, "aborted:nonfinite")
                 self.num_poisoned_aborts += 1
                 outputs.append(self._terminal_output(r))
                 continue
-            r.num_cached += n_run[i]
+            d = len(r.draft_tokens)
+            r.draft_tokens = []
+            # committed cache coverage: drafts are NOT tokens until
+            # accepted below
+            r.num_cached += n_run[i] - d
             if self.cfg.prefix_cache:
                 # register fully-written prompt blocks AFTER the step
                 # that wrote them (never discoverable before their K/V
@@ -840,24 +985,72 @@ class LLMEngine:
             if r.num_cached < len(r.tokens):
                 continue  # mid-prefill chunk: its row logit is a prompt
                 # position — never sampled, no output this step
-            token = int(tokens_np[i]) if logits_np is None \
-                else self._sample(r, logits_np[i])
-            finished = r.append_token(token)
-            self.metrics.record_token()
+            pre_len = len(r.tokens)
+            emit = [int(t) for t in tokens_mat[i, :int(n_emit_np[i])]]
+            accepted = max(int(n_emit_np[i]) - 1, 0)
+            if d:
+                self.num_spec_proposed += d
+                self.num_spec_accepted += accepted
+            finished = False
+            appended = 0
+            for token in emit:
+                finished = r.append_token(token)
+                self.metrics.record_token()
+                appended += 1
+                out = RequestOutput(request_id=r.request_id, token=token,
+                                    finished=finished,
+                                    generated=list(r.generated),
+                                    finish_reason=r.finish_reason)
+                outputs.append(out)
+                if r.callback is not None:
+                    r.callback(r.request_id, token, finished)
+                if finished:
+                    break  # EOS inside an accepted draft prefix: the
+                    # tokens behind it are never emitted
+            # the accepted prefix's K/V (written this step at draft
+            # positions) is valid and stays committed; the corrected/
+            # bonus token recomputes next step
+            r.num_cached = pre_len + min(appended, accepted)
+            # the in-graph sampler advanced this row's stream by a
+            # fixed split count; persist it only for emitting rows, so
+            # a request's key position is a pure function of its
+            # emitted-step count (chunking- and hand-off-invariant)
+            r.device_key = keys_np[i].copy()
             if finished:
                 self.scheduler.finish(r)
                 self.metrics.record_finish(r)
                 self._count_finish(r.finish_reason)
-            out = RequestOutput(request_id=r.request_id, token=token,
-                                finished=finished,
-                                generated=list(r.generated),
-                                finish_reason=r.finish_reason)
-            outputs.append(out)
-            if r.callback is not None:
-                r.callback(r.request_id, token, finished)
+            elif d:
+                # speculative rollback: free the slots claimed for
+                # rejected (or post-EOS) draft tokens
+                self.block_manager.trim(r.request_id, len(r.tokens))
         if self._draining and not self.scheduler.has_unfinished():
             self._finish_drain()  # this step emptied the engine
         return outputs
+
+    def _propose_drafts(self):
+        """One draft-model pass proposing ``num_spec_tokens`` greedy
+        continuations for every decode-eligible running request (fully
+        caught-up, past its first sampled token, with headroom under
+        both max_new_tokens and max_model_len). Proposals park on
+        ``Request.draft_tokens`` for the scheduler to claim as one
+        1+d verify row; any preemption/swap drops them."""
+        k = self.cfg.num_spec_tokens
+        cand = []
+        for r in self.scheduler.running:
+            if r.draft_tokens or r.num_generated < 1:
+                continue  # pending verify, or still prefilling
+            if len(r.tokens) - r.num_cached != 1:
+                continue
+            d = min(k, r.sampling.max_new_tokens - r.num_generated - 1,
+                    self.cfg.max_model_len - len(r.tokens) - 1)
+            if d > 0:
+                cand.append((r, d))
+        if not cand:
+            return
+        rows = self._spec.propose([r.tokens for r, _ in cand])
+        for (r, d), row in zip(cand, rows):
+            r.draft_tokens = [int(t) for t in row[:d]]
 
     def _apply_cow(self):
         """Apply pending copy-on-write block copies (prefix-cache
@@ -872,12 +1065,14 @@ class LLMEngine:
         self._vcs = self._vcs.at[:, dst].set(self._vcs[:, src])
 
     # -- the guarded compiled dispatch ----------------------------------
-    def _dispatch(self, reqs, kind, arrays, B, S, all_greedy):
+    def _dispatch(self, reqs, kind, arrays, B, S, sampling_arrays):
         """Run the compiled step under the fault-isolation envelope:
         watchdog-armed dispatch (hung-step detection), bounded
         retry-with-backoff on transient failures, and the fetch of this
-        step's host-side views. Returns ``(tokens_np, logits_np,
-        finite_np)`` (exactly one of tokens/logits is set).
+        step's host-side views. Returns ``(out_np, finite_np)`` —
+        ``out_np`` is the packed (B, R+3) int32 sampler output
+        ([tokens(R), n_emit, key_hi, key_lo] per row); ``finite_np`` is
+        the per-row nonfinite-guard bit (None with the guard off).
 
         On a failure that exhausts the retry budget — or any failure
         with donated caches, whose buffers a failed dispatch may have
@@ -910,34 +1105,26 @@ class LLMEngine:
                         tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
                 faults.fire("serving.step")  # slow/raise/sigterm point
                 if self._ragged:
-                    logits, greedy, finite, kcs, vcs = self._jstep_ragged(
+                    packed, finite, kcs, vcs = self._jstep_ragged(
                         [p._data for p in self._params],
                         [b._data for b in self._buffers],
                         self._key, ids, self._kcs, self._vcs, bt, cu,
-                        ctx, nseq)
+                        ctx, nseq, *sampling_arrays)
                 else:
-                    logits, greedy, finite, kcs, vcs = self._jstep(
+                    packed, finite, kcs, vcs = self._jstep(
                         [p._data for p in self._params],
                         [b._data for b in self._buffers],
                         self._key, ids, self._kcs, self._vcs, bt, enc,
-                        dec, now)
+                        dec, now, *sampling_arrays)
                 if self._watchdog is not None:
-                    self._watchdog.attach(eid, (logits, greedy))
-                if all_greedy:
-                    # all-greedy step: token ids computed in-graph —
-                    # fetch B int32s, never the B×vocab logits
-                    logits_np = None
-                    tokens_np = np.asarray(greedy)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized int fetch IS the engine's host boundary)
-                else:
-                    # sampled decode still samples host-side per
-                    # request; in-graph top-k/top-p is the remaining
-                    # ROADMAP "in-graph sampling" follow-up
-                    self.num_logits_fetches += 1
-                    tokens_np = None
-                    logits_np = np.asarray(logits)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B×vocab fetch only on the sampled-decode path; ROADMAP serving follow-up: in-graph sampling)
+                    self._watchdog.attach(eid, (packed,))
+                # sampling (greedy AND temperature/top-k/top-p, plus
+                # speculative verify) ran in-graph — the step's whole
+                # host boundary is this one packed int32 row per slot
+                out_np = np.asarray(packed)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized int fetch IS the engine's host boundary — tokens, emit counts, and advanced RNG keys in one packed row)
                 finite_np = None
-                if self.cfg.nonfinite_guard and logits_np is None:
-                    finite_np = np.asarray(finite)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized bool fetch: the nonfinite guard's greedy-path observable)
+                if self.cfg.nonfinite_guard:
+                    finite_np = np.asarray(finite)[:len(reqs)]  # tpulint: disable=host-sync-in-traced (B-sized bool fetch: the nonfinite guard's observable)
             except Exception as e:
                 if self._watchdog is not None:
                     self._watchdog.disarm(eid)
@@ -981,9 +1168,9 @@ class LLMEngine:
                 f"{self.cfg.step_timeout_s}s watchdog deadline — "
                 f"engine drained, {len(outs)} request(s) aborted with "
                 f"structured outputs", outs)
-        return tokens_np, logits_np, finite_np
+        return out_np, finite_np
 
-    def _poisoned_rows(self, reqs, logits_np, finite_np) -> set:
+    def _poisoned_rows(self, reqs, finite_np) -> set:
         """Row indices whose logits are non-finite (or deterministically
         poisoned via the ``serving.nan_logits`` flag fault, whose arg
         picks the row by index or request id)."""
@@ -994,10 +1181,7 @@ class LLMEngine:
             for i, r in enumerate(reqs):
                 if arg in (None, "", str(i), r.request_id):
                     poisoned.add(i)  # as-if this row's logits went NaN
-        if logits_np is not None:
-            fin = np.isfinite(logits_np).all(axis=-1)
-            poisoned |= {i for i in range(len(reqs)) if not fin[i]}
-        elif finite_np is not None:
+        if finite_np is not None:
             poisoned |= {i for i in range(len(reqs)) if not finite_np[i]}
         return poisoned
 
@@ -1016,9 +1200,22 @@ class LLMEngine:
         self._drain_reason = "step-failure"
         self._drain_deadline = None
 
-    # -- sampling (host-side, per request) ------------------------------
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens the target accepted (0.0
+        before any proposal)."""
+        if self.num_spec_proposed == 0:
+            return 0.0
+        return self.num_spec_accepted / self.num_spec_proposed
+
+    # -- sampling CPU oracle --------------------------------------------
     @staticmethod
     def _sample(req: Request, logits: np.ndarray) -> int:
+        """Host-side reference sampler. The serving hot path no longer
+        calls this — sampling is fused into the compiled step
+        (:mod:`paddle_tpu.ops.sampling`) — but it REMAINS the oracle the
+        device sampler is pinned against: greedy bit-identity and
+        sampled distribution-parity in tests/test_spec_decode.py."""
         sp = req.sampling
         if sp.temperature <= 0.0:
             return int(np.argmax(logits))

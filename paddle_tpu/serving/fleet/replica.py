@@ -9,10 +9,13 @@ is the first backend: one engine per handle, same process.
 
 Seam notes for a future remote backend:
 
-* ``rng_state``/``add_request(rng_state=...)`` carry a numpy
-  bit-generator state dict across the hand-off — a remote replica
-  would ship it in the drain notification instead of being queried
-  post-mortem;
+* ``rng_state``/``add_request(rng_state=...)`` carry the request's
+  FULL sampling-stream state across the hand-off as a composite dict —
+  ``{"numpy": <bit-generator state dict>, "device_key": [hi, lo]}``;
+  the device key is the half the engine's in-graph sampler actually
+  draws from, so a sampled request resumes bit-identically on the
+  peer. A remote replica would ship it in the drain notification
+  instead of being queried post-mortem;
 * ``step()`` returning structured :class:`RequestOutput`\\ s (including
   drain/error aborts) is the only result channel — there is no
   callback registration across the seam;
@@ -55,8 +58,8 @@ class ReplicaLoad:
 
 class ReplicaHandle:
     """The verbs the router needs from a replica. Implementations must
-    keep every argument/return JSON-shaped (plus the numpy RNG state
-    dict) so the set can move onto a wire protocol unchanged."""
+    keep every argument/return JSON-shaped (plus the composite RNG
+    state dict) so the set can move onto a wire protocol unchanged."""
 
     replica_id: str
     alive: bool
@@ -181,10 +184,12 @@ class InProcessReplica(ReplicaHandle):
 
     def rng_state(self, request_id: str):
         try:
-            return self.engine.get_request(
-                request_id)._rng.bit_generator.state
+            req = self.engine.get_request(request_id)
         except KeyError:
             return None
+        return {"numpy": req._rng.bit_generator.state,
+                "device_key": [int(req.device_key[0]),
+                               int(req.device_key[1])]}
 
     # -- stepping / drain -------------------------------------------------
     def step(self) -> List[RequestOutput]:

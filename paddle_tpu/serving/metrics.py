@@ -50,7 +50,11 @@ class ServingMetrics:
               # ragged hot path (ISSUE 9): attention-path padding waste
               # plus prefix-cache and chunked-prefill traffic
               "padded_token_frac", "prefix_cache_hits",
-              "prefix_cache_hit_tokens", "prefill_chunks")
+              "prefix_cache_hit_tokens", "prefill_chunks",
+              # in-graph sampling + speculative decoding (ISSUE 11):
+              # draft proposal/acceptance traffic and sampled-step count
+              "spec_proposed", "spec_accepted", "spec_acceptance_rate",
+              "sampled_steps")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -74,6 +78,9 @@ class ServingMetrics:
         "prefix_cache_hit_tokens":
             lambda eng: eng.block_manager.num_prefix_hit_tokens,
         "prefill_chunks": lambda eng: eng.scheduler.num_prefill_chunks,
+        "spec_proposed": lambda eng: eng.num_spec_proposed,
+        "spec_accepted": lambda eng: eng.num_spec_accepted,
+        "sampled_steps": lambda eng: eng.num_sampled_steps,
     }
 
     def __init__(self, engine):
@@ -223,6 +230,9 @@ class ServingMetrics:
             # poisoned-row aborts, drain lifecycle
             out.update({f"serving_{name}": int(get(eng))
                         for name, get in self._ENGINE_GAUGES.items()})
+            # the one float engine gauge (kept out of the int() wrap)
+            out["serving_spec_acceptance_rate"] = round(
+                eng.spec_acceptance_rate, 4)
             out.update({f"serving_finish/{r}":
                         int(eng.finish_counts.get(r, 0))
                         for r in FINISH_REASONS})
@@ -242,6 +252,8 @@ class ServingMetrics:
                     return None  # counters() drops dead providers
                 if name in ServingMetrics._ENGINE_GAUGES:
                     return ServingMetrics._ENGINE_GAUGES[name](eng)
+                if name == "spec_acceptance_rate":
+                    return eng.spec_acceptance_rate
                 if name.startswith("finish/"):
                     return eng.finish_counts.get(name[len("finish/"):], 0)
                 if name == "queue_depth":

@@ -103,6 +103,10 @@ class Request:
     # True once the scheduler ever split this request's prefill into
     # budget-sized chunks (sticky; drives the prefill_chunks metric)
     was_chunked: bool = False
+    # Speculative-decode proposals pending verification this step. NOT
+    # part of ``tokens`` — they become real tokens only if the target
+    # accepts them; any interruption (preempt/swap/abort) drops them.
+    draft_tokens: List[int] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.prompt_ids:
@@ -120,6 +124,15 @@ class Request:
                 self.request_id.encode()).digest()
             seed = int.from_bytes(digest[:8], "little")
         self._rng = np.random.default_rng(seed)
+        # The DEVICE half of the request's RNG: a threefry key in the
+        # same uint32[2] layout as jax.random.PRNGKey(seed), advanced
+        # in-graph by the engine's fused sampler (a fixed number of
+        # splits per emitting step) and written back after each fetch.
+        # Derived from the same seed as ``_rng``, so it shares the
+        # cross-process determinism — fleet drain hand-off carries it
+        # verbatim and the peer resumes the identical stream.
+        self.device_key = np.array(
+            [(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32)
 
     # -- derived ---------------------------------------------------------
     @property
@@ -175,6 +188,7 @@ class Request:
         self.status = RequestStatus.WAITING
         self.num_cached = 0
         self.num_preemptions += 1
+        self.draft_tokens = []
 
     def swap_out(self):
         """Preemption by host spill: device blocks freed, their contents
@@ -185,6 +199,7 @@ class Request:
         self.status = RequestStatus.SWAPPED
         self.num_preemptions += 1
         self.num_swaps += 1
+        self.draft_tokens = []
 
     def swap_in(self):
         self.status = RequestStatus.RUNNING

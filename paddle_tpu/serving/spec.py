@@ -1,0 +1,96 @@
+"""Speculative-decode draft proposer.
+
+A :class:`SpecDecoder` wraps a SMALL draft model and, once per engine
+iteration, proposes ``k = num_spec_tokens`` greedy continuations for
+every decode-eligible running request. The TARGET model then verifies
+all k proposals in its ONE compiled ragged step (they ride as
+mid-context multi-token rows — exactly the chunk-continuation shape the
+ragged kernel already serves) with rejection sampling fused into the
+in-graph sampler (:mod:`paddle_tpu.ops.sampling`).
+
+The draft proposes GREEDILY on purpose: a point-mass proposal makes the
+rejection-sampling accept probability collapse to ``p_target(t_i)`` and
+the corrected distribution to ``p_target`` with ``t_i`` masked — the
+emitted tokens are distributed EXACTLY as the target alone would emit
+them, whatever the draft proposes (a bad draft only costs acceptance
+rate, never correctness), and no draft probability tensors ever cross
+the host boundary.
+
+The proposer is deliberately KV-cache-free: the draft is tiny and the
+whole (B, W) padded forward is one compiled dispatch per unrolled
+proposal, re-run each iteration. Its host boundary is a single (B, k)
+int32 fetch — same O(B) order as the engine's own packed-token fetch.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["SpecDecoder"]
+
+
+class SpecDecoder:
+    """Greedy k-token draft proposer over a padded (B, W) id buffer.
+
+    ``propose`` buckets batch and width to powers of two (one compiled
+    shape per bucket pair), runs ``k`` unrolled draft forwards — each
+    argmaxes the logit at every row's frontier and scatters it back into
+    the buffer — and returns the (B, k) proposals."""
+
+    def __init__(self, model, num_spec_tokens: int):
+        import jax
+
+        from paddle_tpu.jit.trace import functionalize
+
+        if num_spec_tokens < 1:
+            raise ValueError("num_spec_tokens must be >= 1")
+        self.model = model
+        self.k = int(num_spec_tokens)
+        self.vocab_size = model.config.vocab_size
+        apply, (_, self._params), (_, self._buffers) = functionalize(
+            model.forward)
+        k = self.k
+
+        def raw_propose(param_datas, buffer_datas, key, ids, lens):
+            import jax.numpy as jnp
+
+            b = ids.shape[0]
+            rows = jnp.arange(b)
+            toks = ids
+            outs = []
+            for i in range(k):
+                logits, _ = apply(param_datas, buffer_datas, key, toks)
+                nxt = jnp.argmax(logits[rows, lens - 1 + i],
+                                 axis=-1).astype(jnp.int32)
+                outs.append(nxt)
+                toks = toks.at[rows, lens + i].set(nxt)
+            return jnp.stack(outs, axis=1)
+
+        self._jpropose = jax.jit(raw_propose)
+        self._key = jax.random.key(0)
+
+    @staticmethod
+    def _bucket(n: int, lo: int = 1) -> int:
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    def propose(self, token_lists: Sequence[Sequence[int]]) -> np.ndarray:
+        """Greedy k-token proposals for each token prefix. Returns
+        (len(token_lists), k) int32. Right-padding is safe under the
+        draft's causal attention — positions past a row's frontier never
+        influence the argmaxed logit."""
+        n = len(token_lists)
+        b = self._bucket(n)
+        w = self._bucket(max(len(t) for t in token_lists) + self.k, 8)
+        ids = np.zeros((b, w), np.int32)
+        lens = np.ones((b,), np.int32)  # pad rows index position 0
+        for i, toks in enumerate(token_lists):
+            ids[i, :len(toks)] = toks
+            lens[i] = len(toks)
+        out = self._jpropose([p._data for p in self._params],
+                             [bf._data for bf in self._buffers],
+                             self._key, ids, lens)
+        return np.asarray(out)[:n]  # tpulint: disable=host-sync-in-traced (B×k int fetch: the draft proposer's whole host boundary, same O(B) order as the engine's packed-token fetch)

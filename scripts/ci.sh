@@ -11,6 +11,10 @@
 #                            asserts ONE compiled step shape, zero
 #                            padding, prefix-cache hits, chunked
 #                            prefill, bucketed token parity; ~1 min)
+#   scripts/ci.sh --spec     speculative-decoding smoke only (self-
+#                            draft k=3; asserts acceptance > 0, greedy
+#                            token parity vs the non-spec engine, and
+#                            zero logits fetches; ~1 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -60,6 +64,17 @@ fi
 
 if [[ "${1:-}" == "--ragged" ]]; then
     run_ragged
+    exit 0
+fi
+
+run_spec() {
+    echo "== spec smoke =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/spec_smoke.py
+}
+
+if [[ "${1:-}" == "--spec" ]]; then
+    run_spec
     exit 0
 fi
 

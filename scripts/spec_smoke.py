@@ -1,0 +1,106 @@
+"""CI smoke for speculative decoding (scripts/ci.sh --spec).
+
+Serves a greedy + seeded-sampled workload through a speculative engine
+(the target drafting for itself — every greedy proposal verifies) and
+asserts the ISSUE-11 acceptance observables:
+
+* acceptance actually happened: ``spec_accepted > 0`` and the
+  acceptance rate is nonzero (greedy rows with a perfect draft verify
+  ~everything, so the rate is high, not merely positive);
+* token parity at temperature 0: the speculative engine's greedy
+  outputs are identical to a non-speculative engine's — fewer engine
+  steps, same tokens;
+* the hot path stays fetchless: ``num_logits_fetches == 0`` on BOTH
+  engines, speculative and baseline alike (in-graph sampling);
+* exact block accounting after rejected-slot rollback (invariants +
+  all blocks free).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+
+def build_model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def make_requests(vocab):
+    rng = np.random.default_rng(11)
+    prompts = [list(map(int, rng.integers(0, vocab, size=n)))
+               for n in (5, 8, 3, 6)]
+    samplings = [
+        SamplingParams(max_new_tokens=8),
+        SamplingParams(max_new_tokens=6),
+        SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9,
+                       seed=21),
+        SamplingParams(max_new_tokens=8),
+    ]
+    return prompts, samplings
+
+
+def serve(model, spec):
+    prompts, samplings = make_requests(model.config.vocab_size)
+    cfg = dict(block_size=4, max_num_seqs=4, max_model_len=64)
+    if spec:
+        cfg.update(draft_model=model, num_spec_tokens=3)
+    eng = LLMEngine(model, EngineConfig(**cfg))
+    rids = [eng.add_request(p, sampling=s)
+            for p, s in zip(prompts, samplings)]
+    steps, done_at = 0, {}
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        assert steps < 200, "engine failed to converge"
+        for r in rids:
+            if r not in done_at and eng.get_request(r).is_finished:
+                done_at[r] = steps
+    outs = [eng.get_request(r).generated for r in rids]
+    return eng, outs, [done_at[r] for r in rids]
+
+
+def main():
+    model = build_model()
+    base_eng, base_outs, base_done = serve(model, spec=False)
+    spec_eng, spec_outs, spec_done = serve(model, spec=True)
+
+    # greedy token parity: requests 0/1/3 are temperature-0 — rejection
+    # sampling with a greedy target degenerates to exact prefix match
+    for i in (0, 1, 3):
+        assert spec_outs[i] == base_outs[i], (
+            f"greedy request {i} diverged: {spec_outs[i]} vs "
+            f"{base_outs[i]}")
+
+    # acceptance happened, and it bought fewer target dispatches: each
+    # greedy request finishes in strictly fewer engine steps (the
+    # sampled request rejects most random-weight proposals, so TOTAL
+    # step count is gated by it — per-request completion is the
+    # speculation observable)
+    assert spec_eng.num_spec_proposed > 0
+    assert spec_eng.num_spec_accepted > 0, "no draft token ever accepted"
+    rate = spec_eng.spec_acceptance_rate
+    assert rate > 0.0, rate
+    for i in (0, 1, 3):
+        assert spec_done[i] < base_done[i], (i, spec_done, base_done)
+
+    # zero logits fetches on the whole run, both engines
+    assert base_eng.num_logits_fetches == 0
+    assert spec_eng.num_logits_fetches == 0
+
+    # rejected-slot rollback left the allocator exact
+    for eng in (base_eng, spec_eng):
+        assert eng.block_manager.num_free_blocks == eng.cfg.num_blocks
+        eng.block_manager.check_invariants()
+
+    print(f"spec smoke OK: acceptance={rate:.3f} "
+          f"proposed={spec_eng.num_spec_proposed} "
+          f"accepted={spec_eng.num_spec_accepted} "
+          f"greedy done@ {base_done}->{spec_done} logits_fetches=0")
+
+
+if __name__ == "__main__":
+    main()

@@ -693,6 +693,16 @@ class TestFleetE2E:
         assert any(router.get_request(r).handoffs > 0
                    and len(final[r].generated) == 8 for r in ids)
         assert "aborted:drain" not in router.finish_counts
+        # the hand-off carried the COMPOSITE sampling-stream state —
+        # numpy bit-generator AND the device RNG key the in-graph
+        # sampler draws from (what makes the sampled case above
+        # bit-identical at all)
+        handed = [r for r in ids if router.get_request(r).handoffs > 0]
+        assert handed
+        for rid in handed:
+            st = router.get_request(rid).rng_state
+            assert st is not None and "numpy" in st, rid
+            assert len(st["device_key"]) == 2, rid
 
     def test_single_replica_drain_keeps_pr6_semantics(self, tiny_model):
         """No peer -> the PR-6 contract is unchanged: waiting/running

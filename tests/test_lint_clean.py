@@ -54,11 +54,16 @@ def test_suppression_inventory_is_intentional():
     justification, mirroring the inline reason)."""
     expected = {
         # serving/engine.py: the engine's deliberate host boundaries —
-        # B ints for greedy (in-graph argmax), B×vocab only for sampled
-        # decode (ROADMAP follow-up: full in-graph sampling), the
-        # B-bool nonfinite-guard fetch, and the swap-out KV spill
+        # ONE packed B-sized int fetch per step (tokens + emit counts +
+        # advanced RNG keys; sampling is fully in-graph, so the old
+        # B×vocab sampled-decode fetch is GONE), the B-bool
+        # nonfinite-guard fetch, and the swap-out KV spill
         # (device->host is the POINT of swap-based preemption)
-        "paddle_tpu/serving/engine.py": 4,
+        "paddle_tpu/serving/engine.py": 3,
+        # serving/spec.py: the draft proposer's B×k int proposal fetch —
+        # its whole host boundary, same O(B) order as the engine's
+        # packed-token fetch
+        "paddle_tpu/serving/spec.py": 1,
         # watchdog prober: blocking per queued step on a daemon thread
         # IS the hang-detection mechanism
         "paddle_tpu/distributed/watchdog.py": 1,

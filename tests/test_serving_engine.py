@@ -214,10 +214,9 @@ def test_sampled_decode_is_reproducible_per_request(tiny_model):
 
 
 def test_greedy_decode_never_fetches_full_logits(tiny_model):
-    """In-graph greedy sampling (ROADMAP PR-4 follow-up): an all-greedy
-    workload ships B argmax'd ints per step and never pulls the B×vocab
-    logits to host; sampled decode still does (and says so via
-    ``num_logits_fetches``)."""
+    """Fully in-graph sampling (ISSUE 11): greedy AND sampled workloads
+    ship one packed int row per slot each step and NEVER pull the
+    B×vocab logits to host — ``num_logits_fetches`` stays 0 for both."""
     m = tiny_model
     rng = np.random.default_rng(6)
     prompts = _prompts(rng, m.config.vocab_size, [4, 6])
@@ -226,19 +225,20 @@ def test_greedy_decode_never_fetches_full_logits(tiny_model):
     outs = eng.generate(prompts, SamplingParams(max_new_tokens=4))
     assert eng.num_logits_fetches == 0
     assert all(len(o) == 4 for o in outs)
-    # parity with the host-sampled path is pinned by the e2e tests;
-    # sampled decode flips to the logits fetch
+    # sampled decode used to flip to a B×vocab fetch; the in-graph
+    # sampler keeps the boundary at B ints
     eng.generate([prompts[0]],
                  SamplingParams(max_new_tokens=3, temperature=0.7,
                                 seed=1))
-    assert eng.num_logits_fetches > 0
+    assert eng.num_logits_fetches == 0
+    assert eng.num_sampled_steps > 0
 
 
 def test_mixed_greedy_and_sampled_batch_parity(tiny_model):
-    """A batch mixing greedy and sampled requests takes the logits
-    path for the whole step, and the greedy request's tokens still
-    match the naive generate exactly (host argmax == in-graph argmax
-    tie-breaking)."""
+    """A batch mixing greedy and sampled requests runs ONE in-graph
+    sampling path (greedy rows one-hot), the greedy request's tokens
+    still match the naive generate exactly, and no step fetches
+    logits."""
     m = tiny_model
     rng = np.random.default_rng(7)
     pg, ps = _prompts(rng, m.config.vocab_size, [5, 5])
@@ -251,7 +251,7 @@ def test_mixed_greedy_and_sampled_batch_parity(tiny_model):
     eng.run()
     assert eng.get_request(rg).generated == _naive(m, pg, 4)
     assert len(eng.get_request(rs).generated) == 4
-    assert eng.num_logits_fetches > 0
+    assert eng.num_logits_fetches == 0
 
 
 @pytest.mark.slow
@@ -293,3 +293,15 @@ def test_bench_serving_smoke():
     assert cmp["bucketed_compiled_step_shapes"] > 1
     assert cmp["prefix_cache_hits"] > 0
     assert cmp["prefill_chunks"] > 0
+    # ISSUE-11 in-graph sampling + speculative phases: both fetchless,
+    # the self-draft spec run actually proposed and accepted tokens
+    smp = ex["sampled_decode"]
+    assert smp["tokens_per_sec"] > 0
+    assert smp["sampled_steps"] > 0
+    assert smp["logits_fetches"] == 0
+    spc = ex["speculative"]
+    assert spc["tokens_per_sec"] > 0
+    assert spc["spec_proposed"] > 0
+    assert spc["spec_accepted"] > 0
+    assert 0.0 < spc["spec_acceptance_rate"] <= 1.0
+    assert spc["logits_fetches"] == 0
