@@ -483,34 +483,61 @@ def bench_serving(tiny=False, n_requests=16, max_new_tokens=32,
     }
 
 
+def _fleet_model_cfg(tiny):
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if tiny:
+        return LlamaConfig.tiny()
+    return LlamaConfig(
+        vocab_size=32000, hidden_size=512, intermediate_size=1408,
+        num_hidden_layers=8, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=1024)
+
+
+def _worker_model_small(spec):
+    """WorkerSpec factory (``model="bench:_worker_model_small"``) so
+    subprocess bench workers build the exact gpt-small twin of the
+    in-process replicas — same seed, same weights, comparable runs."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    paddle.seed(int(spec.get("seed", 0)))
+    paddle.set_default_dtype("float32")
+    model = LlamaForCausalLM(_fleet_model_cfg(False))
+    model.eval()
+    return model
+
+
 def bench_fleet(tiny=False, replicas=2, n_requests=16,
-                max_new_tokens=32, max_num_seqs=4, seed=0):
+                max_new_tokens=32, max_num_seqs=4, seed=0,
+                subprocess_mode=False):
     """Multi-replica serving throughput through the FleetRouter
     (``--serving --replicas N``): the same ragged-prompt scenario as
     :func:`bench_serving`, dispatched across ``replicas`` engines
     sharing one set of weights. After the measured window, a SEPARATE
     resilience pass drains one replica of a zero-grace pair mid-run so
     the BENCH JSON trends the fleet counters (hand-offs, replica
-    deaths) with nonzero traffic."""
+    deaths) with nonzero traffic.
+
+    ``--subprocess`` re-runs the measured window through a
+    :class:`ReplicaSupervisor` fleet of worker PROCESSES behind the
+    length-prefixed RPC transport — same prompts, same weights — and
+    reports tokens/s, aggregate RPC overhead (calls, wire time), and a
+    SIGKILL-one-worker-mid-run smoke alongside the in-process numbers."""
     import numpy as np
 
     import paddle_tpu as paddle
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaForCausalLM
     from paddle_tpu.serving import EngineConfig, SamplingParams
     from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
     from paddle_tpu.testing import faults
 
     paddle.seed(seed)
     paddle.set_default_dtype("float32")
+    cfg = _fleet_model_cfg(tiny)
     if tiny:
-        cfg = LlamaConfig.tiny()
         n_requests, max_new_tokens = min(n_requests, 12), min(
             max_new_tokens, 8)
-    else:
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=512, intermediate_size=1408,
-            num_hidden_layers=8, num_attention_heads=8,
-            num_key_value_heads=8, max_position_embeddings=1024)
     model = LlamaForCausalLM(cfg)
     model.eval()
 
@@ -538,9 +565,10 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
         router.step()
     tokens0 = router.num_tokens_emitted
 
+    measured_prompts = prompts(n_requests, 5)
     t0 = time.perf_counter()
     rids = [router.add_request(p, sampling=sp)
-            for p in prompts(n_requests, 5)]
+            for p in measured_prompts]
     while router.has_unfinished():
         router.step()
     dt = time.perf_counter() - t0
@@ -570,6 +598,87 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
     resilience = {k: v for k, v in r_snap.items()
                   if k.startswith("fleet_") and k != "fleet_tenants"}
 
+    # out-of-process pass: same measured prompts through subprocess
+    # workers, so tokens/s here vs above IS the RPC overhead
+    sub = None
+    if subprocess_mode:
+        import tempfile
+
+        from paddle_tpu.serving.fleet import (
+            ReplicaSupervisor, SupervisorConfig, WorkerSpec,
+        )
+
+        sup = ReplicaSupervisor(
+            WorkerSpec(model=("tiny_llama" if tiny
+                              else "bench:_worker_model_small"),
+                       seed=seed,
+                       engine=dict(
+                           max_num_seqs=max_num_seqs,
+                           max_model_len=min(
+                               cfg.max_position_embeddings, 1024))),
+            SupervisorConfig(
+                store_dir=tempfile.mkdtemp(prefix="bench_fleet_hb_")))
+        try:
+            s_handles = [sup.spawn() for _ in range(replicas)]
+            s_router = FleetRouter(s_handles, registry=sup.registry)
+            sup.router = s_router
+            for p in prompts(replicas * max_num_seqs + 2, 5):
+                s_router.add_request(p, sampling=sp)
+            while s_router.has_unfinished():
+                s_router.step()
+            s_tokens0 = s_router.num_tokens_emitted
+            # RPC stats diffed across the window: boot pings and
+            # warmup compiles would otherwise dominate ms-per-call
+            rpc0 = [dict(h.rpc_stats) for h in sup.handles()]
+            t1 = time.perf_counter()
+            s_rids = [s_router.add_request(p, sampling=sp)
+                      for p in measured_prompts]
+            while s_router.has_unfinished():
+                s_router.step()
+            s_dt = time.perf_counter() - t1
+            s_tokens = s_router.num_tokens_emitted - s_tokens0
+            assert all(s_router.get_request(r).finish_reason == "length"
+                       for r in s_rids)
+            rpc = {"calls": 0, "retries": 0, "timeouts": 0,
+                   "rpc_time_s": 0.0}
+            for h, before in zip(sup.handles(), rpc0):
+                for k in rpc:
+                    rpc[k] += h.rpc_stats.get(k, 0) - before.get(k, 0)
+
+            # resilience, subprocess edition: SIGKILL one worker
+            # mid-run; every request must still finish 'length' on the
+            # peer (transport-cached RNG, router hand-off)
+            faults.install("fleet.worker_kill:flag:"
+                           f"{s_handles[0].replica_id}@3*1")
+            k_rids = [s_router.add_request(p, sampling=SamplingParams(
+                max_new_tokens=8)) for p in prompts(6, 6)]
+            try:
+                while s_router.has_unfinished():
+                    s_router.step()
+            finally:
+                faults.clear()
+            assert all(s_router.get_request(r).finish_reason == "length"
+                       for r in k_rids)
+            sub = {
+                "tokens_per_sec": round(s_tokens / s_dt, 2),
+                "wall_s": round(s_dt, 3),
+                "vs_inprocess": round((s_tokens / s_dt)
+                                      / (tokens / dt), 3),
+                "rpc_calls": rpc["calls"],
+                "rpc_retries": rpc["retries"],
+                "rpc_timeouts": rpc["timeouts"],
+                "rpc_wire_s": round(rpc["rpc_time_s"], 3),
+                "rpc_ms_per_call": round(
+                    1e3 * rpc["rpc_time_s"] / max(rpc["calls"], 1), 3),
+                "sigkill_smoke": {
+                    "num_handoffs": s_router.num_handoffs,
+                    "num_replicas_dead": s_router.num_replicas_dead,
+                    "finished_length": len(k_rids),
+                },
+            }
+        finally:
+            sup.shutdown()
+
     return {
         "metric": "fleet_tokens_per_sec",
         "value": round(tokens / dt, 2),
@@ -583,6 +692,7 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
             "wall_s": round(dt, 3),
             **{k: v for k, v in snap.items() if k != "replicas"},
             "resilience_smoke": resilience,
+            **({"subprocess": sub} if sub is not None else {}),
         },
     }
 
@@ -818,7 +928,9 @@ if __name__ == "__main__":
         if "--replicas" in sys.argv:
             n = int(sys.argv[sys.argv.index("--replicas") + 1])
             print("BENCH_serving_fleet " + json.dumps(
-                bench_fleet(tiny="--tiny" in sys.argv, replicas=n)))
+                bench_fleet(tiny="--tiny" in sys.argv, replicas=n,
+                            subprocess_mode="--subprocess"
+                                            in sys.argv)))
         else:
             print("BENCH_serving " + json.dumps(
                 bench_serving(tiny="--tiny" in sys.argv)))

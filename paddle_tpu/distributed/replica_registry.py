@@ -13,18 +13,32 @@ liveness protocol.
 Key layout (``/`` flattens to ``__`` in ``list()`` on every store
 implementation, which is why replica ids may not contain either)::
 
-    <prefix>/hb/<replica_id>   -> JSON {"ts": wall-clock, "load": {...}}
+    <prefix>/hb/<replica_id>   -> JSON {"ts": wall-clock,
+                                        "seq": [writer-nonce, n], ...}
 
 ``alive()`` is a read-side filter, not a lease: a stale record is
 simply ignored, and a replica that resumes heartbeating after a pause
 reappears — the router decides what a disappearance means (it treats
 one as replica death and re-enqueues that replica's requests).
+
+Clock discipline: once replicas live in other PROCESSES (even other
+hosts), comparing a writer's wall clock against the reader's would
+turn NTP skew into false deaths (or worse, mask real ones). So each
+writer stamps records with a monotonically increasing ``seq`` (scoped
+by a per-writer nonce — restarts and multiple writers always read as
+a change), and the reader judges freshness entirely on its OWN
+monotonic clock: a member is alive iff its record *changed* within
+``ttl_s`` of the reader's ``time.monotonic()``. ``ts`` stays in the
+record for humans and for the legacy simulated-clock mode: passing an
+explicit ``now=`` to ``alive()``/``is_alive()`` selects the pure
+ts-TTL comparison (single-writer tests drive time that way).
 """
 from __future__ import annotations
 
 import json
+import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["ReplicaRegistry", "MemStore"]
 
@@ -70,6 +84,12 @@ class ReplicaRegistry:
         self.store = store if store is not None else MemStore()
         self.prefix = prefix
         self.ttl_s = ttl_s
+        # write side: per-key heartbeat counter under a writer nonce
+        self._nonce = f"{os.getpid():x}.{id(self) & 0xFFFFFF:x}"
+        self._seq: Dict[str, int] = {}
+        # read side: rid -> (last seq seen, reader-monotonic at change)
+        self._obs: Dict[str, Tuple[list, float]] = {}
+        self._mono = time.monotonic  # injectable for deterministic tests
 
     def _key(self, replica_id: str) -> str:
         if "/" in replica_id or "__" in replica_id:
@@ -86,7 +106,10 @@ class ReplicaRegistry:
     def heartbeat(self, replica_id: str, load: Optional[dict] = None,
                   meta: Optional[dict] = None,
                   now: Optional[float] = None) -> None:
-        rec = {"ts": time.time() if now is None else now}
+        n = self._seq.get(replica_id, 0) + 1
+        self._seq[replica_id] = n
+        rec = {"ts": time.time() if now is None else now,
+               "seq": [self._nonce, n]}
         if meta:
             rec["meta"] = meta
         if load:
@@ -95,6 +118,8 @@ class ReplicaRegistry:
 
     def deregister(self, replica_id: str) -> None:
         self.store.delete(self._key(replica_id))
+        self._seq.pop(replica_id, None)
+        self._obs.pop(replica_id, None)
 
     # -- read side (the router's health view) ----------------------------
     def record(self, replica_id: str) -> Optional[dict]:
@@ -115,14 +140,32 @@ class ReplicaRegistry:
                 out.append(name[len(flat):])
         return sorted(out)
 
+    def _fresh(self, replica_id: str, rec: dict,
+               now: Optional[float]) -> bool:
+        if now is not None or "seq" not in rec:
+            # explicit simulated clock, or a legacy record without a
+            # sequence: pure wall-clock TTL (the pre-monotonic contract)
+            wall = time.time() if now is None else now
+            return wall - rec.get("ts", 0.0) <= self.ttl_s
+        # skew-immune path: freshness = "the record CHANGED within
+        # ttl_s of MY monotonic clock". First sighting counts as a
+        # change (lease semantics for members discovered mid-life).
+        seq = rec["seq"]
+        mono = self._mono()
+        prev = self._obs.get(replica_id)
+        if prev is None or prev[0] != seq:
+            self._obs[replica_id] = (seq, mono)
+            return True
+        return mono - prev[1] <= self.ttl_s
+
     def alive(self, now: Optional[float] = None) -> Dict[str, dict]:
         """replica_id -> last heartbeat record, for every member whose
-        record is within ``ttl_s``."""
-        now = time.time() if now is None else now
+        record is fresh (see the module docstring for the two clock
+        modes; ``now=None`` is the skew-immune monotonic one)."""
         out: Dict[str, dict] = {}
         for rid in self.members():
             rec = self.record(rid)
-            if rec is not None and now - rec.get("ts", 0.0) <= self.ttl_s:
+            if rec is not None and self._fresh(rid, rec, now):
                 out[rid] = rec
         return out
 
@@ -131,5 +174,4 @@ class ReplicaRegistry:
         rec = self.record(replica_id)
         if rec is None:
             return False
-        now = time.time() if now is None else now
-        return now - rec.get("ts", 0.0) <= self.ttl_s
+        return self._fresh(replica_id, rec, now)

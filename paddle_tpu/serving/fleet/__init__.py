@@ -31,9 +31,19 @@ from paddle_tpu.serving.fleet.replica import (  # noqa: F401
 from paddle_tpu.serving.fleet.router import (  # noqa: F401
     FleetConfig, FleetRouter, HANDOFF_REASONS,
 )
+from paddle_tpu.serving.fleet.supervisor import (  # noqa: F401
+    ReplicaSupervisor, SupervisorConfig, WorkerSpec,
+)
 from paddle_tpu.serving.fleet.tenant import TenantQueue  # noqa: F401
+from paddle_tpu.serving.fleet.transport import (  # noqa: F401
+    ReplicaGone, ReplicaServicer, RpcClient, RpcError, RpcRemoteError,
+    RpcTimeout, SubprocessReplica,
+)
 
 __all__ = ["AutoscalePolicy", "FleetController", "LoadThresholdPolicy",
            "FleetMetrics", "InProcessReplica", "ReplicaHandle",
            "ReplicaLoad", "FleetConfig", "FleetRouter",
-           "HANDOFF_REASONS", "TenantQueue"]
+           "HANDOFF_REASONS", "TenantQueue",
+           "ReplicaSupervisor", "SupervisorConfig", "WorkerSpec",
+           "ReplicaGone", "ReplicaServicer", "RpcClient", "RpcError",
+           "RpcRemoteError", "RpcTimeout", "SubprocessReplica"]

@@ -27,14 +27,15 @@ def _mean(xs: List[float]) -> float:
 class FleetMetrics:
     """Owned by one :class:`~paddle_tpu.serving.fleet.FleetRouter`."""
 
-    GAUGES = ("dispatched", "handoffs", "rejected_fleetwide",
-              "replicas_live", "tenant_waiting", "replicas_dead",
-              "scale_ups", "scale_downs", "autoscale_decisions",
-              "tokens_emitted")
+    GAUGES = ("dispatched", "handoffs", "handoff_exhausted",
+              "rejected_fleetwide", "replicas_live", "tenant_waiting",
+              "replicas_dead", "scale_ups", "scale_downs",
+              "autoscale_decisions", "tokens_emitted")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
         "handoffs": lambda r: r.num_handoffs,
+        "handoff_exhausted": lambda r: r.num_handoff_exhausted,
         "rejected_fleetwide": lambda r: r.num_rejected_fleetwide,
         "replicas_live": lambda r: len(r.dispatchable()),
         "tenant_waiting": lambda r: len(r._queue),

@@ -64,6 +64,10 @@ class ReplicaHandle:
     replica_id: str
     alive: bool
     retiring: bool  # scale-down: drain, then detach once empty
+    # True when the replica heartbeats the registry itself (a worker
+    # process does); the router must not beat on its behalf, or a hung
+    # worker would look alive forever
+    self_heartbeat: bool = False
 
     # -- dispatch-side reads ---------------------------------------------
     def admission_verdict(self, prompt_tokens: int) -> Optional[str]:

@@ -35,7 +35,19 @@ step — the arg selects a replica by id or index, empty = first alive):
                         SIGTERM path, minus the signal)
 ``fleet.slow_replica``  sleep ``arg`` seconds in the router step —
                         models a straggling replica stalling the loop
+``fleet.worker_kill``   SIGKILL the replica's worker PROCESS (handles
+                        with a ``hard_kill``, i.e. subprocess/loopback
+                        transports). Unlike ``kill_replica`` the router
+                        does no bookkeeping here — death must be
+                        DETECTED (process exit / connection EOF /
+                        heartbeat TTL), which is what the fault exists
+                        to exercise
 =====================  ==================================================
+
+The transport adds two client-side points (see ``transport.py``):
+``fleet.rpc_delay`` (stall a call against its deadline) and
+``fleet.rpc_drop`` (lose a frame; idempotent calls retry, mutations
+surface as replica death).
 """
 from __future__ import annotations
 
@@ -137,6 +149,7 @@ class FleetRouter:
         # lifetime counters (surfaced as fleet/* profiler gauges)
         self.num_dispatched = 0
         self.num_handoffs = 0
+        self.num_handoff_exhausted = 0
         self.num_rejected_fleetwide = 0
         self.num_replicas_dead = 0
         self.num_scale_ups = 0
@@ -200,6 +213,9 @@ class FleetRouter:
                 self._requeue(fr)
                 self.num_handoffs += 1
             else:
+                if (self.cfg.handoff
+                        and fr.handoffs >= self.cfg.max_handoffs):
+                    self.num_handoff_exhausted += 1
                 self._finalize(fr, "aborted:error", None, outs)
 
     def dispatchable(self) -> List[ReplicaHandle]:
@@ -265,7 +281,10 @@ class FleetRouter:
             if h is not None and h.alive:
                 h.abort_request(request_id)
                 h.release_request(request_id)
-                self._assigned[fr.replica_id].discard(request_id)
+            # unassign even when the handle is dead, or the health
+            # sweep keeps "recovering" the corpse every pass for a
+            # request the client already gave up on
+            self._assigned.get(fr.replica_id, set()).discard(request_id)
         self._finalize(fr, "aborted:user", None, self._pending_outputs)
         return True
 
@@ -298,10 +317,15 @@ class FleetRouter:
                 continue
             for out in h.step():
                 self._handle_output(h, out, outputs)
-            if not h.alive:
+            if not h.alive and not h.retiring:
                 # the engine died mid-step (EngineStepError absorbed at
                 # the handle): outputs above carried its structured
-                # aborts; anything still assigned re-enqueues now
+                # aborts; anything still assigned re-enqueues now.
+                # Retiring handles are exempt — a drained-out worker
+                # exits right after its last reply (retiring set from
+                # that reply) and is reaped, not counted dead; if one
+                # truly crashes mid-drain with work assigned, the next
+                # health sweep recovers it
                 self.kill_replica(h.replica_id, "step failure", outputs)
         self._reap_retired()
         return outputs
@@ -337,6 +361,14 @@ class FleetRouter:
                     self._handle_output(h, out, outputs)
         for arg in faults.check("fleet.slow_replica"):
             time.sleep(float(arg) if arg else 0.01)
+        for arg in faults.check("fleet.worker_kill"):
+            h = self._fault_target(arg)
+            hard_kill = getattr(h, "hard_kill", None)
+            if callable(hard_kill):
+                # SIGKILL the worker process and do NOTHING router-side:
+                # the death must be DETECTED (exit/EOF/heartbeat TTL),
+                # which is the failure mode this fault exists to inject
+                hard_kill()
 
     def _fault_target(self, arg) -> Optional[ReplicaHandle]:
         alive = [h for h in self.replicas if h.alive]
@@ -359,7 +391,7 @@ class FleetRouter:
             return
         self._last_hb = now
         for h in self.replicas:
-            if h.alive:
+            if h.alive and not getattr(h, "self_heartbeat", False):
                 self.registry.heartbeat(h.replica_id,
                                         load=h.load().as_dict())
 
@@ -483,6 +515,10 @@ class FleetRouter:
             handle.release_request(fr.request_id)
             self._requeue(fr)
             return
+        if (reason in HANDOFF_REASONS and self.cfg.handoff
+                and fr.handoffs >= self.cfg.max_handoffs):
+            # out of hand-off budget: the abort surfaces to the client
+            self.num_handoff_exhausted += 1
         handle.release_request(fr.request_id)
         self._finalize(fr, reason, out.token, outputs)
 

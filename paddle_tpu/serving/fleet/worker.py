@@ -1,0 +1,143 @@
+"""Replica worker process: ``python -m paddle_tpu.serving.fleet.worker``.
+
+One process, one engine: wraps an :class:`InProcessReplica` in a
+:class:`~paddle_tpu.serving.fleet.transport.ReplicaServicer` and
+serves the ``ReplicaHandle`` verb set over the socket the supervisor
+passed down. The worker IS the failure domain — SIGKILL here kills an
+engine and nothing else, and the supervisor/router recover.
+
+Env protocol (set by :class:`ReplicaSupervisor`):
+
+  PADDLE_REPLICA_FD     inherited socketpair fd to serve on (required)
+  PADDLE_REPLICA_ID     replica id (also the registry heartbeat key)
+  PADDLE_REPLICA_SPEC   JSON worker spec::
+
+        {"model": "tiny_llama" | "pkg.module:factory",
+         "seed": 0, "engine": {...EngineConfig kwargs...}}
+
+    ``tiny_llama`` builds the deterministic tiny-Llama every fleet
+    test uses (``paddle.seed(seed)`` then ``LlamaConfig.tiny()`` — the
+    same seed gives every process identical weights, which is what
+    makes cross-process hand-off bit-identical). ``module:factory``
+    imports and calls ``factory(spec_dict)`` for real models.
+  PADDLE_REPLICA_STORE  FileStore directory for registry heartbeats
+                        (optional — no store, no heartbeat thread)
+  PADDLE_REPLICA_HB     heartbeat interval seconds (default 0.5)
+  PADDLE_FAULTS         inherited; the in-worker fault points
+                        (serving.step etc.) work as in-process
+
+Lifecycle: serve until EOF (supervisor closed the socket or the
+parent died), an explicit ``shutdown`` verb, or — the SIGTERM drain
+path — the preemption monitor has fired AND the engine has drained
+AND the final outputs were already delivered in a reply. SIGTERM
+itself only sets the monitor flag (the PR-9 lockcheck rule: no work in
+signal handlers); the engine starts its drain at the next ``step``
+RPC and the aborts ride back to the router with their RNG states.
+
+Threading: the service loop is single-threaded. The one extra thread
+heartbeats the registry and shares nothing with the engine — only the
+stop event and immutable strings — so a heartbeat can never observe a
+half-stepped engine (and lockcheck agrees).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import socket
+import threading
+
+
+def build_model(spec: dict):
+    name = spec.get("model", "tiny_llama")
+    if name == "tiny_llama":
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(int(spec.get("seed", 0)))
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        model.eval()
+        return model
+    if ":" in name:
+        mod_name, _, fn_name = name.partition(":")
+        factory = getattr(importlib.import_module(mod_name), fn_name)
+        return factory(spec)
+    raise ValueError(f"unknown worker model spec {name!r}")
+
+
+def _start_heartbeat(replica_id: str, store_dir: str, interval_s: float,
+                     ttl_s: float) -> threading.Event:
+    """Daemon heartbeat thread. Isolated on purpose: it builds its own
+    store/registry and touches nothing the service loop owns."""
+    from paddle_tpu.distributed.replica_registry import ReplicaRegistry
+    from paddle_tpu.distributed.store import FileStore
+
+    stop = threading.Event()
+    pid = os.getpid()
+
+    def beat():
+        reg = ReplicaRegistry(FileStore(store_dir), ttl_s=ttl_s)
+        meta = {"pid": pid}
+        while True:
+            try:
+                reg.heartbeat(replica_id, meta=meta)
+            except OSError:
+                pass  # store dir vanished (teardown); keep trying
+            if stop.wait(interval_s):
+                return
+
+    threading.Thread(target=beat, daemon=True,
+                     name=f"replica-hb-{replica_id}").start()
+    return stop
+
+
+def main() -> int:
+    fd = int(os.environ["PADDLE_REPLICA_FD"])
+    replica_id = os.environ.get("PADDLE_REPLICA_ID", f"worker-{os.getpid()}")
+    spec = json.loads(os.environ.get("PADDLE_REPLICA_SPEC", "{}"))
+    store_dir = os.environ.get("PADDLE_REPLICA_STORE", "")
+    hb_interval = float(os.environ.get("PADDLE_REPLICA_HB", "0.5"))
+    ttl_s = float(os.environ.get("PADDLE_REPLICA_TTL", "5.0"))
+
+    sock = socket.socket(fileno=fd)
+
+    # Import order matters for startup latency: the model (and jax)
+    # load AFTER the socket exists, so the supervisor's first ping just
+    # waits on a deadline rather than a filesystem race.
+    from paddle_tpu.distributed.watchdog import PreemptionMonitor
+    from paddle_tpu.serving.engine import EngineConfig
+    from paddle_tpu.serving.fleet.replica import InProcessReplica
+    from paddle_tpu.serving.fleet.transport import ReplicaServicer
+
+    model = build_model(spec)
+    monitor = PreemptionMonitor()
+    monitor.install()
+    replica = InProcessReplica(
+        model, EngineConfig(**spec.get("engine", {})),
+        replica_id=replica_id, monitor=monitor)
+
+    hb_stop = None
+    if store_dir:
+        hb_stop = _start_heartbeat(replica_id, store_dir, hb_interval,
+                                   ttl_s)
+
+    def drained_out() -> bool:
+        # SIGTERM path: the drain aborts (with RNG states) went out in
+        # the reply we just wrote; nothing left to serve.
+        return (monitor.requested() and replica.drained
+                and not replica.has_unfinished())
+
+    try:
+        ReplicaServicer(replica).serve(sock, should_stop=drained_out)
+    finally:
+        if hb_stop is not None:
+            hb_stop.set()
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

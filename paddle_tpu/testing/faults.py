@@ -21,7 +21,9 @@ worker where to die. Spec grammar (specs separated by ``;``)::
 ``@skip`` ignores the first N hits; ``*times`` fires at most N times
 (for per-step points like ``serving.step`` or the router's
 ``fleet.kill_replica`` / ``fleet.drain_replica`` / ``fleet.slow_replica``
-— queried once per step — ``@skip`` counts steps).
+/ ``fleet.worker_kill`` — queried once per step — ``@skip`` counts
+steps; the fleet transport's ``fleet.rpc_delay`` / ``fleet.rpc_drop``
+fire once per RPC attempt, so ``@skip`` counts calls).
 Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
 the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
 ``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test

@@ -1,13 +1,24 @@
-"""Fleet serving smoke: 2 tiny replicas + a mid-run replica kill.
+"""Fleet serving smoke: in-process kill recovery, then subprocess.
 
-The ``scripts/ci.sh --fleet`` stage: boots a two-replica
-:class:`FleetRouter` on XLA:CPU, admits 8 requests across two tenants,
-kills replica r0 through the ``fleet.kill_replica`` fault four router
-steps in, and asserts the fleet absorbs the loss — every request
-finishes ``'length'`` token-complete, at least one hand-off happened,
-and the fleet counters say exactly one replica died. Exit 0 on
-success; any broken invariant raises.
+The ``scripts/ci.sh --fleet`` stage, two phases:
+
+1. **in-process** — boots a two-replica :class:`FleetRouter` on
+   XLA:CPU, admits 8 requests across two tenants, kills replica r0
+   through the ``fleet.kill_replica`` fault four router steps in, and
+   asserts the fleet absorbs the loss — every request finishes
+   ``'length'`` token-complete, at least one hand-off happened, and
+   the fleet counters say exactly one replica died;
+2. **subprocess** — a :class:`ReplicaSupervisor` spawns 2 worker
+   PROCESSES, 6 requests go in, one worker takes a real ``SIGKILL``
+   mid-decode, and every request must finish with token streams
+   bit-identical to an uninterrupted single-engine reference.
+
+Exit 0 on success; any broken invariant raises.
 """
+import os
+import signal
+import tempfile
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -16,9 +27,64 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.serving import EngineConfig, SamplingParams
-from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetRouter, InProcessReplica, ReplicaSupervisor, SupervisorConfig,
+    WorkerSpec,
+)
 from paddle_tpu.testing import faults
+
+_ENGINE = dict(block_size=4, max_num_seqs=8, max_model_len=64,
+               drain_grace_s=0.0)
+
+
+def subprocess_phase(model):
+    prompts = [list(map(int, np.random.default_rng(9).integers(
+        0, model.config.vocab_size, size=3 + i % 4)))
+        for i in range(6)]
+    sp = SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9)
+    ids = [f"s{i}" for i in range(6)]
+
+    # uninterrupted single-engine reference (worker twins: seed 0)
+    eng = LLMEngine(model, EngineConfig(**_ENGINE))
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    ref = {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+    sup = ReplicaSupervisor(
+        WorkerSpec(model="tiny_llama", seed=0, engine=dict(_ENGINE)),
+        SupervisorConfig(
+            store_dir=tempfile.mkdtemp(prefix="fleet_smoke_hb_")))
+    try:
+        handles = [sup.spawn() for _ in range(2)]
+        router = FleetRouter(handles, registry=sup.registry)
+        sup.router = router
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        for _ in range(3):
+            router.step()                  # tokens in flight
+        victim = handles[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        steps = 0
+        while router.has_unfinished():
+            router.step()
+            steps += 1
+            assert steps < 500, "router failed to converge"
+        got = {rid: list(router.get_request(rid).generated)
+               for rid in ids}
+        assert got == ref, "post-SIGKILL token streams diverged"
+        for rid in ids:
+            assert router.get_request(rid).finish_reason == "length"
+        assert victim.proc.wait(timeout=10) == -signal.SIGKILL
+        assert router.num_replicas_dead == 1
+        assert router.num_handoffs >= 1
+        print("FLEET_SMOKE_SUBPROCESS_OK handoffs=%d dead=%d"
+              % (router.num_handoffs, router.num_replicas_dead),
+              flush=True)
+    finally:
+        sup.shutdown()
 
 
 def main():
@@ -64,6 +130,7 @@ def main():
     print("FLEET_SMOKE_OK steps=%d handoffs=%d dead=%d"
           % (steps, snap["fleet_handoffs"], snap["fleet_replicas_dead"]),
           flush=True)
+    subprocess_phase(model)
 
 
 if __name__ == "__main__":

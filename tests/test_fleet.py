@@ -372,7 +372,11 @@ class TestRegistry:
     def test_stale_heartbeat_kills_replica_and_hands_off(self):
         # freeze router heartbeats after the first so rb's record can
         # go stale underneath it -> health sweep treats rb as dead and
-        # its request finishes on ra, invisibly to the client
+        # its request finishes on ra, invisibly to the client. Liveness
+        # is the registry's skew-immune mode: staleness means "record
+        # unchanged past ttl on the READER's monotonic clock", so the
+        # test leaps the reader clock and beats only ra — rb's silence
+        # is what kills it, exactly what a hung worker looks like.
         ra, rb = FakeReplica("ra", ttft=5.0), FakeReplica("rb", ttft=1.0)
         reg = ReplicaRegistry(MemStore(), ttl_s=5.0)
         router = FleetRouter(
@@ -381,7 +385,10 @@ class TestRegistry:
         rid = router.add_request([1], SamplingParams(max_new_tokens=4))
         router.step()                           # dispatched to rb
         assert rb.dispatch_log == [rid]
-        reg.heartbeat("rb", now=time.time() - 999.0)
+        t0 = time.monotonic()
+        reg._mono = lambda: t0 + 999.0          # reader leaps past ttl
+        reg.heartbeat("ra")                     # ra's record changes...
+        assert reg.is_alive("ra")               # ...re-observed fresh
         outs = _drain_router(router)
         final = {o.request_id: o.finish_reason
                  for o in outs if o.finished}

@@ -1,0 +1,192 @@
+"""True multiprocess fleet e2e (slow tier).
+
+The loopback tests in test_fleet_transport.py pin the protocol and the
+recovery math; these pin the parts only real processes can: SIGKILL
+delivered by the kernel, SIGTERM caught by the worker's preemption
+monitor, supervisor restart generations, and hang detection through
+FileStore heartbeats written by an actual worker heartbeat thread.
+
+Every parity assert compares client-visible token streams against an
+uninterrupted single-engine run of the same tiny model (workers build
+the identical model from ``WorkerSpec(seed=0)``).
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, ReplicaSupervisor, SupervisorConfig,
+    WorkerSpec,
+)
+
+pytestmark = pytest.mark.slow
+
+_ENGINE = {"block_size": 4, "max_num_seqs": 8, "max_model_len": 64,
+           "drain_grace_s": 0.0}
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # the reference twin of what each worker builds from its spec
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    return model
+
+
+def _mk_fleet(tmp_path, n=2, **cfg_kw):
+    cfg_kw.setdefault("store_dir", str(tmp_path / "store"))
+    sup = ReplicaSupervisor(WorkerSpec(model="tiny_llama", seed=0,
+                                       engine=dict(_ENGINE)),
+                            SupervisorConfig(**cfg_kw))
+    handles = [sup.spawn() for _ in range(n)]
+    router = FleetRouter(handles, FleetConfig(),
+                         registry=sup.registry)
+    sup.router = router   # restarts from poll() attach themselves
+    return sup, router
+
+
+def _prompts(model, n, seed=11):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, model.config.vocab_size,
+                                       size=3 + i % 4)))
+            for i in range(n)]
+
+
+def _reference(model, prompts, sp, ids):
+    eng = LLMEngine(model, EngineConfig(**_ENGINE))
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    return {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+
+def _drain(router, max_steps=300):
+    outs = []
+    for _ in range(max_steps):
+        if not router.has_unfinished():
+            return outs
+        outs.extend(router.step())
+    raise AssertionError("router failed to converge")
+
+
+_SP = SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9)
+
+
+def test_sigkill_mid_decode_resume_and_supervised_restart(tiny_model,
+                                                          tmp_path):
+    sup, router = _mk_fleet(tmp_path, restart_backoff_s=0.05)
+    try:
+        prompts = _prompts(tiny_model, 5)
+        ids = [f"k{i}" for i in range(5)]
+        ref = _reference(tiny_model, prompts, _SP, ids)
+        outs = []
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=_SP)
+        for _ in range(3):
+            outs.extend(router.step())        # some tokens in flight
+        victim = sup.handles()[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        outs += _drain(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+        assert victim.proc.wait(timeout=10) == -signal.SIGKILL
+        assert not victim.alive
+        assert router.num_replicas_dead == 1
+        assert router.num_handoffs >= 1
+        # exactly-once: each client-visible token stream has no extras
+        counts = {}
+        for o in outs:
+            if o.token is not None:
+                counts[o.request_id] = counts.get(o.request_id, 0) + 1
+        assert counts == {r: len(ref[r]) for r in ids}
+
+        # the supervisor notices and relaunches under a new generation
+        deadline = time.monotonic() + 120.0
+        events = []
+        while time.monotonic() < deadline:
+            events += sup.poll()
+            if any(e["event"] == "restarted" for e in events):
+                break
+            time.sleep(0.05)
+        restarted = [e for e in events if e["event"] == "restarted"]
+        assert restarted and restarted[0]["replica_id"] == "w0-g1"
+        # ...and serves traffic: same id + prompt as a fresh single-
+        # engine run (sampling streams are seeded per request id)
+        ref2 = _reference(tiny_model, [prompts[0]], _SP, ["k5"])
+        router.add_request("k5", prompts[0], sampling=_SP)
+        outs2 = _drain(router)
+        fin2 = {o.request_id: o for o in outs2 if o.finished}
+        assert fin2["k5"].finish_reason == "length"
+        assert list(fin2["k5"].generated) == ref2["k5"]
+    finally:
+        sup.shutdown()
+
+
+def test_sigterm_drain_hands_off_and_worker_exits_zero(tiny_model,
+                                                       tmp_path):
+    sup, router = _mk_fleet(tmp_path)
+    try:
+        prompts = _prompts(tiny_model, 4, seed=13)
+        ids = [f"d{i}" for i in range(4)]
+        ref = _reference(tiny_model, prompts, _SP, ids)
+        outs = []
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=_SP)
+        for _ in range(2):
+            outs.extend(router.step())
+        victim = sup.handles()[0]
+        sup.stop_worker("w0")                 # SIGTERM, no restart
+        outs += _drain(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+        assert router.num_replicas_dead == 0  # drain is not a death
+        # graceful exit: worker leaves on its own once drained
+        assert victim.proc.wait(timeout=60) == 0
+        assert victim.retiring                # last reply said drained_out
+        assert victim.replica_id not in [     # reaped, not killed
+            h.replica_id for h in router.replicas]
+    finally:
+        sup.shutdown()
+
+
+def test_hung_worker_detected_by_heartbeat_ttl(tiny_model, tmp_path):
+    # SIGSTOP: process alive, socket open, heartbeat thread frozen —
+    # the failure only the registry TTL can see. The short rng_state
+    # deadline bounds the one post-mortem query kill_replica makes
+    # before the handle is marked dead and the cache takes over.
+    sup, router = _mk_fleet(tmp_path, ttl_s=1.5, hb_interval_s=0.2,
+                            deadlines={"rng_state": 0.75})
+    try:
+        prompts = _prompts(tiny_model, 4, seed=17)
+        ids = [f"h{i}" for i in range(4)]
+        ref = _reference(tiny_model, prompts, _SP, ids)
+        outs = []
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=_SP)
+        for _ in range(3):
+            outs.extend(router.step())        # dispatch + observe beats
+        victim = sup.handles()[0]
+        had_work = bool(router._assigned.get(victim.replica_id))
+        os.kill(victim.proc.pid, signal.SIGSTOP)
+        time.sleep(2.5)                       # silence > ttl_s
+        outs += _drain(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+        assert not victim.alive               # TTL sweep declared it
+        assert router.num_replicas_dead == 1
+        if had_work:
+            assert router.num_handoffs >= 1
+        os.kill(victim.proc.pid, signal.SIGKILL)  # SIGTERM can't land
+    finally:
+        sup.shutdown()

@@ -1,0 +1,712 @@
+"""Out-of-process fleet transport pins (ISSUE 12).
+
+Three layers, cheapest first:
+
+* protocol units — framing, deadlines, idempotent retry, late-reply
+  hygiene, error mapping, the ``fleet.rpc_delay``/``fleet.rpc_drop``
+  fault points;
+* **loopback** tests — a real :class:`RpcClient` talking to a real
+  :class:`ReplicaServicer` over a socketpair, with the servicer thread
+  hosting a real tiny-Llama engine in-process. "SIGKILL" here is an
+  abrupt server-side socket sever with no farewell frame — byte-for-
+  byte what the client observes when the worker process is killed —
+  which makes the headline pin (mid-decode kill resumes bit-identical,
+  greedy AND sampled) runnable in the non-slow tier. The true
+  multiprocess versions live in test_fleet_subprocess.py (slow);
+* router bookkeeping regressions — hand-off budget consumed exactly
+  once per death, the ``handoff_exhausted`` counter, dead-handle abort
+  hygiene — and the registry's skew-immune monotonic liveness.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.replica_registry import MemStore, ReplicaRegistry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineConfig, LLMEngine, RequestOutput, SamplingParams,
+)
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, InProcessReplica, ReplicaGone,
+    ReplicaHandle, ReplicaLoad, ReplicaServicer, RpcClient,
+    RpcRemoteError, RpcTimeout, SubprocessReplica,
+)
+from paddle_tpu.serving.fleet.transport import recv_frame, send_frame
+from paddle_tpu.serving.request import FINISH_REASONS
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+class TestFraming:
+    def test_roundtrip_and_eof(self):
+        a, b = socket.socketpair()
+        send_frame(a, {"id": 1, "method": "ping", "params": {}})
+        send_frame(a, {"id": 2, "x": [1, 2, 3]})
+        assert recv_frame(b) == {"id": 1, "method": "ping", "params": {}}
+        assert recv_frame(b) == {"id": 2, "x": [1, 2, 3]}
+        a.close()
+        assert recv_frame(b) is None       # clean EOF
+        b.close()
+
+    def test_oversized_length_prefix_is_connection_loss(self):
+        a, b = socket.socketpair()
+        a.sendall(b"\xff\xff\xff\xff")     # 4 GiB frame: garbage
+        with pytest.raises(OSError):
+            recv_frame(b)
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# RpcClient semantics against a hand-rolled server
+# ---------------------------------------------------------------------------
+def _server(sock, script):
+    """Serve frames per `script(msg) -> reply | None (swallow)`."""
+
+    def run():
+        try:
+            while True:
+                msg = recv_frame(sock)
+                if msg is None:
+                    return
+                reply = script(msg)
+                if reply is not None:
+                    send_frame(sock, reply)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+class TestRpcClient:
+    def _client(self, script, **kw):
+        a, b = socket.socketpair()
+        _server(b, script)
+        kw.setdefault("backoff_base_s", 0.01)
+        return RpcClient(a, **kw), b
+
+    def test_call_response_matched_by_id(self):
+        cl, _ = self._client(
+            lambda m: {"id": m["id"], "ok": True,
+                       "result": m["params"]["x"] * 2})
+        assert cl.call("double", {"x": 21}) == 42
+        assert cl.call("double", {"x": 3}) == 6
+        assert cl.stats["calls"] == 2
+        cl.close()
+
+    def test_mutation_timeout_no_retry(self):
+        calls = []
+
+        def swallow(m):
+            calls.append(m["method"])
+            return None
+
+        cl, _ = self._client(swallow)
+        with pytest.raises(RpcTimeout):
+            cl.call("step", {}, deadline_s=0.1, idempotent=False)
+        time.sleep(0.05)
+        assert calls == ["step"]          # exactly one attempt
+        assert cl.stats["timeouts"] == 1
+        cl.close()
+
+    def test_idempotent_retries_with_backoff_then_succeeds(self):
+        seen = []
+
+        def flaky(m):
+            seen.append(m["id"])
+            if len(seen) == 1:
+                return None               # lose the first reply
+            return {"id": m["id"], "ok": True, "result": "pong"}
+
+        cl, _ = self._client(flaky)
+        assert cl.call("ping", {}, deadline_s=0.15) == "pong"
+        assert cl.stats["retries"] == 1
+        assert seen[0] != seen[1]         # the retry is a NEW sequence
+        cl.close()
+
+    def test_late_reply_to_abandoned_call_never_poisons_next(self):
+        def script(m):
+            if m["method"] == "slow":
+                # reply AFTER the caller's deadline has expired
+                time.sleep(0.25)
+                return {"id": m["id"], "ok": True, "result": "stale"}
+            return {"id": m["id"], "ok": True, "result": "fresh"}
+
+        cl, _ = self._client(script)
+        with pytest.raises(RpcTimeout):
+            cl.call("slow", {}, deadline_s=0.05, idempotent=False)
+        # the stale reply lands while this call is pending; ids differ
+        assert cl.call("fast", {}, deadline_s=2.0,
+                       idempotent=False) == "fresh"
+        cl.close()
+
+    def test_eof_mid_call_raises_replica_gone_not_timeout(self):
+        def die(m):
+            raise OSError("boom")          # server loop exits, EOF
+
+        cl, srv = self._client(die)
+        srv.shutdown(socket.SHUT_RDWR)
+        srv.close()
+        time.sleep(0.05)
+        with pytest.raises(ReplicaGone):
+            cl.call("step", {}, deadline_s=5.0, idempotent=False)
+        assert cl.closed
+        cl.close()
+
+    def test_remote_error_mapping(self):
+        stub = _StubReplica()
+        svc = ReplicaServicer(stub)
+        assert svc.handle({"id": 1, "method": "nope", "params": {}})[
+            "ok"] is False
+        cl, _ = self._client(ReplicaServicer(stub).handle)
+        with pytest.raises(ValueError):   # known types cross as themselves
+            cl.call("add_request", {
+                "request_id": "r", "prompt_ids": [],
+                "sampling": {"max_new_tokens": 0}}, idempotent=False)
+        with pytest.raises(RpcRemoteError):
+            cl.call("no_such_verb", {}, idempotent=False)
+        cl.close()
+
+    def test_rpc_drop_fault_mutation_dies_query_retries(self):
+        cl, _ = self._client(
+            lambda m: {"id": m["id"], "ok": True, "result": "pong"})
+        with faults.injected("fleet.rpc_drop:flag*1"):
+            with pytest.raises(RpcTimeout):   # mutation: one lost frame
+                cl.call("step", {}, deadline_s=1.0, idempotent=False)
+        with faults.injected("fleet.rpc_drop:flag*1"):
+            # idempotent: the retry re-sends and succeeds
+            assert cl.call("ping", {}, deadline_s=1.0) == "pong"
+            assert cl.stats["retries"] >= 1
+        cl.close()
+
+    def test_rpc_delay_fault_adds_latency(self):
+        cl, _ = self._client(
+            lambda m: {"id": m["id"], "ok": True, "result": 1})
+        with faults.injected("fleet.rpc_delay:sleep:0.2*1"):
+            t0 = time.monotonic()
+            assert cl.call("load", {}) == 1
+            assert time.monotonic() - t0 >= 0.2
+        cl.close()
+
+
+class _StubReplica(ReplicaHandle):
+    """Minimal servicer target for protocol-level tests."""
+
+    def __init__(self):
+        self.replica_id = "stub"
+        self.alive = True
+        self.retiring = False
+
+    def admission_verdict(self, prompt_tokens):
+        return None
+
+    def estimated_ttft_ms(self, prompt_tokens):
+        return 1.0
+
+    def load(self):
+        return ReplicaLoad()
+
+    @property
+    def is_draining(self):
+        return False
+
+    @property
+    def drained(self):
+        return False
+
+    def has_unfinished(self):
+        return False
+
+    def add_request(self, request_id, prompt_ids, sampling, *,
+                    rng_state=None):
+        pass  # SamplingParams(max_new_tokens=0) raises before this
+
+    def abort_request(self, request_id):
+        return False
+
+    def release_request(self, request_id):
+        pass
+
+    def rng_state(self, request_id):
+        return None
+
+    def step(self):
+        return []
+
+    def start_drain(self, reason="manual"):
+        return []
+
+
+# ---------------------------------------------------------------------------
+# loopback: real engine behind a real socket; sever() == SIGKILL as the
+# client sees it
+# ---------------------------------------------------------------------------
+class Loopback:
+    def __init__(self, inner, client_kw=None):
+        self.inner = inner
+        a, b = socket.socketpair()
+        self._server_sock = b
+        threading.Thread(target=ReplicaServicer(inner).serve, args=(b,),
+                         daemon=True).start()
+        self.client = RpcClient(a, name=inner.replica_id,
+                                **(client_kw or {}))
+        self.handle = SubprocessReplica(inner.replica_id, self.client)
+        # fleet.worker_kill's SIGKILL, loopback edition: the server
+        # half vanishes abruptly — no farewell frame, replies in flight
+        # lost — exactly the byte stream a killed process leaves behind
+        self.handle.hard_kill = self.sever
+
+    def sever(self):
+        try:
+            self._server_sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._server_sock.close()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    return model
+
+
+def _ecfg(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("drain_grace_s", 0.0)
+    return EngineConfig(**kw)
+
+
+def _prompts(model, n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, model.config.vocab_size,
+                                       size=3 + i % 4)))
+            for i in range(n)]
+
+
+def _reference(model, prompts, sp, ids):
+    eng = LLMEngine(model, _ecfg())
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    return {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+
+def _drain_router(router, max_steps=300):
+    outs = []
+    for _ in range(max_steps):
+        if not router.has_unfinished():
+            return outs
+        outs.extend(router.step())
+    raise AssertionError("router failed to converge")
+
+
+def _sp(sampled):
+    if sampled:
+        return SamplingParams(max_new_tokens=8, temperature=0.8,
+                              top_p=0.9)
+    return SamplingParams(max_new_tokens=8)
+
+
+class TestLoopbackE2E:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_generate_over_transport_matches_engine(self, tiny_model,
+                                                    sampled):
+        sp = _sp(sampled)
+        prompts = _prompts(tiny_model, 3)
+        ids = [f"t{i}" for i in range(3)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                       replica_id="L0"))
+        router = FleetRouter([lb.handle])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_sigkill_mid_decode_resumes_bit_identical(self, tiny_model,
+                                                      sampled):
+        # THE pin: the worker dies with no warning mid-decode; every
+        # in-flight request resumes on the peer and the client-visible
+        # token streams are bit-identical to an uninterrupted single
+        # engine — for sampling, from the piggybacked composite
+        # rng_state (the dead worker can't be queried post-mortem).
+        sp = _sp(sampled)
+        prompts = _prompts(tiny_model, 6)
+        ids = [f"k{i}" for i in range(6)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb0 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="L0"))
+        lb1 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="L1"))
+        router = FleetRouter([lb0.handle, lb1.handle])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install("fleet.worker_kill:flag:L0@3*1")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+        assert not lb0.handle.alive
+        assert router.num_handoffs >= 1
+        assert router.num_replicas_dead == 1
+        # exactly-once emission: every token reached the client once
+        counts = {}
+        for o in outs:
+            if o.token is not None:
+                counts[o.request_id] = counts.get(o.request_id, 0) + 1
+        assert counts == {r: len(ref[r]) for r in ids}
+
+    def test_drain_over_transport_hands_off_bit_identical(self,
+                                                          tiny_model):
+        # SIGTERM path through the wire: start_drain's reply carries
+        # the aborts AND their rng states in one frame
+        sp = _sp(True)
+        prompts = _prompts(tiny_model, 4)
+        ids = [f"d{i}" for i in range(4)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb0 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="L0"))
+        lb1 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="L1"))
+        router = FleetRouter([lb0.handle, lb1.handle])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        for _ in range(3):
+            router.step()
+        router.retire_replica(lb0.handle)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert lb0.handle.replica_id not in [
+            h.replica_id for h in router.replicas]  # reaped after drain
+
+    def test_chaos_storm_no_strands_no_dups_pools_full(self, tiny_model):
+        # randomized kill/drop/delay interleaving (schedule drawn from
+        # a seeded rng, two rounds). Invariants, not outcomes: every
+        # request terminates with a FINISH_REASONS member, every token
+        # reaches the client exactly once, and the surviving engines'
+        # block pools drain back to full.
+        for seed in (0, 1):
+            sched = np.random.default_rng(seed)
+            n = 8
+            prompts = _prompts(tiny_model, n, seed=20 + seed)
+            ids = [f"c{seed}-{i}" for i in range(n)]
+            lbs = [Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                             replica_id=f"S{seed}{j}"))
+                   for j in range(3)]
+            router = FleetRouter([lb.handle for lb in lbs])
+            for i, (rid, p) in enumerate(zip(ids, prompts)):
+                router.add_request(rid, p, sampling=_sp(i % 2 == 1))
+            spec = ";".join([
+                f"fleet.worker_kill:flag:S{seed}0"
+                f"@{sched.integers(2, 5)}*1",
+                f"fleet.worker_kill:flag:S{seed}1"
+                f"@{sched.integers(5, 8)}*1",
+                f"fleet.rpc_drop:flag@{sched.integers(3, 30)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.rpc_delay:sleep:0.01@{sched.integers(1, 20)}"
+                f"*{sched.integers(1, 4)}",
+            ])
+            faults.install(spec)
+            outs = _drain_router(router, max_steps=400)
+            faults.clear()
+            if not router.dispatchable() and router.has_unfinished():
+                # everything died with work queued: the supervisor's
+                # job is a fresh replica; here the test plays it
+                fresh = Loopback(InProcessReplica(
+                    tiny_model, _ecfg(), replica_id=f"S{seed}9"))
+                router.attach_replica(fresh.handle)
+                lbs.append(fresh)
+                outs += _drain_router(router, max_steps=400)
+            final = {o.request_id: o for o in outs if o.finished}
+            assert set(final) == set(ids)            # no strands
+            assert all(final[r].finish_reason in FINISH_REASONS
+                       for r in ids)
+            counts = {}
+            for o in outs:
+                if o.token is not None:
+                    counts[o.request_id] = counts.get(o.request_id,
+                                                      0) + 1
+            for r in ids:                            # no duplicates
+                assert counts.get(r, 0) == len(final[r].generated), r
+            for lb in lbs:                           # pools return full
+                if lb.handle.alive:
+                    bm = lb.inner.engine.block_manager
+                    assert bm.num_free_blocks == bm.num_blocks
+                    assert bm.num_free_host_blocks == bm.num_host_blocks
+
+
+# ---------------------------------------------------------------------------
+# hand-off budget + dead-handle bookkeeping regressions (model-free)
+# ---------------------------------------------------------------------------
+class FakeReplica(ReplicaHandle):
+    """Same shape as test_fleet.FakeReplica, trimmed to what's used."""
+
+    def __init__(self, replica_id, ttft=None, capacity=8):
+        self.replica_id = replica_id
+        self.alive = True
+        self.retiring = False
+        self.ttft = ttft
+        self.capacity = capacity
+        self.reqs = {}
+        self.dispatch_log = []
+        self._draining = False
+
+    def admission_verdict(self, prompt_tokens):
+        if not self.alive:
+            return "replica is dead"
+        if self._draining:
+            return "replica is draining"
+        if len(self.reqs) >= self.capacity:
+            return "queue full"
+        return None
+
+    def estimated_ttft_ms(self, prompt_tokens):
+        return self.ttft
+
+    def load(self):
+        return ReplicaLoad(num_running=len(self.reqs),
+                           kv_utilization=min(1.0, len(self.reqs)
+                                              / max(self.capacity, 1)))
+
+    @property
+    def is_draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        return self._draining and not self.reqs
+
+    def has_unfinished(self):
+        return self.alive and bool(self.reqs)
+
+    def add_request(self, request_id, prompt_ids, sampling, *,
+                    rng_state=None):
+        self.reqs[request_id] = [sampling, []]
+        self.dispatch_log.append(request_id)
+
+    def abort_request(self, request_id):
+        return self.reqs.pop(request_id, None) is not None
+
+    def release_request(self, request_id):
+        self.reqs.pop(request_id, None)
+
+    def rng_state(self, request_id):
+        return {"fake_state_for": request_id}
+
+    def step(self):
+        if not self.alive:
+            return []
+        outs = []
+        for rid in list(self.reqs):
+            sp, gen = self.reqs[rid]
+            gen.append(1000 + len(gen))
+            done = len(gen) >= sp.max_new_tokens
+            outs.append(RequestOutput(
+                request_id=rid, token=gen[-1], finished=done,
+                generated=list(gen),
+                finish_reason="length" if done else None))
+            if done:
+                del self.reqs[rid]
+        return outs
+
+    def start_drain(self, reason="manual"):
+        self._draining = True
+        outs = []
+        for rid in list(self.reqs):
+            sp, gen = self.reqs.pop(rid)
+            outs.append(RequestOutput(
+                request_id=rid, token=None, finished=True,
+                generated=list(gen), finish_reason="aborted:drain"))
+        return outs
+
+
+class TestHandoffBudget:
+    def test_process_death_consumes_budget_exactly_once(self):
+        # the handle dies outside the router's sight; however many
+        # health-sweep passes observe the corpse, each stranded request
+        # pays ONE hand-off slot for the one death
+        ra = FakeReplica("ra", ttft=1.0)
+        rb = FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb])
+        rids = [router.add_request([1], SamplingParams(max_new_tokens=6))
+                for _ in range(2)]
+        router.step()
+        assert ra.dispatch_log == rids
+        ra.alive = False                     # process gone
+        outs = []
+        router._health_sweep(outs)           # discovery pass
+        for _ in range(4):                   # sweep spam: same corpse
+            router._health_sweep(outs)
+        assert router.num_handoffs == 2      # one slot per request
+        assert all(router.get_request(r).handoffs == 1 for r in rids)
+        assert router.num_replicas_dead == 1
+        final = {o.request_id: o for o in _drain_router(router)
+                 if o.finished}
+        assert all(final[r].finish_reason == "length" for r in rids)
+        assert all(router.get_request(r).handoffs == 1 for r in rids)
+
+    def test_repeated_kill_replica_is_idempotent(self):
+        ra = FakeReplica("ra", ttft=1.0)
+        rb = FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=4))
+        router.step()
+        outs = []
+        router.kill_replica("ra", outputs=outs)
+        router.kill_replica("ra", outputs=outs)
+        router.kill_replica("ra", outputs=outs)
+        assert router.num_replicas_dead == 1
+        assert router.num_handoffs == 1
+        assert router.get_request(rid).handoffs == 1
+
+    def test_handoff_exhausted_counter_pinned(self):
+        ra = FakeReplica("ra", ttft=1.0)
+        rb = FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb], FleetConfig(max_handoffs=0))
+        rid = router.add_request([1], SamplingParams(max_new_tokens=4))
+        router.step()
+        outs = []
+        router.kill_replica("ra", outputs=outs)
+        assert router.num_handoff_exhausted == 1
+        assert [o.finish_reason for o in outs] == ["aborted:error"]
+        router.kill_replica("ra", outputs=outs)    # corpse re-kill
+        assert router.num_handoff_exhausted == 1   # not re-counted
+        assert router.snapshot()["fleet_handoff_exhausted"] == 1
+        assert router.get_request(rid).finish_reason == "aborted:error"
+
+    def test_handoff_exhausted_counts_drain_path_too(self):
+        class DrainOnStep(FakeReplica):
+            def step(self):
+                if self.reqs and not self._draining:
+                    return self.start_drain("unstable")
+                return super().step()
+
+        router = FleetRouter(
+            [DrainOnStep("ra"), DrainOnStep("rb"), DrainOnStep("rc")],
+            FleetConfig(max_handoffs=1))
+        router.add_request([1], SamplingParams(max_new_tokens=4))
+        outs = _drain_router(router)
+        assert [o.finish_reason for o in outs
+                if o.finished] == ["aborted:drain"]
+        assert router.num_handoffs == 1
+        assert router.num_handoff_exhausted == 1
+
+    def test_abort_on_dead_replica_unassigns(self):
+        # pre-fix, the dead handle kept the aborted request in
+        # _assigned and every health sweep "recovered" the corpse again
+        ra = FakeReplica("ra", ttft=1.0)
+        rb = FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=9))
+        router.step()
+        ra.alive = False
+        assert router.abort_request(rid) is True
+        assert not router._assigned["ra"]
+        outs = []
+        for _ in range(3):
+            router._health_sweep(outs)
+        assert router.num_replicas_dead == 0   # nothing left to recover
+        assert router.num_handoffs == 0
+
+
+# ---------------------------------------------------------------------------
+# registry: skew-immune monotonic liveness
+# ---------------------------------------------------------------------------
+class TestRegistryMonotonic:
+    def test_wall_clock_skew_cannot_fake_death(self):
+        # a writer whose wall clock is 999s behind still reads as alive:
+        # liveness keys on the record CHANGING, not on its ts field
+        store = MemStore()
+        writer = ReplicaRegistry(store, ttl_s=2.0)
+        reader = ReplicaRegistry(store, ttl_s=2.0)
+        writer.heartbeat("w", now=time.time() - 999.0)   # skewed clock
+        assert reader.is_alive("w")
+        writer.heartbeat("w", now=time.time() - 999.0)
+        assert set(reader.alive()) == {"w"}
+
+    def test_silence_past_ttl_is_death_on_reader_clock(self):
+        store = MemStore()
+        writer = ReplicaRegistry(store, ttl_s=2.0)
+        reader = ReplicaRegistry(store, ttl_s=2.0)
+        writer.heartbeat("w")
+        t0 = time.monotonic()
+        reader._mono = lambda: t0
+        assert reader.is_alive("w")                 # observed at t0
+        reader._mono = lambda: t0 + 1.5
+        assert reader.is_alive("w")                 # inside ttl
+        reader._mono = lambda: t0 + 2.5
+        assert reader.is_alive("w") is False        # silent past ttl
+        writer.heartbeat("w")                       # resumes
+        assert reader.is_alive("w")
+
+    def test_writer_restart_reads_as_fresh(self):
+        # a restarted worker's counter restarts too; the nonce makes
+        # the record read as changed, never as a stale continuation
+        store = MemStore()
+        w1 = ReplicaRegistry(store, ttl_s=2.0)
+        reader = ReplicaRegistry(store, ttl_s=2.0)
+        for _ in range(3):
+            w1.heartbeat("w")
+        t0 = time.monotonic()
+        reader._mono = lambda: t0
+        assert reader.is_alive("w")
+        reader._mono = lambda: t0 + 5.0             # w1 long silent
+        assert reader.is_alive("w") is False
+        w2 = ReplicaRegistry(store, ttl_s=2.0)      # new process
+        w2.heartbeat("w")
+        assert reader.is_alive("w")
+
+    def test_legacy_record_without_seq_falls_back_to_ts(self):
+        import json
+
+        store = MemStore()
+        reader = ReplicaRegistry(store, ttl_s=5.0)
+        store.set("serving_fleet/hb/old",
+                  json.dumps({"ts": time.time()}))
+        assert reader.is_alive("old")
+        store.set("serving_fleet/hb/old",
+                  json.dumps({"ts": time.time() - 100.0}))
+        assert reader.is_alive("old") is False
+
+    def test_explicit_now_keeps_simulated_clock_contract(self):
+        reg = ReplicaRegistry(MemStore(), ttl_s=5.0)
+        reg.heartbeat("a", now=100.0)
+        assert reg.is_alive("a", now=104.0)
+        assert reg.is_alive("a", now=106.0) is False
+
+    def test_worker_kill_fault_noop_without_hard_kill(self):
+        ra = FakeReplica("ra", ttft=1.0)
+        rb = FakeReplica("rb", ttft=9.0)
+        router = FleetRouter([ra, rb])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=3))
+        faults.install("fleet.worker_kill:flag:ra*1")
+        outs = _drain_router(router)
+        assert ra.alive                       # no transport, no SIGKILL
+        final = {o.request_id: o.finish_reason for o in outs
+                 if o.finished}
+        assert final == {rid: "length"}
