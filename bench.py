@@ -21,6 +21,7 @@ return before the device queue drains, which silently inflates throughput.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 MFU_GATE = 0.45  # BASELINE gate #4: >= 45% MFU
@@ -510,7 +511,7 @@ def _worker_model_small(spec):
 
 def bench_fleet(tiny=False, replicas=2, n_requests=16,
                 max_new_tokens=32, max_num_seqs=4, seed=0,
-                subprocess_mode=False):
+                subprocess_mode=False, disagg=False):
     """Multi-replica serving throughput through the FleetRouter
     (``--serving --replicas N``): the same ragged-prompt scenario as
     :func:`bench_serving`, dispatched across ``replicas`` engines
@@ -523,13 +524,24 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
     :class:`ReplicaSupervisor` fleet of worker PROCESSES behind the
     length-prefixed RPC transport — same prompts, same weights — and
     reports tokens/s, aggregate RPC overhead (calls, wire time), and a
-    SIGKILL-one-worker-mid-run smoke alongside the in-process numbers."""
+    SIGKILL-one-worker-mid-run smoke alongside the in-process numbers.
+
+    ``--disagg`` splits the fleet into prefill and decode roles (first
+    half prefill) so every measured request prefills on one side and is
+    KV-SHIPPED to the other for decode — zero prompt tokens recomputed.
+    The extra then carries the ship counters (requests/blocks/bytes/
+    ms_avg) plus a recompute-path comparison against the previous
+    round's undisaggregated fleet number when BENCH_serving_r05.json is
+    on disk; the subprocess SIGKILL smoke targets a DECODE worker so
+    the JSON also trends the crash→recompute-fallback path."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaForCausalLM
     from paddle_tpu.serving import EngineConfig, SamplingParams
-    from paddle_tpu.serving.fleet import FleetRouter, InProcessReplica
+    from paddle_tpu.serving.fleet import (
+        FleetConfig, FleetRouter, InProcessReplica,
+    )
     from paddle_tpu.testing import faults
 
     paddle.seed(seed)
@@ -546,9 +558,13 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
             max_num_seqs=max_num_seqs,
             max_model_len=min(cfg.max_position_embeddings, 1024), **kw)
 
-    router = FleetRouter([
-        InProcessReplica(model, ecfg(), replica_id=f"r{i}")
-        for i in range(replicas)])
+    n_pre = max(1, replicas // 2) if disagg else 0
+    roles = ({f"r{i}": ("prefill" if i < n_pre else "decode")
+              for i in range(replicas)} if disagg else None)
+    router = FleetRouter(
+        [InProcessReplica(model, ecfg(), replica_id=f"r{i}")
+         for i in range(replicas)],
+        FleetConfig(roles=roles) if roles else None)
     rng = np.random.RandomState(seed)
     sp = SamplingParams(max_new_tokens=max_new_tokens)
 
@@ -576,6 +592,13 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
     assert all(router.get_request(r).finish_reason == "length"
                for r in rids)
     snap = router.snapshot()
+    if disagg:
+        # every request prefilled on one side and decoded on the other
+        # with its blocks shipped, not recomputed
+        assert snap["fleet_kv_ship_requests"] >= n_requests, snap
+        assert snap["fleet_kv_ship_bytes"] > 0, snap
+        assert snap["fleet_recompute_fallbacks"] == 0, snap
+        assert snap["fleet_tokens_recomputed"] == 0, snap
 
     # resilience smoke: zero-grace pair, one replica drained mid-run by
     # the fleet.drain_replica fault — every request must still finish
@@ -619,7 +642,10 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
             SupervisorConfig(
                 store_dir=tempfile.mkdtemp(prefix="bench_fleet_hb_")))
         try:
-            s_handles = [sup.spawn() for _ in range(replicas)]
+            s_handles = [
+                sup.spawn(role=(("prefill" if i < n_pre else "decode")
+                                if disagg else None))
+                for i in range(replicas)]
             s_router = FleetRouter(s_handles, registry=sup.registry)
             sup.router = s_router
             for p in prompts(replicas * max_num_seqs + 2, 5):
@@ -647,9 +673,12 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
 
             # resilience, subprocess edition: SIGKILL one worker
             # mid-run; every request must still finish 'length' on the
-            # peer (transport-cached RNG, router hand-off)
+            # peer (transport-cached RNG, router hand-off). In disagg
+            # mode the victim is a DECODE worker, so its shipped
+            # requests exercise the crash→recompute-fallback path.
+            victim = s_handles[n_pre] if disagg else s_handles[0]
             faults.install("fleet.worker_kill:flag:"
-                           f"{s_handles[0].replica_id}@3*1")
+                           f"{victim.replica_id}@3*1")
             k_rids = [s_router.add_request(p, sampling=SamplingParams(
                 max_new_tokens=8)) for p in prompts(6, 6)]
             try:
@@ -675,9 +704,38 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
                     "num_replicas_dead": s_router.num_replicas_dead,
                     "finished_length": len(k_rids),
                 },
+                **({"kv_ship_requests": s_router.num_kv_ship_requests,
+                    "kv_ship_bytes": s_router.num_kv_ship_bytes,
+                    "tokens_recomputed": s_router.num_tokens_recomputed,
+                    "recompute_fallbacks":
+                        s_router.num_recompute_fallbacks}
+                   if disagg else {}),
             }
         finally:
             sup.shutdown()
+
+    disagg_extra = None
+    if disagg:
+        disagg_extra = {
+            "n_prefill": n_pre, "n_decode": replicas - n_pre,
+            "bytes_shipped": snap["fleet_kv_ship_bytes"],
+            "blocks_shipped": snap["fleet_kv_ship_blocks"],
+            "ship_requests": snap["fleet_kv_ship_requests"],
+            "ship_ms_avg": snap["fleet_kv_ship_ms_avg"],
+            "tokens_recomputed": snap["fleet_tokens_recomputed"],
+            "recompute_fallbacks": snap["fleet_recompute_fallbacks"],
+        }
+        if os.path.exists("BENCH_serving_r05.json"):
+            # r05 ran the identical scenario with role-less replicas
+            # (resume-by-recompute fleet) — the ratio IS the cost/win
+            # of disaggregation on this box
+            with open("BENCH_serving_r05.json") as f:
+                prev = json.load(f)
+            disagg_extra["vs_r05_recompute_fleet"] = {
+                "tokens_per_sec_ratio": round(
+                    (tokens / dt) / prev["value"], 3),
+                "r05_tokens_per_sec": prev["value"],
+            }
 
     return {
         "metric": "fleet_tokens_per_sec",
@@ -688,10 +746,12 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
             "config": ("tiny" if tiny else "gpt-small-serving")
                       + f" replicas={replicas} n_req={n_requests}"
                       f" max_new={max_new_tokens}"
-                      f" max_num_seqs={max_num_seqs}",
+                      f" max_num_seqs={max_num_seqs}"
+                      + (" disagg" if disagg else ""),
             "wall_s": round(dt, 3),
             **{k: v for k, v in snap.items() if k != "replicas"},
             "resilience_smoke": resilience,
+            **({"disagg": disagg_extra} if disagg_extra else {}),
             **({"subprocess": sub} if sub is not None else {}),
         },
     }
@@ -924,13 +984,16 @@ if __name__ == "__main__":
         # serving mode: one BENCH_serving JSON line (tokens/s primary,
         # TTFT/TPOT/occupancy in extra) — tracked across BENCH_r* like
         # copy_frac is. --replicas N routes the same scenario through
-        # the fleet router instead (fleet counters in extra).
+        # the fleet router instead (fleet counters in extra); --disagg
+        # splits it into prefill/decode roles with KV-block shipping
+        # (ship counters + recompute comparison in extra.disagg).
         if "--replicas" in sys.argv:
             n = int(sys.argv[sys.argv.index("--replicas") + 1])
             print("BENCH_serving_fleet " + json.dumps(
                 bench_fleet(tiny="--tiny" in sys.argv, replicas=n,
                             subprocess_mode="--subprocess"
-                                            in sys.argv)))
+                                            in sys.argv,
+                            disagg="--disagg" in sys.argv)))
         else:
             print("BENCH_serving " + json.dumps(
                 bench_serving(tiny="--tiny" in sys.argv)))

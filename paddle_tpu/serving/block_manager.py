@@ -315,6 +315,50 @@ class BlockManager:
             table.append(self._claim())
         return list(table)
 
+    # -- fleet KV-ship ----------------------------------------------------
+    def export_blocks(self, request_id: str, num_tokens: int) -> List[int]:
+        """The leading blocks of the request's table that cover its first
+        ``num_tokens`` committed tokens — the device gather list for a
+        fleet KV-ship. Read-only: refcounts and the prefix trie are
+        untouched (the source keeps ownership until it releases; shared
+        prefix blocks export fine, the peer receives a private copy)."""
+        table = self._tables.get(request_id)
+        if table is None:
+            raise KeyError(f"request {request_id!r} holds no block table")
+        need = self.blocks_needed(num_tokens)
+        if need > len(table):
+            raise ValueError(
+                f"request {request_id!r}: table covers {len(table)} "
+                f"block(s), {need} needed for {num_tokens} tokens")
+        return list(table[:need])
+
+    def import_blocks(self, request_id: str, num_tokens: int) -> List[int]:
+        """Claim fresh device blocks to receive a shipped KV payload
+        covering ``num_tokens`` tokens (fleet KV-ship import side). Every
+        block is private (refcount 1) and starts unregistered — shipped
+        content only becomes prefix-discoverable through the normal
+        :meth:`commit_prefix` after the engine scatters the bytes, so a
+        block is never shared before its K/V exists on device. Raises
+        :class:`NoFreeBlocksError` when the pool cannot take the payload
+        (the router falls back to recompute)."""
+        if request_id in self._tables:
+            raise ValueError(
+                f"request {request_id!r} already holds a block table — "
+                f"free() it before importing")
+        need = self.blocks_needed(num_tokens)
+        if need < 1:
+            raise ValueError(
+                f"request {request_id!r}: nothing to import for "
+                f"{num_tokens} tokens")
+        if need > len(self._free):
+            raise NoFreeBlocksError(
+                f"request {request_id!r}: {need} block(s) needed to "
+                f"import {num_tokens} shipped tokens, "
+                f"{len(self._free)} free")
+        table = [self._claim() for _ in range(need)]
+        self._tables[request_id] = table
+        return list(table)
+
     def trim(self, request_id: str, num_tokens: int) -> int:
         """Shrink the table to cover exactly ``num_tokens`` tokens,
         releasing trailing blocks back to the free list — the

@@ -76,12 +76,15 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddle_tpu.serving.block_manager import BlockManager, cdiv
+from paddle_tpu.serving.block_manager import (
+    BlockManager, NoFreeBlocksError, cdiv,
+)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.request import (
     Request, RequestOutput, RequestStatus, SamplingParams,
@@ -308,6 +311,31 @@ class _KVSwapper:
         dev = np.asarray(dev_table, np.int32)
         eng._kcs = eng._kcs.at[:, dev].set(eng._host_k[:, host])
         eng._vcs = eng._vcs.at[:, dev].set(eng._host_v[:, host])
+
+    def gather(self, dev_table: List[int]):
+        """Device->host gather of arbitrary blocks — the fleet KV-ship
+        export path. Same discipline as ``copy_out``/``fence`` (a
+        functional gather into a fresh buffer, async D2H start, then
+        land), except the bytes leave the process instead of landing in
+        a host-pool slot, so the land is immediate."""
+        eng = self._eng
+        dev = np.asarray(dev_table, np.int32)
+        k_slice = eng._kcs[:, dev]   # functional gather: its own buffer
+        v_slice = eng._vcs[:, dev]
+        for buf in (k_slice, v_slice):
+            start = getattr(buf, "copy_to_host_async", None)
+            if start is not None:
+                start()             # overlap D2H across the two slices
+        return np.asarray(k_slice), np.asarray(v_slice)
+
+    def scatter(self, dev_table: List[int], k_np, v_np):
+        """Write shipped KV bytes into freshly claimed device blocks
+        (fleet KV-ship import path) — the ``copy_in`` write, sourced
+        from wire bytes instead of the host pool."""
+        eng = self._eng
+        dev = np.asarray(dev_table, np.int32)
+        eng._kcs = eng._kcs.at[:, dev].set(k_np)
+        eng._vcs = eng._vcs.at[:, dev].set(v_np)
 
 
 class LLMEngine:
@@ -542,6 +570,17 @@ class LLMEngine:
         # speculative-decode lifetime counters (serving/spec_* gauges)
         self.num_spec_proposed = 0
         self.num_spec_accepted = 0
+        # requests admitted mid-context with peer-computed KV (fleet
+        # KV-ship import side; serving/continuation_admits gauge)
+        self.num_continuation_admits = 0
+        # drain-parked KV snapshots: request_id -> (covered tokens,
+        # device table) captured the instant a drain sweep aborts a
+        # running request. The blocks go back to the free list with the
+        # abort, but a drained engine dispatches no further steps, so
+        # the device bytes stay intact for a post-abort export_kv —
+        # the router's block-transfer drain hand-off reads them from
+        # here after the structured abort already crossed the wire.
+        self._handoff_kv: Dict[str, tuple] = {}
         # steps whose batch held >= 1 sampled (temperature > 0) request
         self.num_sampled_steps = 0
 
@@ -627,15 +666,7 @@ class LLMEngine:
                 f"it could never be served even alone")
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
-        if rng_state is not None:
-            if "numpy" in rng_state or "device_key" in rng_state:
-                if rng_state.get("numpy") is not None:
-                    req._rng.bit_generator.state = rng_state["numpy"]
-                if rng_state.get("device_key") is not None:
-                    req.device_key = np.asarray(
-                        rng_state["device_key"], np.uint32)
-            else:  # legacy bare numpy bit-generator state dict
-                req._rng.bit_generator.state = rng_state
+        self._apply_rng_state(req, rng_state)
         self._requests[request_id] = req
         # admission control: a draining engine admits nothing; a live
         # one consults the controller. Rejection is a first-class
@@ -653,11 +684,149 @@ class LLMEngine:
         self.scheduler.add(req)
         return request_id
 
+    @staticmethod
+    def _apply_rng_state(req: Request, rng_state) -> None:
+        """Resume a request's sampling stream from a hand-off state:
+        composite ``{"numpy": ..., "device_key": [hi, lo]}`` or the
+        legacy bare bit-generator dict."""
+        if rng_state is None:
+            return
+        if "numpy" in rng_state or "device_key" in rng_state:
+            if rng_state.get("numpy") is not None:
+                req._rng.bit_generator.state = rng_state["numpy"]
+            if rng_state.get("device_key") is not None:
+                req.device_key = np.asarray(
+                    rng_state["device_key"], np.uint32)
+        else:  # legacy bare numpy bit-generator state dict
+            req._rng.bit_generator.state = rng_state
+
     def abort_request(self, request_id: str) -> bool:
         found = self.scheduler.abort(request_id, "aborted:user")
         if found:
             self._count_finish("aborted:user")
         return found
+
+    # -- fleet KV-ship ---------------------------------------------------
+    def export_kv(self, request_id: str):
+        """Package the request's committed KV for a fleet KV-ship:
+        ``(meta, payload)`` where ``payload`` is the K bytes followed by
+        the V bytes of the ``(L, nblocks, BS, KH, D)`` gather, or
+        ``None`` when there is nothing worth shipping (no committed
+        tokens, no device table). Sources either a live request's table
+        or the drain-parked snapshot of one a drain sweep already
+        aborted. Read-only and idempotent — safe under RPC retry."""
+        covered, table = 0, None
+        req = self._requests.get(request_id)
+        if req is not None and req.num_cached > 0 \
+                and self.block_manager.has_table(request_id):
+            covered = req.num_cached
+            table = self.block_manager.export_blocks(request_id, covered)
+        else:
+            parked = self._handoff_kv.get(request_id)
+            if parked is not None:
+                covered, table = parked
+        if not table or covered <= 0:
+            return None
+        k_np, v_np = self._swapper.gather(table)
+        k_bytes = k_np.tobytes()
+        payload = k_bytes + v_np.tobytes()
+        meta = {
+            "tokens_covered": int(covered),
+            "blocks": len(table),
+            "block_size": int(self.cfg.block_size),
+            "shape": list(k_np.shape),
+            "dtype": str(k_np.dtype),
+            "k_bytes": len(k_bytes),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        }
+        return meta, payload
+
+    def import_kv(self, request_id: str, prompt_ids: Sequence[int],
+                  sampling: Optional[SamplingParams] = None,
+                  callback: Optional[Callable] = None, *,
+                  meta: dict, payload: bytes, rng_state=None) -> str:
+        """Admit a request whose leading KV was computed on a peer
+        replica (fleet KV-ship import side): claim fresh blocks,
+        scatter the shipped bytes, and enter the scheduler RUNNING with
+        ``num_cached`` pre-set — ``_schedule_mixed`` then continues it
+        as an ordinary mid-context continuation row, recomputing
+        nothing. Every clean rejection (geometry/checksum mismatch,
+        draining, cache full, duplicate id) raises ``ValueError`` so
+        the transport layer never mistakes it for replica death and the
+        router can fall back to recompute; nothing is allocated unless
+        admission fully succeeds."""
+        if not self.cfg.chunked_prefill:
+            raise ValueError(
+                "KV import needs chunked prefill (the imported request "
+                "resumes as a mid-context continuation row)")
+        if self._draining:
+            raise ValueError("engine is draining")
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id!r}")
+        sampling = sampling or SamplingParams()
+        prompt_ids = [int(t) for t in prompt_ids]
+        total = len(prompt_ids) + sampling.max_new_tokens
+        if total > self.cfg.max_model_len:
+            raise ValueError(
+                f"request {request_id!r}: prompt ({len(prompt_ids)}) + "
+                f"max_new_tokens ({sampling.max_new_tokens}) = {total} "
+                f"exceeds max_model_len {self.cfg.max_model_len}")
+        covered = int(meta.get("tokens_covered", 0))
+        if not 0 < covered < len(prompt_ids):
+            raise ValueError(
+                f"request {request_id!r}: shipped coverage {covered} "
+                f"outside (0, {len(prompt_ids)}) — at least one prompt "
+                f"token must remain to compute")
+        if int(meta.get("block_size", -1)) != self.cfg.block_size:
+            raise ValueError(
+                f"request {request_id!r}: shipped block_size "
+                f"{meta.get('block_size')} != {self.cfg.block_size}")
+        nblocks = cdiv(covered, self.cfg.block_size)
+        L, _, BS, KH, D = self._kcs.shape
+        want_shape = [L, nblocks, BS, KH, D]
+        if list(meta.get("shape", ())) != want_shape or \
+                int(meta.get("blocks", -1)) != nblocks:
+            raise ValueError(
+                f"request {request_id!r}: shipped KV shape "
+                f"{meta.get('shape')} != expected {want_shape}")
+        if str(meta.get("dtype")) != str(self._kcs.dtype):
+            raise ValueError(
+                f"request {request_id!r}: shipped dtype "
+                f"{meta.get('dtype')} != cache dtype {self._kcs.dtype}")
+        dtype = np.dtype(str(meta["dtype"]))
+        k_bytes = int(meta.get("k_bytes", -1))
+        want_bytes = int(np.prod(want_shape)) * dtype.itemsize
+        if k_bytes != want_bytes or len(payload) != 2 * want_bytes:
+            raise ValueError(
+                f"request {request_id!r}: shipped payload "
+                f"{len(payload)}B (k={k_bytes}) != 2x{want_bytes}B")
+        if zlib.crc32(payload) & 0xFFFFFFFF != int(meta.get("crc32", -1)):
+            raise ValueError(
+                f"request {request_id!r}: shipped KV failed its "
+                f"checksum — payload corrupt, refusing the import")
+        req = Request(request_id=request_id, prompt_ids=prompt_ids,
+                      sampling=sampling, callback=callback)
+        self._apply_rng_state(req, rng_state)
+        try:
+            table = self.block_manager.import_blocks(request_id, covered)
+        except NoFreeBlocksError as e:
+            raise ValueError(str(e)) from e
+        k_np = np.frombuffer(payload, dtype=dtype,
+                             count=want_bytes // dtype.itemsize)
+        v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
+                             count=want_bytes // dtype.itemsize)
+        self._swapper.scatter(table, k_np.reshape(want_shape),
+                              v_np.reshape(want_shape))
+        req.num_cached = covered
+        self._requests[request_id] = req
+        self.scheduler.add_continuation(req)
+        if self.cfg.prefix_cache:
+            # shipped prompt blocks are fully written now — register
+            # them so peers of THIS replica prefix-hit on them too
+            self.block_manager.commit_prefix(request_id, prompt_ids,
+                                             covered)
+        self.num_continuation_admits += 1
+        return request_id
 
     def _count_finish(self, reason: Optional[str]):
         if reason is not None:
@@ -733,6 +902,15 @@ class LLMEngine:
         live = (list(self.scheduler.running) + list(self.scheduler.waiting)
                 + list(self.scheduler.swapped))
         for r in live:
+            if reason == "aborted:drain" and r.num_cached > 0 \
+                    and self.block_manager.has_table(r.request_id):
+                # park the table snapshot BEFORE the abort frees it:
+                # the router's block-transfer drain hand-off exports
+                # these bytes after the structured abort lands
+                self._handoff_kv[r.request_id] = (
+                    r.num_cached,
+                    self.block_manager.export_blocks(r.request_id,
+                                                     r.num_cached))
             self.scheduler.abort(r.request_id, reason)
             if reason == "aborted:drain":
                 self.num_drain_aborted += 1
@@ -774,6 +952,7 @@ class LLMEngine:
             raise ValueError(
                 f"request {request_id!r} is {req.status.value}, not "
                 f"finished — abort_request() cancels in-flight requests")
+        self._handoff_kv.pop(request_id, None)
         return self._requests.pop(request_id)
 
     def reset_metrics(self) -> ServingMetrics:

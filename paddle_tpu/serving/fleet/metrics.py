@@ -30,7 +30,10 @@ class FleetMetrics:
     GAUGES = ("dispatched", "handoffs", "handoff_exhausted",
               "rejected_fleetwide", "replicas_live", "tenant_waiting",
               "replicas_dead", "scale_ups", "scale_downs",
-              "autoscale_decisions", "tokens_emitted")
+              "autoscale_decisions", "tokens_emitted",
+              "kv_ship_requests", "kv_ship_blocks", "kv_ship_bytes",
+              "kv_ship_ms_avg", "recompute_fallbacks",
+              "tokens_recomputed")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
@@ -44,6 +47,15 @@ class FleetMetrics:
         "scale_downs": lambda r: r.num_scale_downs,
         "autoscale_decisions": lambda r: r.num_autoscale_decisions,
         "tokens_emitted": lambda r: r.num_tokens_emitted,
+        # KV-ship (disaggregated serving)
+        "kv_ship_requests": lambda r: r.num_kv_ship_requests,
+        "kv_ship_blocks": lambda r: r.num_kv_ship_blocks,
+        "kv_ship_bytes": lambda r: r.num_kv_ship_bytes,
+        "kv_ship_ms_avg": lambda r: round(
+            r.kv_ship_time_s * 1e3 / r.num_kv_ship_requests, 3)
+            if r.num_kv_ship_requests else 0.0,
+        "recompute_fallbacks": lambda r: r.num_recompute_fallbacks,
+        "tokens_recomputed": lambda r: r.num_tokens_recomputed,
     }
 
     def __init__(self, router):
@@ -58,6 +70,9 @@ class FleetMetrics:
         dt = time.monotonic() - r.start_time
         out = {f"fleet_{name}": int(get(r))
                for name, get in self._ROUTER_GAUGES.items()}
+        # the one float gauge — re-emit past the int() wrap above
+        out["fleet_kv_ship_ms_avg"] = \
+            self._ROUTER_GAUGES["kv_ship_ms_avg"](r)
         out["fleet_replicas_total"] = len(r.replicas)
         out["fleet_tokens_per_sec"] = round(
             r.num_tokens_emitted / dt if dt > 0 else 0.0, 2)

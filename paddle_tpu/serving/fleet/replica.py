@@ -68,6 +68,10 @@ class ReplicaHandle:
     # process does); the router must not beat on its behalf, or a hung
     # worker would look alive forever
     self_heartbeat: bool = False
+    # disaggregated-serving specialization: "prefill" | "decode" |
+    # None (serves both). Advertised through the registry heartbeat so
+    # a restarted handle re-learns it (see FleetRouter._health_sweep)
+    role: Optional[str] = None
 
     # -- dispatch-side reads ---------------------------------------------
     def admission_verdict(self, prompt_tokens: int) -> Optional[str]:
@@ -107,6 +111,20 @@ class ReplicaHandle:
         unavailable (request unknown, or the replica is unreachable)."""
         raise NotImplementedError
 
+    # -- fleet KV-ship (optional capability; default: unsupported) --------
+    def export_kv(self, request_id: str):
+        """(meta dict, payload bytes) packaging the request's committed
+        KV blocks, or None when there is nothing to ship — the router
+        then falls back to recompute."""
+        return None
+
+    def import_kv(self, request_id: str, prompt_ids: Sequence[int],
+                  sampling: SamplingParams, *, meta: dict,
+                  payload: bytes, rng_state=None) -> bool:
+        """Admit a shipped-KV continuation; False on any clean
+        rejection (the router falls back to recompute)."""
+        return False
+
     # -- stepping / drain -------------------------------------------------
     def step(self) -> List[RequestOutput]:
         raise NotImplementedError
@@ -126,11 +144,13 @@ class InProcessReplica(ReplicaHandle):
     replicas (SIGTERM preempts the host, not one engine)."""
 
     def __init__(self, model, config: Optional[EngineConfig] = None,
-                 replica_id: Optional[str] = None, monitor=None):
+                 replica_id: Optional[str] = None, monitor=None,
+                 role: Optional[str] = None):
         self.replica_id = replica_id or f"replica-{id(self):x}"
         self.engine = LLMEngine(model, config)
         self.alive = True
         self.retiring = False
+        self.role = role
         self.created_at = time.monotonic()
         if monitor is not None:
             self.engine.install_preemption_handler(monitor)
@@ -194,6 +214,25 @@ class InProcessReplica(ReplicaHandle):
         return {"numpy": req._rng.bit_generator.state,
                 "device_key": [int(req.device_key[0]),
                                int(req.device_key[1])]}
+
+    # -- fleet KV-ship -----------------------------------------------------
+    def export_kv(self, request_id: str):
+        if not self.alive:
+            return None
+        return self.engine.export_kv(request_id)
+
+    def import_kv(self, request_id: str, prompt_ids: Sequence[int],
+                  sampling: SamplingParams, *, meta: dict,
+                  payload: bytes, rng_state=None) -> bool:
+        if not self.alive:
+            return False
+        try:
+            self.engine.import_kv(request_id, list(prompt_ids),
+                                  sampling=sampling, meta=meta,
+                                  payload=payload, rng_state=rng_state)
+            return True
+        except ValueError:
+            return False
 
     # -- stepping / drain -------------------------------------------------
     def step(self) -> List[RequestOutput]:

@@ -48,6 +48,19 @@ The transport adds two client-side points (see ``transport.py``):
 ``fleet.rpc_delay`` (stall a call against its deadline) and
 ``fleet.rpc_drop`` (lose a frame; idempotent calls retry, mutations
 surface as replica death).
+
+KV-ship fault points (disaggregated serving — queried at each ship):
+
+=========================  ==============================================
+``fleet.kv_ship_delay``     sleep ``arg`` seconds before the export —
+                            models a slow transfer link
+``fleet.kv_ship_drop``      lose the exported payload; the router falls
+                            back to resume-by-recompute on the peer
+``fleet.kv_ship_corrupt``   flip a byte in the payload; the import
+                            side's CRC check rejects it and the router
+                            falls back to recompute — the request is
+                            never duplicated or lost either way
+=========================  ==============================================
 """
 from __future__ import annotations
 
@@ -87,12 +100,27 @@ class FleetConfig:
     # a request that keeps landing on dying replicas eventually surfaces
     # its abort rather than bouncing forever
     max_handoffs: int = 8
+    # disaggregated serving: replica_id -> "prefill" | "decode". New
+    # requests dispatch to prefill-role replicas; on prefill completion
+    # the committed KV blocks SHIP to a decode-role replica instead of
+    # being recomputed there. Replicas absent from the map (and fleets
+    # with roles=None) serve both phases. Role preference, not quota:
+    # when no replica of the wanted role is dispatchable, any replica
+    # takes the request — availability beats purity
+    roles: Optional[Dict[str, str]] = None
 
     def __post_init__(self):
         if self.heartbeat_interval_s < 0:
             raise ValueError("heartbeat_interval_s must be >= 0")
         if self.max_handoffs < 0:
             raise ValueError("max_handoffs must be >= 0")
+        if self.roles:
+            bad = {r for r in self.roles.values()
+                   if r not in ("prefill", "decode")}
+            if bad:
+                raise ValueError(
+                    f"roles values must be 'prefill' or 'decode', "
+                    f"got {sorted(bad)!r}")
 
 
 @dataclass
@@ -114,6 +142,16 @@ class _FleetRequest:
     base_generated: List[int] = field(default_factory=list)
     progress: List[int] = field(default_factory=list)
     rng_state: Optional[dict] = None
+    # (meta, payload) of shipped KV riding to the next dispatch; the
+    # bytes live router-side, so the payload survives the SOURCE
+    # replica dying while the request waits in the queue
+    kv: Optional[tuple] = None
+    # set once the request's prefill completed on a prefill-role
+    # replica: from then on it belongs on the decode side, WITH the
+    # shipped KV or (fallback) by recompute there — re-prefilling on
+    # the prefill side would re-ship and a permanently failing ship
+    # would bounce forever
+    decode_bound: bool = False
     replica_id: Optional[str] = None
     dispatch_t: Optional[float] = None
     dispatches: int = 0
@@ -156,6 +194,13 @@ class FleetRouter:
         self.num_scale_downs = 0
         self.num_autoscale_decisions = 0
         self.num_tokens_emitted = 0
+        # KV-ship accounting (disaggregated serving)
+        self.num_kv_ship_requests = 0
+        self.num_kv_ship_blocks = 0
+        self.num_kv_ship_bytes = 0
+        self.kv_ship_time_s = 0.0
+        self.num_recompute_fallbacks = 0
+        self.num_tokens_recomputed = 0
         # client-visible terminal histogram (the fleet-level aggregate:
         # per-replica engines keep their own serving/finish/* view,
         # which double-counts handed-off attempts by design)
@@ -172,6 +217,8 @@ class FleetRouter:
                 f"duplicate replica id {handle.replica_id!r}")
         self.replicas.append(handle)
         self._assigned.setdefault(handle.replica_id, set())
+        if self.cfg.roles and getattr(handle, "role", None) is None:
+            handle.role = self.cfg.roles.get(handle.replica_id)
         self.registry.register(handle.replica_id)
 
     def retire_replica(self, handle: ReplicaHandle,
@@ -315,8 +362,17 @@ class FleetRouter:
         for h in list(self.replicas):
             if not h.alive:
                 continue
+            to_ship: List[str] = []
             for out in h.step():
-                self._handle_output(h, out, outputs)
+                self._handle_output(h, out, outputs, to_ship)
+            # ship AFTER the whole output list folded into progress —
+            # shipping inside the loop would migrate a request while
+            # later outputs from the same step still reference it
+            for rid in to_ship:
+                fr = self._open.get(rid)
+                if (fr is not None and not fr.finished
+                        and fr.replica_id == h.replica_id):
+                    self._ship_from(h, fr)
             if not h.alive and not h.retiring:
                 # the engine died mid-step (EngineStepError absorbed at
                 # the handle): outputs above carried its structured
@@ -398,6 +454,13 @@ class FleetRouter:
     def _health_sweep(self, outputs: List[RequestOutput]) -> None:
         view = self.registry.alive()
         for h in list(self.replicas):
+            if h.alive and getattr(h, "role", None) is None:
+                # a restarted worker advertises its role through the
+                # registry heartbeat meta; re-learn it so the fresh
+                # handle rejoins the right side of the disaggregation
+                meta = (view.get(h.replica_id) or {}).get("meta") or {}
+                if meta.get("role") in ("prefill", "decode"):
+                    h.role = meta["role"]
             if h.alive and h.replica_id not in view:
                 self.kill_replica(h.replica_id, "heartbeat lost", outputs)
             elif not h.alive and self._assigned.get(h.replica_id):
@@ -428,10 +491,36 @@ class FleetRouter:
                 # overtake a starved tenant)
                 self._queue.unpop(tenant, rid, cost)
                 return
-            handle = self._pick(cands, len(prompt))
-            handle.add_request(rid, prompt,
-                               self._effective_sampling(fr, now),
-                               rng_state=fr.rng_state)
+            handle = self._pick(self._role_candidates(cands, fr),
+                                len(prompt))
+            shipped = False
+            if fr.kv is not None:
+                meta, payload = fr.kv
+                t0 = time.monotonic()
+                shipped = handle.import_kv(
+                    rid, prompt, self._effective_sampling(fr, now),
+                    meta=meta, payload=payload, rng_state=fr.rng_state)
+                if shipped:
+                    self.kv_ship_time_s += time.monotonic() - t0
+                    self.num_kv_ship_requests += 1
+                    self.num_kv_ship_blocks += int(meta.get("blocks", 0))
+                    self.num_kv_ship_bytes += len(payload)
+                    self.num_tokens_recomputed += max(
+                        0, len(prompt) - 1
+                        - int(meta.get("tokens_covered", 0)))
+                else:
+                    # clean import rejection (corrupt payload, peer OOM,
+                    # capability missing): recompute on the same handle
+                    self.num_recompute_fallbacks += 1
+                fr.kv = None  # consumed either way
+            if not shipped:
+                handle.add_request(rid, prompt,
+                                   self._effective_sampling(fr, now),
+                                   rng_state=fr.rng_state)
+                if fr.dispatches > 0:
+                    # a continuation without KV re-prefills its whole
+                    # context (the single computed position excepted)
+                    self.num_tokens_recomputed += max(0, len(prompt) - 1)
             self._assigned.setdefault(handle.replica_id, set()).add(rid)
             fr.replica_id = handle.replica_id
             fr.dispatches += 1
@@ -471,15 +560,89 @@ class FleetRouter:
         return dataclasses.replace(fr.sampling, **repl) if repl \
             else fr.sampling
 
-    def _requeue(self, fr: _FleetRequest) -> None:
+    def _requeue(self, fr: _FleetRequest, *,
+                 count_handoff: bool = True) -> None:
         fr.base_generated = list(fr.progress)
         fr.replica_id = None
-        fr.handoffs += 1
+        if count_handoff:
+            fr.handoffs += 1
         # cost 0, front: the tenant already paid when first dispatched
         self._queue.push(fr.tenant, fr.request_id, 0, front=True)
 
+    # -- KV-ship (disaggregated serving) ----------------------------------
+    def _role(self, handle: ReplicaHandle) -> Optional[str]:
+        return getattr(handle, "role", None)
+
+    def _export_kv_guarded(self, handle: ReplicaHandle, request_id: str,
+                           *, expected: bool):
+        """``export_kv`` with the ``fleet.kv_ship_*`` fault points
+        applied. Returns ``(meta, payload)`` or None — None means the
+        next dispatch resumes by recompute. ``expected`` marks exports
+        that SHOULD succeed (prefill just completed), so a bare failure
+        counts as a recompute fallback; a drain export of a request
+        that never ran has nothing to ship and is not a fallback."""
+        for arg in faults.check("fleet.kv_ship_delay"):
+            time.sleep(float(arg) if arg else 0.01)
+        try:
+            kv = handle.export_kv(request_id)
+        except (KeyError, ValueError, OSError):
+            kv = None
+        dropped = kv is not None and bool(
+            faults.check("fleet.kv_ship_drop"))
+        if dropped:
+            kv = None
+        if kv is None:
+            if expected or dropped:
+                self.num_recompute_fallbacks += 1
+            return None
+        if faults.check("fleet.kv_ship_corrupt"):
+            # flip one payload byte: the import side's CRC check
+            # rejects it and the dispatch falls back to recompute
+            meta, payload = kv
+            if payload:
+                buf = bytearray(payload)
+                buf[0] ^= 0xFF
+                kv = (meta, bytes(buf))
+        return kv
+
+    def _ship_from(self, handle: ReplicaHandle,
+                   fr: _FleetRequest) -> None:
+        """Prefill complete on a prefill-role replica: migrate the
+        request to the decode side, shipping its committed KV blocks so
+        the peer recomputes nothing. A planned transfer, not a failure
+        hand-off — it spends no hand-off budget; a failed export
+        degrades to resume-by-recompute and the request migrates
+        anyway."""
+        state = handle.rng_state(fr.request_id)
+        if state is not None:
+            fr.rng_state = state
+        fr.decode_bound = True
+        t0 = time.monotonic()
+        fr.kv = self._export_kv_guarded(handle, fr.request_id,
+                                        expected=True)
+        if fr.kv is not None:
+            self.kv_ship_time_s += time.monotonic() - t0
+        handle.abort_request(fr.request_id)
+        handle.release_request(fr.request_id)
+        self._assigned.get(handle.replica_id, set()).discard(
+            fr.request_id)
+        self._requeue(fr, count_handoff=False)
+
+    def _role_candidates(self, cands: List[ReplicaHandle],
+                         fr: _FleetRequest) -> List[ReplicaHandle]:
+        """Role preference: KV-carrying continuations avoid prefill
+        replicas, everything else avoids decode replicas. Preference
+        only — when no replica of the wanted kind is dispatchable, any
+        candidate serves (availability beats purity)."""
+        if fr.kv is not None or fr.decode_bound:
+            pref = [h for h in cands if self._role(h) != "prefill"]
+        else:
+            pref = [h for h in cands if self._role(h) != "decode"]
+        return pref or cands
+
     def _handle_output(self, handle: ReplicaHandle, out: RequestOutput,
-                       outputs: List[RequestOutput]) -> None:
+                       outputs: List[RequestOutput],
+                       to_ship: Optional[List[str]] = None) -> None:
         fr = self._open.get(out.request_id)
         if fr is None:
             return  # not router-owned (or already finalized)
@@ -492,6 +655,14 @@ class FleetRouter:
                 generated=list(fr.progress)))
             if fr.callback is not None:
                 fr.callback(fr.request_id, out.token, False)
+            if (to_ship is not None
+                    and self._role(handle) == "prefill"
+                    and len(out.generated) == 1
+                    and self._has_peer(handle)):
+                # first emitted token = prefill complete: ship the KV
+                # to the decode side (after this handle's full output
+                # list has folded into progress)
+                to_ship.append(fr.request_id)
             return
         self._assigned.get(handle.replica_id, set()).discard(
             fr.request_id)
@@ -502,6 +673,15 @@ class FleetRouter:
             state = handle.rng_state(fr.request_id)
             if state is not None:
                 fr.rng_state = state
+            if reason == "aborted:drain":
+                # drain hand-off upgrades to block transfer: the source
+                # engine parks the KV before freeing the table, so the
+                # peer resumes without recomputing the prompt. Export
+                # BEFORE release — release drops the parked snapshot.
+                # Crash hand-offs (aborted:error) recompute: the source
+                # can't be trusted to produce bytes
+                fr.kv = self._export_kv_guarded(
+                    handle, fr.request_id, expected=False)
             handle.release_request(fr.request_id)
             self._requeue(fr)
             self.num_handoffs += 1

@@ -62,6 +62,11 @@ class WorkerSpec:
     model: str = "tiny_llama"
     seed: int = 0
     engine: Dict = field(default_factory=dict)
+    # disaggregated serving: "prefill" | "decode" | None (serve both).
+    # The default for every slot; spawn(role=...) overrides per slot.
+    # The worker advertises it in its registry heartbeat meta, so a
+    # router re-learns roles after a supervisor restart
+    role: Optional[str] = None
 
 
 @dataclass
@@ -84,6 +89,7 @@ class _Slot:
     def __init__(self, name: str):
         self.name = name
         self.generation = 0
+        self.role: Optional[str] = None   # sticky across restarts
         self.proc: Optional[subprocess.Popen] = None
         self.handle: Optional[SubprocessReplica] = None
         self.restarts = 0            # consecutive, reset when stable
@@ -111,15 +117,23 @@ class ReplicaSupervisor:
         self.num_restarts = 0
 
     # -- spawning ----------------------------------------------------------
-    def spawn(self, slot_name: Optional[str] = None) -> SubprocessReplica:
+    def spawn(self, slot_name: Optional[str] = None,
+              role: Optional[str] = None) -> SubprocessReplica:
         """Launch a worker in a (new or named) slot; attaches the handle
-        to the router when the supervisor owns one."""
+        to the router when the supervisor owns one. ``role`` pins the
+        slot to one side of a disaggregated fleet — sticky, so a
+        restarted slot rejoins the same side."""
         if slot_name is None:
             while True:
                 slot_name = f"w{next(self._auto)}"
                 if slot_name not in self._slots:
                     break
         slot = self._slots.setdefault(slot_name, _Slot(slot_name))
+        if role is not None:
+            if role not in ("prefill", "decode"):
+                raise ValueError(
+                    f"role must be 'prefill' or 'decode', got {role!r}")
+            slot.role = role
         handle = self._launch(slot)
         if self.router is not None:
             self.router.attach_replica(handle)
@@ -142,8 +156,10 @@ class ReplicaSupervisor:
         env.update(self.cfg.env)
         env["PADDLE_REPLICA_FD"] = str(child.fileno())
         env["PADDLE_REPLICA_ID"] = rid
-        env["PADDLE_REPLICA_SPEC"] = json.dumps(
-            dataclasses.asdict(self.spec))
+        role = slot.role or self.spec.role
+        spec_dict = dataclasses.asdict(self.spec)
+        spec_dict["role"] = role
+        env["PADDLE_REPLICA_SPEC"] = json.dumps(spec_dict)
         env["PADDLE_REPLICA_STORE"] = self.cfg.store_dir
         env["PADDLE_REPLICA_HB"] = str(self.cfg.hb_interval_s)
         env["PADDLE_REPLICA_TTL"] = str(self.cfg.ttl_s)
@@ -154,7 +170,8 @@ class ReplicaSupervisor:
         client = RpcClient(parent, name=rid,
                            default_deadline_s=self.cfg.spawn_timeout_s)
         handle = SubprocessReplica(rid, client, proc=proc,
-                                   deadlines=self.cfg.deadlines)
+                                   deadlines=self.cfg.deadlines,
+                                   role=role)
         try:
             client.call("ping", deadline_s=self.cfg.spawn_timeout_s)
         except (RpcError, OSError) as e:

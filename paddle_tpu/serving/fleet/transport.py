@@ -64,18 +64,37 @@ from paddle_tpu.testing import faults
 __all__ = [
     "RpcError", "RpcTimeout", "ReplicaGone", "RpcRemoteError",
     "RpcClient", "ReplicaServicer", "SubprocessReplica",
-    "send_frame", "recv_frame", "IDEMPOTENT_METHODS",
-    "DEFAULT_DEADLINES",
+    "send_frame", "recv_frame", "send_frame_with_blob",
+    "IDEMPOTENT_METHODS", "DEFAULT_DEADLINES",
 ]
 
 _LEN = struct.Struct(">I")
-MAX_FRAME = 64 * 1024 * 1024  # torn/garbage length guard
+MAX_FRAME = 64 * 1024 * 1024  # torn/garbage length guard; also the
+# per-shipped-batch KV payload cap (a bigger hand-off falls back to
+# recompute rather than growing frames without bound)
 
 
 # -- framing ---------------------------------------------------------------
 def send_frame(sock: socket.socket, obj: Any) -> None:
     payload = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def send_frame_with_blob(sock: socket.socket, obj: dict,
+                         blob: bytes) -> None:
+    """Binary-payload extension (fleet KV-ship): a JSON header frame
+    whose ``_bin`` key announces the exact length of ONE raw-bytes
+    frame that follows on the same socket. Readers that see ``_bin``
+    consume the blob frame too, so the stream never desynchronizes;
+    both frames obey the :data:`MAX_FRAME` cap."""
+    if len(blob) > MAX_FRAME:
+        raise ValueError(
+            f"blob length {len(blob)} exceeds {MAX_FRAME}")
+    obj = dict(obj)
+    obj["_bin"] = len(blob)
+    payload = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload
+                 + _LEN.pack(len(blob)) + blob)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -90,7 +109,9 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 def recv_frame(sock: socket.socket) -> Optional[Any]:
     """One frame, or None on EOF. Raises OSError on a torn length
-    prefix or oversized frame (treated as connection loss upstream)."""
+    prefix or oversized frame (treated as connection loss upstream).
+    A header announcing a binary payload (``_bin``) consumes the raw
+    frame that follows and attaches it under ``_blob``."""
     head = _recv_exact(sock, _LEN.size)
     if head is None:
         return None
@@ -100,7 +121,21 @@ def recv_frame(sock: socket.socket) -> Optional[Any]:
     body = _recv_exact(sock, n)
     if body is None:
         raise OSError("connection lost mid-frame")
-    return json.loads(body.decode())
+    msg = json.loads(body.decode())
+    if isinstance(msg, dict) and "_bin" in msg:
+        head = _recv_exact(sock, _LEN.size)
+        if head is None:
+            raise OSError("connection lost before announced blob")
+        (bn,) = _LEN.unpack(head)
+        if bn > MAX_FRAME or bn != int(msg["_bin"]):
+            raise OSError(
+                f"blob length {bn} disagrees with header "
+                f"({msg['_bin']}) or exceeds {MAX_FRAME}")
+        blob = _recv_exact(sock, bn)
+        if blob is None:
+            raise OSError("connection lost mid-blob")
+        msg["_blob"] = blob
+    return msg
 
 
 # -- errors ----------------------------------------------------------------
@@ -125,16 +160,21 @@ class RpcRemoteError(RpcError):
 
 
 # reads with no replica-side effect: safe to re-send after a lost reply
+# (export_kv is a pure device->host gather — the source keeps its
+# blocks; re-reading them returns the same bytes)
 IDEMPOTENT_METHODS = frozenset({
     "ping", "admission_verdict", "estimated_ttft_ms", "load",
     "is_draining", "drained", "has_unfinished", "rng_state", "snapshot",
+    "export_kv",
 })
 
 # per-method deadline overrides: step/start_drain cover the engine's
-# first-step XLA compile; everything else is a bookkeeping round trip
+# first-step XLA compile; the KV-ship verbs move whole block batches;
+# everything else is a bookkeeping round trip
 DEFAULT_DEADLINES: Dict[str, float] = {
     "*": 30.0, "ping": 120.0, "add_request": 120.0,
     "step": 600.0, "start_drain": 600.0,
+    "export_kv": 120.0, "import_kv": 120.0,
 }
 
 
@@ -218,9 +258,13 @@ class RpcClient:
     # -- caller side -------------------------------------------------------
     def call(self, method: str, params: Optional[dict] = None, *,
              deadline_s: Optional[float] = None,
-             idempotent: Optional[bool] = None) -> Any:
+             idempotent: Optional[bool] = None,
+             blob: Optional[bytes] = None) -> Any:
         """One RPC. Idempotent calls retry ``retries`` times on timeout
-        with exponential backoff; mutations get exactly one attempt."""
+        with exponential backoff; mutations get exactly one attempt.
+        ``blob`` rides as a raw-bytes frame behind the JSON header (the
+        KV-ship payload path); a blob-carrying reply is attached to a
+        dict result under ``_blob``."""
         if idempotent is None:
             idempotent = method in IDEMPOTENT_METHODS
         if deadline_s is None:
@@ -234,13 +278,15 @@ class RpcClient:
                 time.sleep(delay)
                 delay = min(delay * 2.0, self.backoff_max_s)
             try:
-                return self._call_once(method, params or {}, deadline_s)
+                return self._call_once(method, params or {}, deadline_s,
+                                       blob)
             except RpcTimeout as e:
                 last = e  # the reply may be lost, the worker may live
         raise last  # type: ignore[misc]
 
     def _call_once(self, method: str, params: dict,
-                   deadline_s: float) -> Any:
+                   deadline_s: float,
+                   blob: Optional[bytes] = None) -> Any:
         faults.fire("fleet.rpc_delay")
         if faults.check("fleet.rpc_drop"):
             self.stats["timeouts"] += 1
@@ -253,9 +299,12 @@ class RpcClient:
             call = _Call()
             self._pending[seq] = call
         t0 = time.monotonic()
+        req = {"id": seq, "method": method, "params": params}
         try:
-            send_frame(self._sock,
-                       {"id": seq, "method": method, "params": params})
+            if blob is None:
+                send_frame(self._sock, req)
+            else:
+                send_frame_with_blob(self._sock, req, blob)
         except (OSError, ValueError):
             self._mark_closed()
             raise ReplicaGone(f"{method}: send failed")
@@ -272,7 +321,10 @@ class RpcClient:
             raise call.err
         msg = call.msg or {}
         if msg.get("ok"):
-            return msg.get("result")
+            result = msg.get("result")
+            if "_blob" in msg and isinstance(result, dict):
+                result["_blob"] = msg["_blob"]
+            return result
         etype = msg.get("type", "Exception")
         emsg = str(msg.get("error", "remote error"))
         # known in-process exception types cross the wire as themselves
@@ -322,8 +374,10 @@ class ReplicaServicer:
     def handle(self, msg: dict) -> dict:
         seq = msg.get("id")
         try:
-            result = self._dispatch(msg.get("method", ""),
-                                    msg.get("params") or {})
+            params = dict(msg.get("params") or {})
+            if "_blob" in msg:  # incoming binary frame -> verb payload
+                params["_blob"] = msg["_blob"]
+            result = self._dispatch(msg.get("method", ""), params)
             return {"id": seq, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — every error crosses the wire
             return {"id": seq, "ok": False, "error": str(e),
@@ -341,6 +395,10 @@ class ReplicaServicer:
             if msg is None:
                 return
             reply = self.handle(msg)
+            blob = None
+            res = reply.get("result")
+            if isinstance(res, dict) and "_blob" in res:
+                blob = res.pop("_blob")  # rides as a raw frame instead
             stopping = should_stop is not None and should_stop()
             if (stopping and reply.get("ok")
                     and isinstance(reply.get("result"), dict)
@@ -349,7 +407,10 @@ class ReplicaServicer:
                 # drain, not a crash — the handle marks itself retiring
                 # and the router reaps instead of counting a death
                 reply["result"]["drained_out"] = True
-            send_frame(sock, reply)
+            if blob is None:
+                send_frame(sock, reply)
+            else:
+                send_frame_with_blob(sock, reply, blob)
             if msg.get("method") == "shutdown" or stopping:
                 return
 
@@ -365,6 +426,39 @@ class ReplicaServicer:
             if state is not None:
                 out[o.request_id] = state
         return out
+
+    def _kv_for(self, outputs: List[RequestOutput]):
+        """Drain-parked KV payloads for this reply's drain-aborted
+        requests — the block-transfer analog of the RNG piggyback: by
+        the time the router could ask, a drained-out worker has already
+        exited, so the bytes must ride the same reply that carries the
+        structured aborts. One concatenated blob, per-request metas
+        with (off, len) spans, capped at MAX_FRAME per reply (the
+        shipped-batch cap); requests past the cap simply get no payload
+        and fall back to recompute."""
+        export = getattr(self.replica, "export_kv", None)
+        if export is None:
+            return {}, b""
+        metas: Dict[str, dict] = {}
+        chunks: List[bytes] = []
+        off = 0
+        for o in outputs:
+            if o.finish_reason != "aborted:drain" \
+                    or o.request_id in metas:
+                continue
+            res = export(o.request_id)
+            if res is None:
+                continue
+            meta, payload = res
+            if off + len(payload) > MAX_FRAME:
+                continue
+            meta = dict(meta)
+            meta["off"] = off
+            meta["len"] = len(payload)
+            metas[o.request_id] = meta
+            chunks.append(payload)
+            off += len(payload)
+        return metas, b"".join(chunks)
 
     def _dispatch(self, method: str, p: dict) -> Any:
         r = self.replica
@@ -400,15 +494,37 @@ class ReplicaServicer:
             return True
         if method == "step":
             outs = r.step()
-            return {"outputs": [_output_to_wire(o) for o in outs],
-                    "rng": self._rng_for(outs), "alive": bool(r.alive)}
+            return self._step_reply(outs)
         if method == "start_drain":
             outs = r.start_drain(p.get("reason", "manual"))
-            return {"outputs": [_output_to_wire(o) for o in outs],
-                    "rng": self._rng_for(outs), "alive": bool(r.alive)}
+            return self._step_reply(outs)
+        if method == "export_kv":
+            res = r.export_kv(p["request_id"])
+            if res is None:
+                return None
+            meta, payload = res
+            out = dict(meta)
+            out["_blob"] = payload
+            return out
+        if method == "import_kv":
+            return bool(r.import_kv(
+                p["request_id"], [int(t) for t in p["prompt_ids"]],
+                SamplingParams(**p["sampling"]), meta=p["meta"],
+                payload=p.get("_blob", b""),
+                rng_state=p.get("rng_state")))
         if method == "shutdown":
             return True
         raise RpcError(f"unknown method {method!r}")
+
+    def _step_reply(self, outs: List[RequestOutput]) -> dict:
+        res = {"outputs": [_output_to_wire(o) for o in outs],
+               "rng": self._rng_for(outs),
+               "alive": bool(self.replica.alive)}
+        kv, blob = self._kv_for(outs)
+        if kv:
+            res["kv"] = kv
+            res["_blob"] = blob
+        return res
 
 
 class SubprocessReplica(ReplicaHandle):
@@ -427,14 +543,19 @@ class SubprocessReplica(ReplicaHandle):
     self_heartbeat = True
 
     def __init__(self, replica_id: str, client: RpcClient, *,
-                 proc=None, deadlines: Optional[Dict[str, float]] = None):
+                 proc=None, deadlines: Optional[Dict[str, float]] = None,
+                 role: Optional[str] = None):
         self.replica_id = replica_id
         self.retiring = False
         self.created_at = time.monotonic()
+        self.role = role  # "prefill" | "decode" | None (both)
         self._client = client
         self._proc = proc  # subprocess.Popen, or None for loopback
         self._dead = False
         self._rng_cache: Dict[str, dict] = {}
+        # drain-reply KV piggyback cache: (meta, payload) per request,
+        # answering export_kv post-mortem exactly like _rng_cache
+        self._kv_cache: Dict[str, tuple] = {}
         self._deadlines = dict(DEFAULT_DEADLINES)
         if deadlines:
             self._deadlines.update(deadlines)
@@ -531,14 +652,18 @@ class SubprocessReplica(ReplicaHandle):
         return state
 
     # -- mutations (never retried: failure = replica death) ----------------
-    def _mutate(self, method: str, params: dict):
+    def _mutate(self, method: str, params: dict,
+                blob: Optional[bytes] = None):
         """One attempt; a transport failure marks the replica dead and
         returns None. No raise: the router's health sweep re-enqueues
         whatever was assigned here, and the abandoned worker can never
-        emit to the router again — so no duplication either way."""
+        emit to the router again — so no duplication either way.
+        (Clean remote ValueError/KeyError DO propagate: the call
+        executed and failed deterministically — no death.)"""
         try:
             return self._client.call(method, params, idempotent=False,
-                                     deadline_s=self._deadline(method))
+                                     deadline_s=self._deadline(method),
+                                     blob=blob)
         except (RpcTimeout, ReplicaGone, RpcRemoteError, OSError):
             self._dead = True
             return None
@@ -559,8 +684,47 @@ class SubprocessReplica(ReplicaHandle):
 
     def release_request(self, request_id: str) -> None:
         self._rng_cache.pop(request_id, None)
+        self._kv_cache.pop(request_id, None)
         if self.alive:
             self._mutate("release_request", {"request_id": request_id})
+
+    # -- fleet KV-ship -----------------------------------------------------
+    def export_kv(self, request_id: str):
+        """(meta, payload) for the request's committed KV — from the
+        drain-reply piggyback cache first (a drained-out worker is
+        already gone when the router asks), else a live idempotent
+        query carrying the payload back as a raw-bytes frame."""
+        cached = self._kv_cache.get(request_id)
+        if cached is not None:
+            return cached
+        if not self.alive:
+            return None
+        res = self._query("export_kv", {"request_id": request_id})
+        if not isinstance(res, dict) or "_blob" not in res:
+            return None
+        payload = res.pop("_blob")
+        return res, payload
+
+    def import_kv(self, request_id: str, prompt_ids: Sequence[int],
+                  sampling: SamplingParams, *, meta: dict,
+                  payload: bytes, rng_state=None) -> bool:
+        """Ship a KV payload into this replica. One attempt (mutation
+        semantics); a CLEAN remote rejection (checksum/geometry
+        mismatch, cache full, draining) crosses back as ValueError and
+        returns False — the replica stays alive and the router falls
+        back to recompute."""
+        if not self.alive:
+            return False
+        try:
+            return bool(self._mutate("import_kv", {
+                "request_id": request_id,
+                "prompt_ids": [int(t) for t in prompt_ids],
+                "sampling": dataclasses.asdict(sampling),
+                "meta": {k: v for k, v in meta.items()
+                         if k not in ("off", "len")},
+                "rng_state": rng_state}, blob=payload))
+        except ValueError:
+            return False
 
     def _absorb_step_result(self, res) -> List[RequestOutput]:
         if res is None:
@@ -568,11 +732,19 @@ class SubprocessReplica(ReplicaHandle):
         outs = [_output_from_wire(d) for d in res.get("outputs", [])]
         for rid, state in (res.get("rng") or {}).items():
             self._rng_cache[rid] = state
+        blob = res.get("_blob") or b""
+        for rid, meta in (res.get("kv") or {}).items():
+            off = int(meta.get("off", 0))
+            ln = int(meta.get("len", 0))
+            self._kv_cache[rid] = (
+                {k: v for k, v in meta.items() if k not in ("off", "len")},
+                blob[off:off + ln])
         for o in outs:
             if o.finished and o.finish_reason in (
                     "stop", "length", "expired", "rejected",
                     "aborted:user", "aborted:nonfinite"):
                 self._rng_cache.pop(o.request_id, None)  # never handed off
+                self._kv_cache.pop(o.request_id, None)
         if not res.get("alive", True):
             self._dead = True  # remote engine died; aborts are in outs
         if res.get("drained_out"):

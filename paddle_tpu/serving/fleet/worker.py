@@ -13,7 +13,8 @@ Env protocol (set by :class:`ReplicaSupervisor`):
   PADDLE_REPLICA_SPEC   JSON worker spec::
 
         {"model": "tiny_llama" | "pkg.module:factory",
-         "seed": 0, "engine": {...EngineConfig kwargs...}}
+         "seed": 0, "engine": {...EngineConfig kwargs...},
+         "role": "prefill" | "decode" | null}
 
     ``tiny_llama`` builds the deterministic tiny-Llama every fleet
     test uses (``paddle.seed(seed)`` then ``LlamaConfig.tiny()`` — the
@@ -66,9 +67,12 @@ def build_model(spec: dict):
 
 
 def _start_heartbeat(replica_id: str, store_dir: str, interval_s: float,
-                     ttl_s: float) -> threading.Event:
+                     ttl_s: float,
+                     role: str = None) -> threading.Event:
     """Daemon heartbeat thread. Isolated on purpose: it builds its own
-    store/registry and touches nothing the service loop owns."""
+    store/registry and touches nothing the service loop owns. The
+    record's meta carries the worker's disaggregation ``role`` so a
+    restarted router re-learns the fleet topology from the registry."""
     from paddle_tpu.distributed.replica_registry import ReplicaRegistry
     from paddle_tpu.distributed.store import FileStore
 
@@ -78,6 +82,8 @@ def _start_heartbeat(replica_id: str, store_dir: str, interval_s: float,
     def beat():
         reg = ReplicaRegistry(FileStore(store_dir), ttl_s=ttl_s)
         meta = {"pid": pid}
+        if role:
+            meta["role"] = role
         while True:
             try:
                 reg.heartbeat(replica_id, meta=meta)
@@ -112,14 +118,15 @@ def main() -> int:
     model = build_model(spec)
     monitor = PreemptionMonitor()
     monitor.install()
+    role = spec.get("role") or None
     replica = InProcessReplica(
         model, EngineConfig(**spec.get("engine", {})),
-        replica_id=replica_id, monitor=monitor)
+        replica_id=replica_id, monitor=monitor, role=role)
 
     hb_stop = None
     if store_dir:
         hb_stop = _start_heartbeat(replica_id, store_dir, hb_interval,
-                                   ttl_s)
+                                   ttl_s, role=role)
 
     def drained_out() -> bool:
         # SIGTERM path: the drain aborts (with RNG states) went out in

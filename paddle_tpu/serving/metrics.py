@@ -54,7 +54,10 @@ class ServingMetrics:
               # in-graph sampling + speculative decoding (ISSUE 11):
               # draft proposal/acceptance traffic and sampled-step count
               "spec_proposed", "spec_accepted", "spec_acceptance_rate",
-              "sampled_steps")
+              "sampled_steps",
+              # disaggregated serving (ISSUE 13): requests admitted
+              # mid-context with shipped KV instead of recompute
+              "continuation_admits")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -81,6 +84,7 @@ class ServingMetrics:
         "spec_proposed": lambda eng: eng.num_spec_proposed,
         "spec_accepted": lambda eng: eng.num_spec_accepted,
         "sampled_steps": lambda eng: eng.num_sampled_steps,
+        "continuation_admits": lambda eng: eng.num_continuation_admits,
     }
 
     def __init__(self, engine):

@@ -133,6 +133,21 @@ class Scheduler:
         request.status = RequestStatus.WAITING
         self.waiting.append(request)
 
+    def add_continuation(self, request: Request):
+        """Admit a request that ALREADY holds a device table covering
+        ``request.num_cached`` tokens (fleet KV-ship import: the engine
+        claimed the blocks and scattered peer-computed bytes into
+        them). It queues WAITING like any arrival — seats are enforced
+        at admission, and ``abort``/``expire_deadlines`` free blocks on
+        every queue so the held table can't leak — but the mixed
+        scheduler's admission pass recognizes the existing table and
+        skips the fresh ``allocate``, continuing the row mid-context
+        like a chunked-prefill resume. If it is later evicted,
+        ``_evict`` resets ``num_cached`` and frees the imported blocks,
+        so recompute-from-scratch remains the universal fallback."""
+        request.status = RequestStatus.WAITING
+        self.waiting.append(request)
+
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running or self.swapped)
 
@@ -448,6 +463,28 @@ class Scheduler:
             if left <= 0:
                 break
             total = len(req.tokens)
+            if bm.has_table(req.request_id):
+                # fleet KV-ship continuation: its blocks were claimed
+                # and filled at import, so admission is purely a seat +
+                # budget decision; growth past the imported coverage
+                # goes through the ordinary slot claim
+                n = min(total - req.num_cached, left)
+                try:
+                    bm.append_slot(req.request_id, req.num_cached + n,
+                                   write_from=req.num_cached)
+                except NoFreeBlocksError:
+                    break  # blocks free up as running requests finish
+                req.status = RequestStatus.RUNNING
+                admitted.append(req)
+                rows.append(req)
+                nsched.append(n)
+                used += n
+                any_prefill = True
+                if n < total - req.num_cached:
+                    req.was_chunked = True
+                if req.was_chunked:
+                    self.num_prefill_chunks += 1
+                continue
             hit = bm.match_prefix(req.tokens)
             eff = min(hit, total - 1)
             n = min(total - eff, left)

@@ -17,6 +17,11 @@
 #                            draft k=3; asserts acceptance > 0, greedy
 #                            token parity vs the non-spec engine, and
 #                            zero logits fetches; ~1 min)
+#   scripts/ci.sh --disagg   disaggregated serving smoke only (2
+#                            prefill + 2 decode subprocess workers,
+#                            KV-block shipping prefill→decode, a real
+#                            SIGKILL of a decode worker mid-run; token
+#                            parity + ship counters; ~2 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -79,6 +84,18 @@ run_spec() {
 
 if [[ "${1:-}" == "--spec" ]]; then
     run_spec
+    exit 0
+fi
+
+run_disagg() {
+    echo "== disagg smoke =="
+    # 600s: four worker processes each build a model before first ping
+    timeout -k 10 600 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/disagg_smoke.py
+}
+
+if [[ "${1:-}" == "--disagg" ]]; then
+    run_disagg
     exit 0
 fi
 

@@ -190,3 +190,101 @@ def test_hung_worker_detected_by_heartbeat_ttl(tiny_model, tmp_path):
         os.kill(victim.proc.pid, signal.SIGKILL)  # SIGTERM can't land
     finally:
         sup.shutdown()
+
+
+def test_disagg_fleet_sigkill_and_drain_block_transfer(tiny_model,
+                                                       tmp_path):
+    """Disaggregated 2-prefill + 2-decode fleet, real processes.
+
+    Phase 1 — mid-decode SIGKILL of a decode replica: its requests
+    resume by recompute, token streams stay bit-identical, and the
+    supervisor restarts the slot with its sticky ``decode`` role
+    (advertised back through the worker's registry heartbeat meta).
+
+    Phase 2 — SIGTERM drain of a decode replica: the drain reply
+    piggybacks the parked KV, the peer imports it, and the hand-off
+    recomputes ZERO prompt tokens (counter-asserted)."""
+    sup = ReplicaSupervisor(WorkerSpec(model="tiny_llama", seed=0,
+                                       engine=dict(_ENGINE)),
+                            SupervisorConfig(
+                                store_dir=str(tmp_path / "store"),
+                                restart_backoff_s=0.05))
+    handles = [sup.spawn(role="prefill"), sup.spawn(role="prefill"),
+               sup.spawn(role="decode"), sup.spawn(role="decode")]
+    router = FleetRouter(handles, FleetConfig(), registry=sup.registry)
+    sup.router = router
+    try:
+        # -- phase 1: SIGKILL a decode replica mid-decode -------------
+        prompts = _prompts(tiny_model, 6, seed=23)
+        ids = [f"z{i}" for i in range(6)]
+        ref = _reference(tiny_model, prompts, _SP, ids)
+        outs = []
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=_SP)
+        for _ in range(4):
+            outs.extend(router.step())   # prefills shipped, decoding
+        victim = next(h for h in sup.handles() if h.role == "decode")
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        outs += _drain(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert all(final[r].finish_reason == "length" for r in ids)
+        counts = {}
+        for o in outs:
+            if o.token is not None:
+                counts[o.request_id] = counts.get(o.request_id, 0) + 1
+        assert counts == {r: len(ref[r]) for r in ids}
+        assert router.num_kv_ship_requests >= 1
+        assert router.num_replicas_dead == 1
+
+        # the slot restarts with its role intact...
+        deadline = time.monotonic() + 120.0
+        events = []
+        while time.monotonic() < deadline:
+            events += sup.poll()
+            if any(e["event"] == "restarted" for e in events):
+                break
+            time.sleep(0.05)
+        restarted = [e for e in events if e["event"] == "restarted"]
+        assert restarted
+        fresh = next(h for h in sup.handles()
+                     if h.replica_id == restarted[0]["replica_id"])
+        assert fresh.role == "decode"
+        # ...and advertises it through its own heartbeat meta, so a
+        # rebuilt router could re-learn the topology from the registry
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            rec = sup.registry.record(fresh.replica_id)
+            if rec and rec.get("meta", {}).get("role"):
+                break
+            time.sleep(0.05)
+        assert rec["meta"]["role"] == "decode"
+
+        # -- phase 2: SIGTERM drain rides the block-transfer path -----
+        recomputed_before = router.num_tokens_recomputed
+        ships_before = router.num_kv_ship_requests
+        prompts2 = _prompts(tiny_model, 4, seed=29)
+        ids2 = [f"y{i}" for i in range(4)]
+        ref2 = _reference(tiny_model, prompts2, _SP, ids2)
+        outs2 = []
+        for rid, p in zip(ids2, prompts2):
+            router.add_request(rid, p, sampling=_SP)
+        for _ in range(4):
+            outs2.extend(router.step())  # shipped + decoding
+        # SIGTERM whichever decode worker holds requests right now
+        target = next(
+            (h for h in sup.handles()
+             if h.role == "decode" and h.alive
+             and router._assigned.get(h.replica_id)), None)
+        if target is not None:
+            slot_name = target.replica_id.rsplit("-g", 1)[0]
+            sup.stop_worker(slot_name)
+        outs2 += _drain(router)
+        final2 = {o.request_id: o for o in outs2 if o.finished}
+        assert {r: list(final2[r].generated) for r in ids2} == ref2
+        assert all(final2[r].finish_reason == "length" for r in ids2)
+        assert router.num_kv_ship_requests > ships_before
+        # the drain hand-off shipped blocks instead of recomputing
+        assert router.num_tokens_recomputed == recomputed_before
+    finally:
+        sup.shutdown()

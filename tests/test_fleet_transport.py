@@ -418,7 +418,12 @@ class TestLoopbackE2E:
             lbs = [Loopback(InProcessReplica(tiny_model, _ecfg(),
                                              replica_id=f"S{seed}{j}"))
                    for j in range(3)]
-            router = FleetRouter([lb.handle for lb in lbs])
+            # disaggregated roles (third replica serves both) so the
+            # storm exercises prefill->decode ships under fire too
+            router = FleetRouter(
+                [lb.handle for lb in lbs],
+                FleetConfig(roles={f"S{seed}0": "prefill",
+                                   f"S{seed}1": "decode"}))
             for i, (rid, p) in enumerate(zip(ids, prompts)):
                 router.add_request(rid, p, sampling=_sp(i % 2 == 1))
             spec = ";".join([
@@ -430,6 +435,14 @@ class TestLoopbackE2E:
                 f"*{sched.integers(1, 3)}",
                 f"fleet.rpc_delay:sleep:0.01@{sched.integers(1, 20)}"
                 f"*{sched.integers(1, 4)}",
+                # KV-ship chaos: dropped/corrupt ships must degrade to
+                # recompute without duplicating or stranding a request
+                f"fleet.kv_ship_drop:flag@{sched.integers(1, 5)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.kv_ship_corrupt:flag@{sched.integers(1, 5)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.kv_ship_delay:flag:0.005@{sched.integers(1, 8)}"
+                f"*{sched.integers(1, 3)}",
             ])
             faults.install(spec)
             outs = _drain_router(router, max_steps=400)
@@ -458,6 +471,222 @@ class TestLoopbackE2E:
                     bm = lb.inner.engine.block_manager
                     assert bm.num_free_blocks == bm.num_blocks
                     assert bm.num_free_host_blocks == bm.num_host_blocks
+
+
+# ---------------------------------------------------------------------------
+# disaggregated serving: prefill/decode roles + KV-ship (ISSUE 13)
+# ---------------------------------------------------------------------------
+def _disagg_pair(model, seed_prefix="P"):
+    lb_p = Loopback(InProcessReplica(model, _ecfg(),
+                                     replica_id=f"{seed_prefix}pre"))
+    lb_d = Loopback(InProcessReplica(model, _ecfg(),
+                                     replica_id=f"{seed_prefix}dec"))
+    router = FleetRouter(
+        [lb_p.handle, lb_d.handle],
+        FleetConfig(roles={f"{seed_prefix}pre": "prefill",
+                           f"{seed_prefix}dec": "decode"}))
+    return lb_p, lb_d, router
+
+
+def _token_counts(outs):
+    counts = {}
+    for o in outs:
+        if o.token is not None:
+            counts[o.request_id] = counts.get(o.request_id, 0) + 1
+    return counts
+
+
+class TestDisaggKVShip:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_disagg_parity_over_transport(self, tiny_model, sampled):
+        # THE tentpole pin: every request prefills on the prefill-role
+        # replica, its committed KV ships over the wire (binary frame),
+        # and the decode-role replica continues it mid-context — token
+        # streams bit-identical to an uninterrupted single engine,
+        # with ZERO prompt tokens recomputed.
+        sp = _sp(sampled)
+        n = 5
+        prompts = _prompts(tiny_model, n)
+        ids = [f"g{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _disagg_pair(tiny_model, "A")
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert router.num_kv_ship_requests == n
+        assert router.num_kv_ship_bytes > 0
+        assert router.num_kv_ship_blocks > 0
+        assert router.num_tokens_recomputed == 0
+        assert router.num_recompute_fallbacks == 0
+        # ships are planned transfers, not failure hand-offs
+        assert router.num_handoffs == 0
+        assert lb_d.inner.engine.num_continuation_admits == n
+        snap = router.snapshot()
+        assert snap["fleet_kv_ship_requests"] == n
+        assert isinstance(snap["fleet_kv_ship_ms_avg"], float)
+
+    def test_drain_hand_off_ships_blocks_zero_recompute(self,
+                                                        tiny_model):
+        # SIGTERM-drain upgrade: the drain reply piggybacks the parked
+        # KV, the peer imports it, and the hand-off recomputes ZERO
+        # prompt tokens (counter-asserted) — still bit-identical.
+        sp = _sp(True)
+        prompts = _prompts(tiny_model, 4)
+        ids = [f"dr{i}" for i in range(4)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb0 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="B0"))
+        lb1 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                        replica_id="B1"))
+        router = FleetRouter([lb0.handle, lb1.handle])
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        for _ in range(4):
+            router.step()   # everyone well into decode
+        router.retire_replica(lb0.handle)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert router.num_handoffs >= 1
+        assert router.num_kv_ship_requests >= 1
+        assert router.num_tokens_recomputed == 0
+        assert router.num_recompute_fallbacks == 0
+
+    @pytest.mark.parametrize("fault", ["drop", "corrupt"],
+                             ids=["dropped", "corrupt"])
+    def test_kv_ship_fault_falls_back_to_recompute(self, tiny_model,
+                                                   fault):
+        # a dropped ship never reaches the peer; a corrupt one fails
+        # the import-side CRC. Both degrade to resume-by-recompute on
+        # the decode side — bit-identical, never duplicated or lost.
+        sp = _sp(True)
+        n = 4
+        prompts = _prompts(tiny_model, n)
+        ids = [f"f{fault[0]}{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _disagg_pair(tiny_model, fault[0].upper())
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install(f"fleet.kv_ship_{fault}:flag*{n}")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert router.num_recompute_fallbacks == n
+        assert router.num_kv_ship_requests == 0
+        assert router.num_tokens_recomputed > 0
+        assert lb_d.inner.engine.num_continuation_admits == 0
+
+    def test_decode_replica_sigkill_recompute_fallback(self,
+                                                       tiny_model):
+        # crash hand-off: the decode replica dies mid-decode with no
+        # farewell; its requests recover from router-side bookkeeping
+        # by recompute on the surviving decode replica — bit-identical.
+        sp = _sp(True)
+        n = 4
+        prompts = _prompts(tiny_model, n)
+        ids = [f"x{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                         replica_id="Xpre"))
+        lb_d0 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                          replica_id="Xdec0"))
+        lb_d1 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                          replica_id="Xdec1"))
+        router = FleetRouter(
+            [lb_p.handle, lb_d0.handle, lb_d1.handle],
+            FleetConfig(roles={"Xpre": "prefill", "Xdec0": "decode",
+                               "Xdec1": "decode"}))
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install("fleet.worker_kill:flag:Xdec0@4*1")
+        outs = _drain_router(router, max_steps=400)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert not lb_d0.handle.alive
+        assert router.num_replicas_dead == 1
+        assert router.num_kv_ship_requests >= 1
+        # the stranded requests resumed by recompute somewhere
+        assert router.num_tokens_recomputed > 0
+
+    def test_no_decode_peer_keeps_decoding_on_prefill_replica(
+            self, tiny_model):
+        # availability beats purity: a prefill-only fleet never ships
+        # (no peer) and still serves correctly
+        sp = _sp(False)
+        prompts = _prompts(tiny_model, 2)
+        ids = ["np0", "np1"]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                       replica_id="solo"))
+        router = FleetRouter([lb.handle],
+                             FleetConfig(roles={"solo": "prefill"}))
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert router.num_kv_ship_requests == 0
+        assert router.num_recompute_fallbacks == 0
+
+    def test_role_relearned_from_registry_heartbeat(self):
+        # restart story: a rebuilt router attaches role-less handles;
+        # the worker's self-heartbeat meta carries the role and the
+        # next health sweep re-learns it
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0)
+        h = _StubReplica()
+        h.replica_id = "w0-g1"
+        h.self_heartbeat = True
+        h.role = None
+        router = FleetRouter([h], registry=reg)
+        reg.heartbeat("w0-g1", meta={"pid": 1234, "role": "decode"})
+        router.step()
+        assert h.role == "decode"
+        # sticky: later beats without meta must not erase it
+        reg.heartbeat("w0-g1", meta={"pid": 1234})
+        router.step()
+        assert h.role == "decode"
+
+    def test_export_import_content_identical(self, tiny_model):
+        # the shipped bytes land bit-for-bit: gather the source blocks
+        # and the imported blocks off both engines and compare
+        eng_a = InProcessReplica(tiny_model, _ecfg(),
+                                 replica_id="ca").engine
+        eng_b = InProcessReplica(tiny_model, _ecfg(),
+                                 replica_id="cb").engine
+        sp = SamplingParams(max_new_tokens=4)
+        prompt = _prompts(tiny_model, 1)[0] * 3   # multi-block prompt
+        eng_a.add_request("src", prompt, sampling=sp)
+        eng_a.step()   # prefill commits + first token
+        req = eng_a.get_request("src")
+        assert req.num_cached > 0
+        meta, payload = eng_a.export_kv("src")
+        src_table = eng_a.block_manager.export_blocks(
+            "src", meta["tokens_covered"])
+        k_src, v_src = eng_a._swapper.gather(src_table)
+        eng_b.import_kv("dst", list(req.tokens), sampling=sp,
+                        meta=meta, payload=payload)
+        dst_table = eng_b.block_manager.export_blocks(
+            "dst", meta["tokens_covered"])
+        k_dst, v_dst = eng_b._swapper.gather(dst_table)
+        np.testing.assert_array_equal(k_src, k_dst)
+        np.testing.assert_array_equal(v_src, v_dst)
+        bm = eng_b.block_manager
+        for b in dst_table:
+            assert bm.ref_count(b) == 1
+        eng_b.abort_request("dst")
+        eng_b.release_request("dst")
+        eng_a.abort_request("src")
+        eng_a.release_request("src")
+        for eng in (eng_a, eng_b):
+            bm = eng.block_manager
+            bm.check_invariants()
+            assert bm.num_free_blocks == bm.num_blocks
 
 
 # ---------------------------------------------------------------------------

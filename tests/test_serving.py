@@ -594,3 +594,154 @@ def test_prefix_cache_cow_refcount_randomized_storm():
     # the storm actually exercised the machinery it pins
     assert bm.num_prefix_hits > 0, "no admission ever shared a prefix"
     assert bm.num_cow_copies > 0, "no write ever copy-on-wrote"
+
+
+# ---------------------------------------------------------------------------
+# fleet KV-ship: export_blocks / import_blocks (ISSUE 13)
+# ---------------------------------------------------------------------------
+def test_block_manager_export_import_basics():
+    bm = BlockManager(num_blocks=8, block_size=4)
+    bm.allocate("src", 10)                      # 3 blocks
+    # export is read-only: leading blocks, no accounting change
+    free_before = bm.num_free_blocks
+    exported = bm.export_blocks("src", 7)       # 2 blocks cover 7
+    assert exported == bm.block_table("src")[:2]
+    assert bm.num_free_blocks == free_before
+    bm.check_invariants()
+    with pytest.raises(KeyError):
+        bm.export_blocks("nope", 4)
+    with pytest.raises(ValueError):
+        bm.export_blocks("src", 999)            # table too short
+    # import claims fresh refcount-1 unregistered blocks
+    table = bm.import_blocks("dst", 7)
+    assert len(table) == 2 and bm.has_table("dst")
+    assert all(bm.ref_count(b) == 1 for b in table)
+    assert set(table).isdisjoint(exported)
+    bm.check_invariants()
+    with pytest.raises(ValueError):
+        bm.import_blocks("dst", 4)              # table already exists
+    with pytest.raises(NoFreeBlocksError):
+        bm.import_blocks("big", 100)
+    bm.free("src")
+    bm.free("dst")
+    bm.check_invariants()
+    assert bm.num_free_blocks == bm.num_blocks
+
+
+def test_export_import_interleaved_with_cow_swap_storm():
+    """ISSUE-13 satellite: the COW/refcount/swap storm with randomized
+    export/import interleaved. Exports must be pure reads; imported
+    tables join the same lifecycle (growth, COW via prefix commits,
+    swap, abort) and the exact-accounting invariants hold after every
+    operation; at the end both free lists return to full and the trie
+    bijection (checked inside ``check_invariants``) survives."""
+    rng = np.random.default_rng(13)
+    bm = BlockManager(num_blocks=24, block_size=4, num_host_blocks=8,
+                      enable_prefix_cache=True)
+    stems = [list(map(int, rng.integers(0, 40, size=16)))
+             for _ in range(3)]
+    pool = [stem[:k] + list(map(int, rng.integers(40, 80, size=t)))
+            for stem in stems
+            for (k, t) in ((16, 3), (16, 6), (12, 5), (8, 0))]
+    live = {}
+    swapped = {}
+    n_exports = n_imports = 0
+
+    def drain_cow():
+        for src, dst in bm.take_cow_pairs():
+            assert src != dst
+            assert bm.ref_count(dst) >= 1
+
+    def pick(d):
+        return list(d)[int(rng.integers(0, len(d)))]
+
+    for it in range(1500):
+        op = int(rng.integers(0, 7))
+        if op == 0:  # admit, scheduler-shaped
+            rid = f"s{it}"
+            tokens = list(pool[int(rng.integers(0, len(pool)))])
+            total = len(tokens)
+            hit = bm.match_prefix(tokens)
+            eff = min(hit, total - 1)
+            n = int(rng.integers(1, total - eff + 1))
+            try:
+                bm.allocate(rid, eff + n, tokens=tokens)
+            except NoFreeBlocksError:
+                bm.check_invariants()
+                continue
+            covered = bm.last_hit_tokens + n
+            live[rid] = {"tokens": tokens, "covered": covered,
+                         "target": total + int(rng.integers(1, 6))}
+            bm.commit_prefix(rid, tokens, covered)
+        elif op == 1 and live:  # grow
+            rid = pick(live)
+            st = live[rid]
+            if st["covered"] >= st["target"]:
+                bm.free(rid)
+                live.pop(rid)
+            else:
+                remaining = len(st["tokens"]) - st["covered"]
+                n = (int(rng.integers(1, remaining + 1))
+                     if remaining > 0 else 1)
+                try:
+                    bm.append_slot(rid, st["covered"] + n,
+                                   write_from=st["covered"])
+                except NoFreeBlocksError:
+                    bm.check_invariants()
+                    continue
+                st["covered"] += n
+                bm.commit_prefix(rid, st["tokens"], st["covered"])
+        elif op == 2 and live:  # abort/finish
+            rid = pick(live)
+            bm.free(rid)
+            live.pop(rid)
+        elif op == 3 and live:  # swap out
+            rid = pick(live)
+            if bm.can_swap_out(rid, live[rid]["covered"]):
+                bm.swap_out(rid, live[rid]["covered"])
+                swapped[rid] = live.pop(rid)
+        elif op == 4 and swapped:  # swap in / abort-while-swapped
+            rid = pick(swapped)
+            if rng.random() < 0.25:
+                bm.free(rid)
+                swapped.pop(rid)
+            elif bm.can_swap_in(rid):
+                bm.swap_in(rid)
+                live[rid] = swapped.pop(rid)
+        elif op == 5 and live:  # export: a pure read
+            rid = pick(live)
+            covered = live[rid]["covered"]
+            if covered > 0:
+                free_before = bm.num_free_blocks
+                table = bm.export_blocks(rid, covered)
+                assert table == bm.block_table(rid)[:len(table)]
+                assert bm.num_free_blocks == free_before
+                n_exports += 1
+        elif op == 6:  # import: fresh blocks enter the lifecycle
+            rid = f"i{it}"
+            tokens = list(pool[int(rng.integers(0, len(pool)))])
+            covered = int(rng.integers(1, len(tokens)))
+            try:
+                table = bm.import_blocks(rid, covered)
+            except NoFreeBlocksError:
+                bm.check_invariants()
+                continue
+            assert all(bm.ref_count(b) == 1 for b in table)
+            live[rid] = {"tokens": tokens, "covered": covered,
+                         "target": len(tokens)
+                         + int(rng.integers(1, 6))}
+            # the engine registers imported full blocks in the trie
+            # (peers can prefix-hit onto shipped KV)
+            bm.commit_prefix(rid, tokens, covered)
+            n_imports += 1
+        drain_cow()
+        bm.check_invariants()
+    for rid in list(live) + list(swapped):
+        bm.free(rid)
+    drain_cow()
+    bm.check_invariants()
+    assert bm.num_free_blocks == bm.num_blocks
+    assert bm.num_free_host_blocks == bm.num_host_blocks
+    assert n_exports > 0, "storm never exported"
+    assert n_imports > 0, "storm never imported"
+    assert bm.num_cow_copies > 0, "no write ever copy-on-wrote"
