@@ -495,6 +495,184 @@ def _fleet_model_cfg(tiny):
         num_key_value_heads=8, max_position_embeddings=1024)
 
 
+def _fleet_prefix_workload(model, cfg, make_ecfg, replicas, seed):
+    """Multi-tenant shared-prefix serving through the fleet: four
+    tenants behind one shared system header (4 blocks) with per-tenant
+    headers (2 blocks) and FIXED-length tails, submitted in waves so
+    advertisements exist before later dispatches. The identical
+    workload runs twice — prefix-affine routing vs load-only — and the
+    comparison reports the fleet-wide hit rate
+    (``prefix_cache_hit_tokens / prompt_tokens``) over the wave
+    window, plus client-side TTFT from SERIAL probes after the waves —
+    one request in flight at a time, identical prompt length, the only
+    difference being whether the prefix is cached where the request
+    lands. (Wave-level TTFT would confound the comparison: affinity
+    concentrates a wave onto one replica, whose admission budget then
+    serializes it.) Greedy decoding, so both modes must emit
+    bit-identical tokens — routing policy may move work, never change
+    it."""
+    import numpy as np
+
+    from paddle_tpu.serving import SamplingParams
+    from paddle_tpu.serving.fleet import (
+        FleetConfig, FleetRouter, InProcessReplica,
+    )
+
+    rng = np.random.RandomState(seed + 7)
+    bs = make_ecfg().block_size
+    tail_len = 8
+    system = list(map(int, rng.randint(1, cfg.vocab_size, size=4 * bs)))
+    tenants = {f"tenant{k}": list(map(int, rng.randint(
+        1, cfg.vocab_size, size=2 * bs))) for k in range(4)}
+    plen = len(system) + 2 * bs + tail_len
+    # waves of 6 random-tenant requests: load-only's round-robin
+    # placement can't accidentally track tenant->home-replica affinity
+    names = sorted(tenants)
+    waves = []
+    for _ in range(3):
+        wave = []
+        for _ in range(6):
+            t = names[int(rng.randint(0, len(names)))]
+            tail = list(map(int, rng.randint(1, cfg.vocab_size,
+                                             size=tail_len)))
+            wave.append((t, system + tenants[t] + tail))
+        waves.append(wave)
+    # waves OVERLAP in flight (a wave is submitted while the previous
+    # one still decodes), so load-only routing genuinely balances by
+    # occupancy instead of degenerating to always-min-id on an idle
+    # fleet; seats cover two waves so affinity's concentration is
+    # never forced to spill for seats
+    seats = 2 * len(waves[0])
+    warm_prompts = [list(map(int, rng.randint(1, cfg.vocab_size,
+                                              size=plen)))
+                    for _ in range(replicas * seats)]
+    # serial TTFT probes: repeats of wave prompts (cache-hit path) vs
+    # fresh never-seen prompts of the SAME length (cold path)
+    hit_probes = [waves[-1][j] for j in range(3)]
+    cold_probes = [
+        (f"probe{j}", list(map(int, rng.randint(1, cfg.vocab_size,
+                                                size=plen))))
+        for j in range(3)]
+
+    def run(fleet_cfg):
+        # a bounded per-step token budget (4 blocks) makes prefill cost
+        # proportional to COMPUTED tokens: a cold prompt chunks over
+        # ceil(plen/budget) ragged steps while a deep prefix hit
+        # prefills its short suffix in one — without this the fixed
+        # ragged shape makes cold and hit prefills cost the same step
+        router = FleetRouter(
+            [InProcessReplica(model,
+                              make_ecfg(max_num_seqs=seats,
+                                        max_batched_tokens=4 * bs),
+                              replica_id=f"x{i}")
+             for i in range(replicas)], fleet_cfg)
+        # compile-only warmup: unrelated prompts of the same bucketed
+        # shapes, run TWICE — the repeat prefix-hits its own first
+        # pass, so the batched cache-hit prefill shapes compile here
+        for _ in range(2):
+            for p in warm_prompts:
+                router.add_request(p, sampling=SamplingParams(
+                    max_new_tokens=tail_len))
+            while router.has_unfinished():
+                router.step()
+        # single-row warmup directly on EVERY engine: one serial
+        # prefill and its repeat (which prefix-hits), so the probe
+        # phase never measures compilation on either replica
+        for i, h in enumerate(router.replicas):
+            p = list(map(int, rng.randint(1, cfg.vocab_size,
+                                          size=plen)))
+            for k in range(2):
+                h.engine.add_request(f"sw{i}-{k}", p,
+                                     sampling=SamplingParams(
+                                         max_new_tokens=tail_len))
+                while h.engine.has_unfinished():
+                    h.engine.step()
+        base_hit = sum(h.engine.block_manager.num_prefix_hit_tokens
+                       for h in router.replicas)
+        base_computed = sum(h.engine.metrics.num_prompt_tokens
+                            for h in router.replicas)
+        t_sub, ttft = {}, {}
+
+        def cb(rid, token, finished):
+            if rid not in ttft:
+                ttft[rid] = time.perf_counter() - t_sub[rid]
+
+        gen = {}
+        all_ids = []
+        for w, wave in enumerate(waves):
+            for j, (t, p) in enumerate(wave):
+                rid = f"w{w}-{j}"
+                all_ids.append(rid)
+                router.add_request(rid, p, sampling=SamplingParams(
+                    max_new_tokens=tail_len, tenant_id=t))
+            if w + 1 < len(waves):
+                # a few steps, NOT a drain: the next wave arrives while
+                # this one still decodes (prefill is done, so its
+                # prefixes are committed and advertised)
+                for _ in range(12):
+                    router.step()
+        while router.has_unfinished():
+            router.step()
+        for rid in all_ids:
+            gen[rid] = list(router.release_request(rid).generated)
+        # hit rate over the wave window only (warmup repeats
+        # prefix-hit their own first pass by design)
+        hit = sum(h.engine.block_manager.num_prefix_hit_tokens
+                  for h in router.replicas) - base_hit
+        computed = sum(h.engine.metrics.num_prompt_tokens
+                       for h in router.replicas) - base_computed
+        # serial TTFT probes: one request in flight at a time, so the
+        # cold/hit difference is cached-vs-computed prefill and
+        # nothing else. Ships are off during probes — a mid-probe
+        # ship would bill its one-time gather/scatter compile to
+        # whichever probe it interrupted
+        router.cfg.prefix_ship = False
+        probe_ms = {}
+        for kind, plist in (("cold", cold_probes), ("hit", hit_probes)):
+            ts = []
+            for j, (t, p) in enumerate(plist):
+                rid = f"{kind}-{j}"
+                t_sub[rid] = time.perf_counter()
+                router.add_request(rid, p, sampling=SamplingParams(
+                    max_new_tokens=tail_len, tenant_id=t), callback=cb)
+                while router.has_unfinished():
+                    router.step()
+                gen[rid] = list(router.release_request(rid).generated)
+                ts.append(ttft[rid])
+            probe_ms[kind] = round(1e3 * sum(ts) / len(ts), 3)
+        snap = router.snapshot()
+        return gen, {
+            "fleet_prefix_hit_rate": round(hit / (hit + computed), 4)
+                if hit + computed else 0.0,
+            "ttft_cold_ms": probe_ms["cold"],
+            "ttft_hit_ms": probe_ms["hit"],
+            "prefix_affine_dispatches":
+                snap["fleet_prefix_affine_dispatches"],
+            "prefix_ships": snap["fleet_prefix_ships"],
+            "prefix_ship_bytes": snap["fleet_prefix_ship_bytes"],
+            "prefix_hit_tokens_advertised":
+                snap["fleet_prefix_hit_tokens"],
+        }
+
+    gen_a, affine = run(FleetConfig(prefix_ship_threshold=2))
+    gen_l, load_only = run(FleetConfig(prefix_affinity=False,
+                                       prefix_ship=False))
+    assert gen_a == gen_l, "routing policy changed tokens"
+    # the acceptance pins: affinity strictly beats load-only on fleet
+    # hit rate, and cache-hit TTFT beats cold TTFT at equal length
+    assert (affine["fleet_prefix_hit_rate"]
+            > load_only["fleet_prefix_hit_rate"]), (affine, load_only)
+    assert affine["ttft_hit_ms"] < affine["ttft_cold_ms"], affine
+    return {
+        "prompt_len": plen,
+        "shared_tokens": len(system),
+        "tenant_tokens": 2 * bs,
+        "n_requests": sum(len(w) for w in waves),
+        "affine": affine,
+        "load_only": load_only,
+    }
+
+
 def _worker_model_small(spec):
     """WorkerSpec factory (``model="bench:_worker_model_small"``) so
     subprocess bench workers build the exact gpt-small twin of the
@@ -554,9 +732,10 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
     model.eval()
 
     def ecfg(**kw):
-        return EngineConfig(
-            max_num_seqs=max_num_seqs,
-            max_model_len=min(cfg.max_position_embeddings, 1024), **kw)
+        kw.setdefault("max_num_seqs", max_num_seqs)
+        kw.setdefault("max_model_len",
+                      min(cfg.max_position_embeddings, 1024))
+        return EngineConfig(**kw)
 
     n_pre = max(1, replicas // 2) if disagg else 0
     roles = ({f"r{i}": ("prefill" if i < n_pre else "decode")
@@ -714,6 +893,14 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
         finally:
             sup.shutdown()
 
+    # fleet-global prefix cache: the multi-tenant shared-prefix
+    # comparison (prefix-affine vs load-only routing) — the numbers
+    # BENCH_serving_r07 records
+    prefix_extra = None
+    if not disagg:
+        prefix_extra = _fleet_prefix_workload(model, cfg, ecfg,
+                                              replicas, seed)
+
     disagg_extra = None
     if disagg:
         disagg_extra = {
@@ -751,6 +938,7 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
             "wall_s": round(dt, 3),
             **{k: v for k, v in snap.items() if k != "replicas"},
             "resilience_smoke": resilience,
+            **({"prefix": prefix_extra} if prefix_extra else {}),
             **({"disagg": disagg_extra} if disagg_extra else {}),
             **({"subprocess": sub} if sub is not None else {}),
         },

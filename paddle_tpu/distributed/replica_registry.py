@@ -175,3 +175,16 @@ class ReplicaRegistry:
         if rec is None:
             return False
         return self._fresh(replica_id, rec, now)
+
+    def age_s(self, replica_id: str) -> Optional[float]:
+        """Seconds (on the READER's monotonic clock) since this
+        member's record last changed — the staleness basis for decaying
+        heartbeat-carried metadata like prefix advertisements. None
+        before the reader has ever observed the member (callers treat
+        unknown as fully stale). Reads only the observation table
+        :meth:`_fresh` maintains, so call it after an ``alive()``
+        sweep."""
+        prev = self._obs.get(replica_id)
+        if prev is None:
+            return None
+        return max(0.0, self._mono() - prev[1])

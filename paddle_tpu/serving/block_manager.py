@@ -35,12 +35,13 @@ for the host pool, and ``free()`` releases BOTH sides, so no lifecycle
 path (abort while swapped included) can leak."""
 from __future__ import annotations
 
+import hashlib
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.testing import faults
 
-__all__ = ["BlockManager", "NoFreeBlocksError"]
+__all__ = ["BlockManager", "NoFreeBlocksError", "prefix_chain_hashes"]
 
 
 class NoFreeBlocksError(RuntimeError):
@@ -50,6 +51,35 @@ class NoFreeBlocksError(RuntimeError):
 
 def cdiv(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def _fold_hash(parent_hex: Optional[str],
+               block_tokens: Sequence[int]) -> str:
+    """Fold one full block of tokens into the content chain hash. The
+    chain mirrors the trie key structure ((key_{i-1}, block_i_tokens)),
+    so equal hashes imply (modulo blake2b collision) the entire prefix
+    matches — and a collision can at worst misroute or waste a ship,
+    never corrupt: the trie itself is keyed by actual token content."""
+    base = (parent_hex or "").encode()
+    body = ",".join(str(int(t)) for t in block_tokens).encode()
+    return hashlib.blake2b(base + b"|" + body,
+                           digest_size=8).hexdigest()
+
+
+def prefix_chain_hashes(tokens: Sequence[int],
+                        block_size: int) -> List[str]:
+    """Chain hash for every FULL-block prefix of ``tokens``:
+    ``hashes[i]`` identifies ``tokens[:(i + 1) * block_size]``. This is
+    the router-side mirror of the hashes a BlockManager advertises, so
+    the two sides agree without sharing any state but the tokens."""
+    out: List[str] = []
+    h: Optional[str] = None
+    i = 0
+    while (i + 1) * block_size <= len(tokens):
+        h = _fold_hash(h, tokens[i * block_size:(i + 1) * block_size])
+        out.append(h)
+        i += 1
+    return out
 
 
 class BlockManager:
@@ -76,6 +106,18 @@ class BlockManager:
         # cached-free block keeps its registration until reclaimed.
         self._prefix_index: Dict[tuple, int] = {}
         self._block_key: Dict[int, tuple] = {}
+        # fleet advertisement layer: every registered chain key also
+        # carries a content chain HASH (stable across processes, unlike
+        # the tuple key which is only meaningful locally). `_hash_key`
+        # is the inverse used to resolve an incoming ship/export request
+        # by hash; `_hash_tokens` caches covered-token counts for the
+        # digest. `_trie_rev` bumps on any registration change so the
+        # heartbeat-rate digest is computed at most once per change.
+        self._key_hash: Dict[tuple, str] = {}
+        self._hash_key: Dict[str, tuple] = {}
+        self._hash_tokens: Dict[str, int] = {}
+        self._trie_rev = 0
+        self._digest_cache: Optional[Tuple[tuple, dict]] = None
         self._cow_pairs: List[Tuple[int, int]] = []
         # observability (engine surfaces these through ServingMetrics)
         self.num_prefix_hits = 0
@@ -140,6 +182,11 @@ class BlockManager:
         key = self._block_key.pop(b, None)
         if key is not None and self._prefix_index.get(key) == b:
             self._prefix_index.pop(key)
+            h = self._key_hash.pop(key, None)
+            if h is not None and self._hash_key.get(h) == key:
+                self._hash_key.pop(h)
+                self._hash_tokens.pop(h, None)
+            self._trie_rev += 1
         self._refs[b] = 1
         return b
 
@@ -194,9 +241,12 @@ class BlockManager:
         bs = self.block_size
         limit = min(covered, len(tokens))
         key: Optional[tuple] = None
+        chash: Optional[str] = None
         idx = 0
         while (idx + 1) * bs <= limit:
-            key = (key, tuple(tokens[idx * bs:(idx + 1) * bs]))
+            part = tuple(tokens[idx * bs:(idx + 1) * bs])
+            key = (key, part)
+            chash = _fold_hash(chash, part)
             b = table[idx]
             if key in self._prefix_index:
                 # someone committed this prefix first; keep their block
@@ -205,7 +255,70 @@ class BlockManager:
             if b not in self._block_key:
                 self._prefix_index[key] = b
                 self._block_key[b] = key
+                self._key_hash[key] = chash
+                self._hash_key[chash] = key
+                self._hash_tokens[chash] = (idx + 1) * bs
+                self._trie_rev += 1
             idx += 1
+
+    # -- fleet prefix advertisement ---------------------------------------
+    @property
+    def num_uncached_free_blocks(self) -> int:
+        """Free blocks holding NO registered prefix content — the room a
+        proactive prefix import may consume without evicting anything
+        the cache already holds."""
+        return sum(1 for b in self._free if b not in self._block_key)
+
+    def prefix_digest(self, max_entries: int = 128) -> dict:
+        """Bounded advertisement of the committed prefix trie, shaped
+        for heartbeat meta: ``{"bs": block_size, "n": total_entries,
+        "h": {chain_hash: covered_tokens}}``. Entries are kept
+        SHALLOW-first (fewest covered tokens) when capped — shallow
+        chains (shared system prompts) are the broadly useful ones, and
+        keeping every ancestor of a kept entry means a router walking
+        the chain front-to-back never breaks early on a capped-out
+        middle link. Cached per trie revision, so heartbeat-rate calls
+        are O(1) between registration changes."""
+        ck = (self._trie_rev, int(max_entries))
+        if self._digest_cache is not None \
+                and self._digest_cache[0] == ck:
+            return self._digest_cache[1]
+        items = sorted(self._hash_tokens.items(),
+                       key=lambda kv: (kv[1], kv[0]))
+        digest = {"bs": self.block_size, "n": len(items),
+                  "h": dict(items[:max_entries])}
+        self._digest_cache = (ck, digest)
+        return digest
+
+    def prefix_blocks_by_hash(
+            self, chain_hash: str,
+    ) -> Optional[Tuple[List[int], List[int]]]:
+        """Resolve an advertised chain hash back to ``(tokens,
+        blocks)`` — the full token content and the device blocks of the
+        registered chain it names. Returns None when the hash is
+        unknown or any link of the chain has since been evicted (the
+        caller treats that as a plain miss; advertisement staleness is
+        expected, never an error). Read-only."""
+        key = self._hash_key.get(chain_hash)
+        if key is None:
+            return None
+        parts: List[tuple] = []
+        k: Optional[tuple] = key
+        while k is not None:
+            k, part = k
+            parts.append(part)
+        parts.reverse()
+        tokens: List[int] = []
+        blocks: List[int] = []
+        k = None
+        for part in parts:
+            k = (k, part)
+            b = self._prefix_index.get(k)
+            if b is None:
+                return None   # ancestor evicted since registration
+            blocks.append(b)
+            tokens.extend(part)
+        return tokens, blocks
 
     # -- allocation ------------------------------------------------------
     def allocate(self, request_id: str, num_tokens: int,
@@ -504,6 +617,15 @@ class BlockManager:
         for key, b in self._prefix_index.items():
             assert self._block_key.get(b) == key, \
                 f"trie drift: block {b} does not map back to its key"
+        # advertisement maps ride the trie exactly: every registered key
+        # has a hash, every hash maps back, token counts track hashes
+        assert set(self._key_hash) == set(self._prefix_index), \
+            "key-hash map drifted from the prefix index"
+        for h, k in self._hash_key.items():
+            assert self._key_hash.get(k) == h, \
+                f"hash map drift: {h} does not map back to its key"
+        assert set(self._hash_tokens) == set(self._hash_key), \
+            "hash token-count map drifted from the hash map"
         assert not self._cow_pairs, \
             "pending COW pairs not drained before invariant check"
         # host pool: same exact accounting, plus refcount consistency

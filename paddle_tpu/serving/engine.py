@@ -573,6 +573,12 @@ class LLMEngine:
         # requests admitted mid-context with peer-computed KV (fleet
         # KV-ship import side; serving/continuation_admits gauge)
         self.num_continuation_admits = 0
+        # proactive prefix ships (no request attached): whole cached
+        # prefixes exported to / imported from peer replicas
+        # (serving/prefix_{exports,imports} gauges)
+        self.num_prefix_exports = 0
+        self.num_prefix_imports = 0
+        self._prefix_import_seq = itertools.count()
         # drain-parked KV snapshots: request_id -> (covered tokens,
         # device table) captured the instant a drain sweep aborts a
         # running request. The blocks go back to the free list with the
@@ -827,6 +833,120 @@ class LLMEngine:
                                              covered)
         self.num_continuation_admits += 1
         return request_id
+
+    # -- fleet prefix cache ----------------------------------------------
+    def prefix_digest(self) -> Optional[dict]:
+        """Bounded advertisement of this engine's committed prefix trie
+        (chain hashes + covered token counts) for heartbeat meta; None
+        when prefix caching is off. Read-only, cached per trie change."""
+        if not self.cfg.prefix_cache:
+            return None
+        return self.block_manager.prefix_digest()
+
+    def export_prefix(self, chain_hash: str):
+        """Package one advertised cached prefix for a proactive fleet
+        ship: ``(meta, payload)`` exactly like :meth:`export_kv` but
+        addressed by content chain hash instead of request id, with the
+        full token content in the meta (the importer commits by token
+        content, so a hash collision can only waste a ship, never
+        corrupt). Returns ``None`` when the hash is unknown or its
+        chain was partially evicted since advertisement — staleness is
+        a miss, not an error. Read-only and idempotent (RPC-retryable)."""
+        if not self.cfg.prefix_cache:
+            return None
+        resolved = self.block_manager.prefix_blocks_by_hash(chain_hash)
+        if resolved is None:
+            return None
+        tokens, table = resolved
+        k_np, v_np = self._swapper.gather(table)
+        k_bytes = k_np.tobytes()
+        payload = k_bytes + v_np.tobytes()
+        meta = {
+            "chain_hash": chain_hash,
+            "tokens": [int(t) for t in tokens],
+            "blocks": len(table),
+            "block_size": int(self.cfg.block_size),
+            "shape": list(k_np.shape),
+            "dtype": str(k_np.dtype),
+            "k_bytes": len(k_bytes),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        }
+        self.num_prefix_exports += 1
+        return meta, payload
+
+    def import_prefix(self, *, meta: dict, payload: bytes) -> int:
+        """Commit a shipped prefix into the local trie with NO request
+        attached: claim fresh blocks under a synthetic id, scatter the
+        bytes, register them by token content, then free the synthetic
+        id — the blocks land cached-free at the cold end of the free
+        list, refcounted and evictable exactly like locally computed
+        prefixes. Returns the token count committed; 0 when the prefix
+        is already cached at least as deep (idempotent under RPC
+        retry). Clean rejections raise ``ValueError`` (never replica
+        death): geometry/checksum mismatch, draining, or a pool whose
+        free headroom is all REGISTERED content — a proactive ship must
+        never evict resident cache to make room for speculative bytes."""
+        if not self.cfg.prefix_cache:
+            raise ValueError("prefix import needs prefix caching on")
+        if self._draining:
+            raise ValueError("engine is draining")
+        tokens = [int(t) for t in meta.get("tokens", ())]
+        covered = len(tokens)
+        bs = self.cfg.block_size
+        if int(meta.get("block_size", -1)) != bs:
+            raise ValueError(
+                f"shipped prefix block_size {meta.get('block_size')} "
+                f"!= {bs}")
+        if covered <= 0 or covered % bs != 0:
+            raise ValueError(
+                f"shipped prefix covers {covered} tokens — must be a "
+                f"positive multiple of block_size {bs}")
+        nblocks = covered // bs
+        L, _, BS, KH, D = self._kcs.shape
+        want_shape = [L, nblocks, BS, KH, D]
+        if list(meta.get("shape", ())) != want_shape or \
+                int(meta.get("blocks", -1)) != nblocks:
+            raise ValueError(
+                f"shipped prefix KV shape {meta.get('shape')} != "
+                f"expected {want_shape}")
+        if str(meta.get("dtype")) != str(self._kcs.dtype):
+            raise ValueError(
+                f"shipped prefix dtype {meta.get('dtype')} != cache "
+                f"dtype {self._kcs.dtype}")
+        dtype = np.dtype(str(meta["dtype"]))
+        k_bytes = int(meta.get("k_bytes", -1))
+        want_bytes = int(np.prod(want_shape)) * dtype.itemsize
+        if k_bytes != want_bytes or len(payload) != 2 * want_bytes:
+            raise ValueError(
+                f"shipped prefix payload {len(payload)}B "
+                f"(k={k_bytes}) != 2x{want_bytes}B")
+        if zlib.crc32(payload) & 0xFFFFFFFF != int(meta.get("crc32", -1)):
+            raise ValueError(
+                "shipped prefix failed its checksum — payload corrupt, "
+                "refusing the import")
+        if self.block_manager.match_prefix(tokens) >= covered:
+            return 0
+        if nblocks > self.block_manager.num_uncached_free_blocks:
+            raise ValueError(
+                f"{nblocks} block(s) needed for a proactive prefix "
+                f"import, only "
+                f"{self.block_manager.num_uncached_free_blocks} "
+                f"uncached-free — refusing to evict resident cache")
+        rid = f"__prefix_import__{next(self._prefix_import_seq)}"
+        try:
+            table = self.block_manager.import_blocks(rid, covered)
+        except NoFreeBlocksError as e:
+            raise ValueError(str(e)) from e
+        k_np = np.frombuffer(payload, dtype=dtype,
+                             count=want_bytes // dtype.itemsize)
+        v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
+                             count=want_bytes // dtype.itemsize)
+        self._swapper.scatter(table, k_np.reshape(want_shape),
+                              v_np.reshape(want_shape))
+        self.block_manager.commit_prefix(rid, tokens, covered)
+        self.block_manager.free(rid)
+        self.num_prefix_imports += 1
+        return covered
 
     def _count_finish(self, reason: Optional[str]):
         if reason is not None:

@@ -33,7 +33,10 @@ class FleetMetrics:
               "autoscale_decisions", "tokens_emitted",
               "kv_ship_requests", "kv_ship_blocks", "kv_ship_bytes",
               "kv_ship_ms_avg", "recompute_fallbacks",
-              "tokens_recomputed")
+              "tokens_recomputed", "prefix_hit_tokens",
+              "prefix_affine_dispatches", "prefix_ships",
+              "prefix_ship_bytes", "prefix_ship_failures",
+              "kv_snapshot_skipped")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
@@ -56,6 +59,19 @@ class FleetMetrics:
             if r.num_kv_ship_requests else 0.0,
         "recompute_fallbacks": lambda r: r.num_recompute_fallbacks,
         "tokens_recomputed": lambda r: r.num_tokens_recomputed,
+        # fleet-global prefix cache (router side: advert-credited
+        # dispatches and proactive ships)
+        "prefix_hit_tokens": lambda r: r.num_prefix_hit_tokens,
+        "prefix_affine_dispatches":
+            lambda r: r.num_prefix_affine_dispatches,
+        "prefix_ships": lambda r: r.num_prefix_ships,
+        "prefix_ship_bytes": lambda r: r.num_prefix_ship_bytes,
+        "prefix_ship_failures": lambda r: r.num_prefix_ship_failures,
+        # drain KV snapshots dropped at the frame cap, summed over
+        # worker-backed handles (the PR 12 silent-skip, now counted)
+        "kv_snapshot_skipped": lambda r: sum(
+            getattr(h, "num_kv_snapshot_skipped", 0)
+            for h in r.replicas),
     }
 
     def __init__(self, router):
@@ -103,6 +119,15 @@ class FleetMetrics:
                     pass  # a dead handle's snapshot is best-effort
             replicas[h.replica_id] = rec
         out["replicas"] = replicas
+        # fleet-wide prefix-cache hit rate: engine-counted hit tokens
+        # over ALL submitted prompt tokens (num_prompt_tokens counts
+        # only COMPUTED prompt tokens, so submitted = hit + computed)
+        hit = sum(int(rec.get("serving_prefix_cache_hit_tokens", 0))
+                  for rec in replicas.values())
+        computed = sum(int(rec.get("num_prompt_tokens", 0))
+                       for rec in replicas.values())
+        out["fleet_prefix_hit_rate"] = round(
+            hit / (hit + computed), 4) if hit + computed else 0.0
         return out
 
     # -- profiler counter providers --------------------------------------

@@ -125,6 +125,26 @@ class ReplicaHandle:
         rejection (the router falls back to recompute)."""
         return False
 
+    # -- fleet prefix cache (optional capability; default: none) ----------
+    def prefix_digest(self) -> Optional[dict]:
+        """Bounded advertisement of the replica's committed prefix trie
+        ({"bs", "n", "h": {chain_hash: tokens}}), or None when the
+        replica cannot advertise — the router then treats it as cold."""
+        return None
+
+    def export_prefix(self, chain_hash: str):
+        """(meta dict, payload bytes) packaging one advertised cached
+        prefix, or None when the hash is no longer resolvable (evicted
+        since advertisement — the router just drops the ship)."""
+        return None
+
+    def import_prefix(self, *, meta: dict, payload: bytes) -> bool:
+        """Commit a shipped prefix into the local cache with no request
+        attached; False on any clean rejection (no room without
+        eviction, geometry/checksum mismatch — the ship is dropped,
+        requests landing here simply prefill)."""
+        return False
+
     # -- stepping / drain -------------------------------------------------
     def step(self) -> List[RequestOutput]:
         raise NotImplementedError
@@ -231,6 +251,26 @@ class InProcessReplica(ReplicaHandle):
                                   sampling=sampling, meta=meta,
                                   payload=payload, rng_state=rng_state)
             return True
+        except ValueError:
+            return False
+
+    # -- fleet prefix cache ------------------------------------------------
+    def prefix_digest(self) -> Optional[dict]:
+        if not self.alive:
+            return None
+        return self.engine.prefix_digest()
+
+    def export_prefix(self, chain_hash: str):
+        if not self.alive:
+            return None
+        return self.engine.export_prefix(chain_hash)
+
+    def import_prefix(self, *, meta: dict, payload: bytes) -> bool:
+        if not self.alive:
+            return False
+        try:
+            self.engine.import_prefix(meta=meta, payload=payload)
+            return True   # 0 committed (already cached) is success too
         except ValueError:
             return False
 

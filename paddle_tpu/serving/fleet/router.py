@@ -71,6 +71,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from paddle_tpu.distributed.replica_registry import ReplicaRegistry
+from paddle_tpu.serving.block_manager import prefix_chain_hashes
 from paddle_tpu.serving.fleet.metrics import FleetMetrics
 from paddle_tpu.serving.fleet.replica import ReplicaHandle
 from paddle_tpu.serving.fleet.tenant import TenantQueue
@@ -108,12 +109,31 @@ class FleetConfig:
     # when no replica of the wanted role is dispatchable, any replica
     # takes the request — availability beats purity
     roles: Optional[Dict[str, str]] = None
+    # fleet-global prefix cache: score dispatch by estimated TTFT of
+    # the UNMATCHED prompt suffix (advertised cached-prefix tokens are
+    # credited at the replica's own prefill-rate model), and
+    # proactively ship prefixes that keep matching dispatches
+    # (prefix_ship_threshold hits) to cold replicas, at most
+    # max_prefix_ships_per_step per router step. Advertisements decay
+    # linearly to zero over prefix_decay_s of heartbeat age — a stale
+    # advert is worth nothing, and landing on it just prefills
+    prefix_affinity: bool = True
+    prefix_ship: bool = True
+    prefix_ship_threshold: int = 3
+    max_prefix_ships_per_step: int = 1
+    prefix_decay_s: float = 10.0
 
     def __post_init__(self):
         if self.heartbeat_interval_s < 0:
             raise ValueError("heartbeat_interval_s must be >= 0")
         if self.max_handoffs < 0:
             raise ValueError("max_handoffs must be >= 0")
+        if self.prefix_ship_threshold < 1:
+            raise ValueError("prefix_ship_threshold must be >= 1")
+        if self.max_prefix_ships_per_step < 0:
+            raise ValueError("max_prefix_ships_per_step must be >= 0")
+        if self.prefix_decay_s <= 0:
+            raise ValueError("prefix_decay_s must be > 0")
         if self.roles:
             bad = {r for r in self.roles.values()
                    if r not in ("prefill", "decode")}
@@ -201,6 +221,17 @@ class FleetRouter:
         self.kv_ship_time_s = 0.0
         self.num_recompute_fallbacks = 0
         self.num_tokens_recomputed = 0
+        # fleet-global prefix cache: eventually-consistent adverts
+        # (replica_id -> last heartbeat digest), per-prefix dispatch
+        # hit counts, and the recent-ship cooldown table
+        self._adverts: Dict[str, dict] = {}
+        self._prefix_hot: Dict[str, dict] = {}
+        self._shipped: Dict[tuple, float] = {}
+        self.num_prefix_hit_tokens = 0
+        self.num_prefix_affine_dispatches = 0
+        self.num_prefix_ships = 0
+        self.num_prefix_ship_bytes = 0
+        self.num_prefix_ship_failures = 0
         # client-visible terminal histogram (the fleet-level aggregate:
         # per-replica engines keep their own serving/finish/* view,
         # which double-counts handed-off attempts by design)
@@ -359,6 +390,7 @@ class FleetRouter:
         self._heartbeat()
         self._health_sweep(outputs)
         self._dispatch_queue(outputs)
+        self._ship_hot_prefixes()
         for h in list(self.replicas):
             if not h.alive:
                 continue
@@ -448,11 +480,23 @@ class FleetRouter:
         self._last_hb = now
         for h in self.replicas:
             if h.alive and not getattr(h, "self_heartbeat", False):
+                # in-process replicas advertise through the router's
+                # own beat (a worker process publishes the same meta
+                # shape itself — see fleet/worker.py)
+                meta: Dict[str, object] = {}
+                role = getattr(h, "role", None)
+                if role:
+                    meta["role"] = role
+                dig = h.prefix_digest()
+                if dig is not None:
+                    meta["prefix"] = dig
                 self.registry.heartbeat(h.replica_id,
-                                        load=h.load().as_dict())
+                                        load=h.load().as_dict(),
+                                        meta=meta or None)
 
     def _health_sweep(self, outputs: List[RequestOutput]) -> None:
         view = self.registry.alive()
+        self._refresh_adverts(view)
         for h in list(self.replicas):
             if h.alive and getattr(h, "role", None) is None:
                 # a restarted worker advertises its role through the
@@ -492,7 +536,7 @@ class FleetRouter:
                 self._queue.unpop(tenant, rid, cost)
                 return
             handle = self._pick(self._role_candidates(cands, fr),
-                                len(prompt))
+                                prompt)
             shipped = False
             if fr.kv is not None:
                 meta, payload = fr.kv
@@ -531,18 +575,187 @@ class FleetRouter:
                     now - fr.arrival)
 
     def _pick(self, cands: List[ReplicaHandle],
-              prompt_tokens: int) -> ReplicaHandle:
+              prompt: List[int]) -> ReplicaHandle:
         """Best estimated TTFT; least-loaded while estimates are cold
         (fresh replicas have no step history, so their estimator
-        abstains rather than guess)."""
-        ests = [(h.estimated_ttft_ms(prompt_tokens), h) for h in cands]
+        abstains rather than guess). With prefix affinity on, each
+        candidate's estimate is taken over the UNMATCHED prompt suffix
+        only — the cached-prefix credit priced by the replica's own
+        prefill-rate model — and advertised match depth breaks ties
+        toward the warm replica. With no advertised match anywhere,
+        the scoring is bit-identical to plain load balancing."""
+        matched = self._affinity_match(cands, prompt) \
+            if self.cfg.prefix_affinity else {}
+        ests = [(h.estimated_ttft_ms(
+                    max(1, len(prompt) - matched.get(h.replica_id, 0))),
+                 h) for h in cands]
         warm = [(e, h) for e, h in ests if e is not None]
         if len(warm) == len(ests) and warm:
-            return min(warm, key=lambda p: (p[0], p[1].load().occupancy,
-                                            p[1].replica_id))[1]
-        return min(cands, key=lambda h: (h.load().occupancy,
-                                         h.load().kv_utilization,
-                                         h.replica_id))
+            best = min(warm, key=lambda p: (
+                p[0], -matched.get(p[1].replica_id, 0),
+                p[1].load().occupancy, p[1].replica_id))[1]
+        else:
+            best = min(cands, key=lambda h: (
+                -matched.get(h.replica_id, 0), h.load().occupancy,
+                h.load().kv_utilization, h.replica_id))
+        m = matched.get(best.replica_id, 0)
+        if m > 0:
+            self.num_prefix_affine_dispatches += 1
+            self.num_prefix_hit_tokens += m
+        return best
+
+    # -- fleet-global prefix cache -----------------------------------------
+    def _refresh_adverts(self, view: Dict[str, dict]) -> None:
+        """Rebuild the advert map from the liveness sweep's registry
+        view: one digest per live attached replica whose last heartbeat
+        carried one. Replicas that stop heartbeating drop out wholesale
+        — eventual consistency is the contract, staleness decay handles
+        the window in between."""
+        adverts: Dict[str, dict] = {}
+        for h in self.replicas:
+            if not h.alive:
+                continue
+            meta = (view.get(h.replica_id) or {}).get("meta") or {}
+            dig = meta.get("prefix")
+            if isinstance(dig, dict) and dig.get("h"):
+                adverts[h.replica_id] = dig
+        self._adverts = adverts
+
+    def _affinity_match(self, cands: List[ReplicaHandle],
+                        prompt: List[int]) -> Dict[str, int]:
+        """Advertised matched-token count per candidate, decayed by
+        heartbeat age (linear to zero over ``prefix_decay_s``). The
+        walk breaks on the first unadvertised link, mirroring the
+        engine's own match semantics (the digest keeps SHALLOW entries
+        when capped, so every kept entry's ancestors are kept too).
+        Also feeds the hot-prefix tracker with the deepest advertised
+        match anywhere, which drives proactive shipping."""
+        matched: Dict[str, int] = {}
+        best_hash: Optional[str] = None
+        best_tokens = 0
+        hashes_by_bs: Dict[int, List[str]] = {}
+        for h in cands:
+            adv = self._adverts.get(h.replica_id)
+            if not adv:
+                continue
+            bs = int(adv.get("bs", 0))
+            if bs <= 0:
+                continue
+            if bs not in hashes_by_bs:
+                hashes_by_bs[bs] = prefix_chain_hashes(prompt, bs)
+            table = adv.get("h") or {}
+            raw = 0
+            last: Optional[str] = None
+            for i, ch in enumerate(hashes_by_bs[bs]):
+                if ch not in table:
+                    break
+                raw = (i + 1) * bs
+                last = ch
+            if raw <= 0:
+                continue
+            age = self.registry.age_s(h.replica_id)
+            decay = max(0.0, 1.0 - (age or 0.0)
+                        / self.cfg.prefix_decay_s)
+            m = int(raw * decay)
+            if m > 0:
+                matched[h.replica_id] = m
+            if raw > best_tokens:
+                best_tokens, best_hash = raw, last
+        if best_hash is not None:
+            rec = self._prefix_hot.setdefault(
+                best_hash, {"count": 0, "tokens": best_tokens})
+            rec["count"] += 1
+            if len(self._prefix_hot) > 1024:
+                # bound the tracker: drop the coldest half
+                keep = sorted(self._prefix_hot.items(),
+                              key=lambda kv: -kv[1]["count"])[:512]
+                self._prefix_hot = dict(keep)
+        return matched
+
+    def _export_prefix_guarded(self, handle: ReplicaHandle,
+                               chain_hash: str):
+        """``export_prefix`` with the ``fleet.prefix_ship_*`` fault
+        points applied. None means the ship is dropped this step — the
+        destination stays cold and simply prefills, nothing else."""
+        try:
+            kv = handle.export_prefix(chain_hash)
+        except (KeyError, ValueError, OSError):
+            kv = None
+        if kv is not None and faults.check("fleet.prefix_ship_drop"):
+            kv = None
+        if kv is None:
+            return None
+        if faults.check("fleet.prefix_ship_corrupt"):
+            # flip one payload byte: the import side's CRC check
+            # rejects it and the destination stays cold
+            meta, payload = kv
+            if payload:
+                buf = bytearray(payload)
+                buf[0] ^= 0xFF
+                kv = (meta, bytes(buf))
+        return kv
+
+    def _ship_hot_prefixes(self) -> None:
+        """Proactively copy hot advertised prefixes to cold replicas
+        over the KV transport — an ``import_kv`` with no continuation
+        attached. Failures are cheap (the destination just prefills),
+        so policy errs simple: hottest hash first, least-loaded warm
+        source, least-loaded cold destination, a per-(hash, dst)
+        cooldown so a refusing destination is not hammered, and a
+        per-step ship budget so policy never starves serving."""
+        cfg = self.cfg
+        if not (cfg.prefix_affinity and cfg.prefix_ship
+                and self._prefix_hot):
+            return
+        now = time.monotonic()
+        self._shipped = {k: t for k, t in self._shipped.items()
+                         if now - t < cfg.prefix_decay_s}
+        live = self.dispatchable()
+        if len(live) < 2:
+            return
+        budget = cfg.max_prefix_ships_per_step
+        for ch, rec in sorted(self._prefix_hot.items(),
+                              key=lambda kv: (-kv[1]["count"], kv[0])):
+            if budget <= 0:
+                return
+            if rec["count"] < cfg.prefix_ship_threshold:
+                return  # sorted hottest-first: nothing hotter follows
+            warm = [h for h in live if ch in
+                    (self._adverts.get(h.replica_id) or {}).get("h", {})]
+            if not warm:
+                continue
+            warm_ids = {h.replica_id for h in warm}
+            cold = [h for h in live
+                    if h.replica_id not in warm_ids
+                    and self._role(h) != "decode"
+                    and (ch, h.replica_id) not in self._shipped]
+            if not cold:
+                continue
+            src = min(warm, key=lambda h: (h.load().occupancy,
+                                           h.replica_id))
+            dst = min(cold, key=lambda h: (h.load().occupancy,
+                                           h.replica_id))
+            budget -= 1
+            # cooldown even on failure: a destination that refused
+            # (no uncached headroom, draining) will refuse again soon
+            self._shipped[(ch, dst.replica_id)] = now
+            ok = False
+            kv = self._export_prefix_guarded(src, ch)
+            if kv is not None:
+                meta, payload = kv
+                ok = bool(dst.import_prefix(meta=meta, payload=payload))
+                if ok:
+                    self.num_prefix_ships += 1
+                    self.num_prefix_ship_bytes += len(payload)
+                    # optimistic advert update so affinity can use the
+                    # shipped prefix before the next heartbeat confirms
+                    adv = self._adverts.setdefault(
+                        dst.replica_id,
+                        {"bs": meta.get("block_size"), "n": 0, "h": {}})
+                    if adv.get("bs") == meta.get("block_size"):
+                        adv["h"][ch] = len(meta.get("tokens", ()))
+            if not ok:
+                self.num_prefix_ship_failures += 1
 
     def _effective_sampling(self, fr: _FleetRequest,
                             now: float) -> SamplingParams:

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import socket
 import struct
 import threading
@@ -67,6 +68,8 @@ __all__ = [
     "send_frame", "recv_frame", "send_frame_with_blob",
     "IDEMPOTENT_METHODS", "DEFAULT_DEADLINES",
 ]
+
+_log = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024  # torn/garbage length guard; also the
@@ -160,12 +163,12 @@ class RpcRemoteError(RpcError):
 
 
 # reads with no replica-side effect: safe to re-send after a lost reply
-# (export_kv is a pure device->host gather — the source keeps its
-# blocks; re-reading them returns the same bytes)
+# (export_kv/export_prefix are pure device->host gathers — the source
+# keeps its blocks; re-reading them returns the same bytes)
 IDEMPOTENT_METHODS = frozenset({
     "ping", "admission_verdict", "estimated_ttft_ms", "load",
     "is_draining", "drained", "has_unfinished", "rng_state", "snapshot",
-    "export_kv",
+    "export_kv", "prefix_digest", "export_prefix",
 })
 
 # per-method deadline overrides: step/start_drain cover the engine's
@@ -175,6 +178,7 @@ DEFAULT_DEADLINES: Dict[str, float] = {
     "*": 30.0, "ping": 120.0, "add_request": 120.0,
     "step": 600.0, "start_drain": 600.0,
     "export_kv": 120.0, "import_kv": 120.0,
+    "export_prefix": 120.0, "import_prefix": 120.0,
 }
 
 
@@ -368,8 +372,16 @@ class ReplicaServicer:
     request, one reply, in order — the engine is not thread-safe and
     the protocol does not need pipelining."""
 
-    def __init__(self, replica: ReplicaHandle):
+    def __init__(self, replica: ReplicaHandle, on_tick=None):
         self.replica = replica
+        # optional post-reply hook: the worker main() publishes the
+        # current prefix digest into its heartbeat meta here, so
+        # advertisements track the trie without the heartbeat thread
+        # ever touching the (not thread-safe) engine
+        self.on_tick = on_tick
+        # drain KV snapshots dropped for frame-cap reasons (PR 12 made
+        # this fall-through silent; the count rides every step reply)
+        self.num_kv_snapshot_skipped = 0
 
     def handle(self, msg: dict) -> dict:
         seq = msg.get("id")
@@ -413,6 +425,8 @@ class ReplicaServicer:
                 send_frame_with_blob(sock, reply, blob)
             if msg.get("method") == "shutdown" or stopping:
                 return
+            if self.on_tick is not None:
+                self.on_tick()
 
     def _rng_for(self, outputs: List[RequestOutput]) -> Dict[str, dict]:
         """Post-step RNG states for every request that emitted this
@@ -434,14 +448,15 @@ class ReplicaServicer:
         exited, so the bytes must ride the same reply that carries the
         structured aborts. One concatenated blob, per-request metas
         with (off, len) spans, capped at MAX_FRAME per reply (the
-        shipped-batch cap); requests past the cap simply get no payload
-        and fall back to recompute."""
+        shipped-batch cap); requests past the cap get no payload and
+        fall back to recompute — counted and logged, never silent."""
         export = getattr(self.replica, "export_kv", None)
         if export is None:
-            return {}, b""
+            return {}, b"", 0
         metas: Dict[str, dict] = {}
         chunks: List[bytes] = []
         off = 0
+        skipped = 0
         for o in outputs:
             if o.finish_reason != "aborted:drain" \
                     or o.request_id in metas:
@@ -451,6 +466,14 @@ class ReplicaServicer:
                 continue
             meta, payload = res
             if off + len(payload) > MAX_FRAME:
+                skipped += 1
+                self.num_kv_snapshot_skipped += 1
+                _log.debug(
+                    "drain KV snapshot for %s skipped: %dB payload "
+                    "would push the reply past the %dB frame cap "
+                    "(%dB already packed) — the peer falls back to "
+                    "recompute", o.request_id, len(payload), MAX_FRAME,
+                    off)
                 continue
             meta = dict(meta)
             meta["off"] = off
@@ -458,7 +481,7 @@ class ReplicaServicer:
             metas[o.request_id] = meta
             chunks.append(payload)
             off += len(payload)
-        return metas, b"".join(chunks)
+        return metas, b"".join(chunks), skipped
 
     def _dispatch(self, method: str, p: dict) -> Any:
         r = self.replica
@@ -512,6 +535,24 @@ class ReplicaServicer:
                 SamplingParams(**p["sampling"]), meta=p["meta"],
                 payload=p.get("_blob", b""),
                 rng_state=p.get("rng_state")))
+        if method == "prefix_digest":
+            dig = getattr(r, "prefix_digest", None)
+            return dig() if callable(dig) else None
+        if method == "export_prefix":
+            exp = getattr(r, "export_prefix", None)
+            res = exp(p["chain_hash"]) if callable(exp) else None
+            if res is None:
+                return None
+            meta, payload = res
+            out = dict(meta)
+            out["_blob"] = payload
+            return out
+        if method == "import_prefix":
+            imp = getattr(r, "import_prefix", None)
+            if not callable(imp):
+                return False
+            return bool(imp(meta=p["meta"],
+                            payload=p.get("_blob", b"")))
         if method == "shutdown":
             return True
         raise RpcError(f"unknown method {method!r}")
@@ -520,10 +561,12 @@ class ReplicaServicer:
         res = {"outputs": [_output_to_wire(o) for o in outs],
                "rng": self._rng_for(outs),
                "alive": bool(self.replica.alive)}
-        kv, blob = self._kv_for(outs)
+        kv, blob, skipped = self._kv_for(outs)
         if kv:
             res["kv"] = kv
             res["_blob"] = blob
+        if skipped:
+            res["kv_skipped"] = skipped
         return res
 
 
@@ -556,6 +599,9 @@ class SubprocessReplica(ReplicaHandle):
         # drain-reply KV piggyback cache: (meta, payload) per request,
         # answering export_kv post-mortem exactly like _rng_cache
         self._kv_cache: Dict[str, tuple] = {}
+        # worker-side drain snapshots dropped at the frame cap,
+        # accumulated from step replies (fleet/kv_snapshot_skipped)
+        self.num_kv_snapshot_skipped = 0
         self._deadlines = dict(DEFAULT_DEADLINES)
         if deadlines:
             self._deadlines.update(deadlines)
@@ -726,9 +772,39 @@ class SubprocessReplica(ReplicaHandle):
         except ValueError:
             return False
 
+    # -- fleet prefix cache ------------------------------------------------
+    def prefix_digest(self) -> Optional[dict]:
+        return self._query("prefix_digest")
+
+    def export_prefix(self, chain_hash: str):
+        if not self.alive:
+            return None
+        res = self._query("export_prefix", {"chain_hash": chain_hash})
+        if not isinstance(res, dict) or "_blob" not in res:
+            return None
+        payload = res.pop("_blob")
+        return res, payload
+
+    def import_prefix(self, *, meta: dict, payload: bytes) -> bool:
+        """Ship a cached prefix into this replica. One attempt
+        (mutation semantics); a clean remote rejection crosses back as
+        ValueError and returns False — the replica stays alive and the
+        ship is simply dropped."""
+        if not self.alive:
+            return False
+        try:
+            return bool(self._mutate(
+                "import_prefix",
+                {"meta": {k: v for k, v in meta.items()
+                          if k not in ("off", "len")}},
+                blob=payload))
+        except ValueError:
+            return False
+
     def _absorb_step_result(self, res) -> List[RequestOutput]:
         if res is None:
             return []
+        self.num_kv_snapshot_skipped += int(res.get("kv_skipped", 0))
         outs = [_output_from_wire(d) for d in res.get("outputs", [])]
         for rid, state in (res.get("rng") or {}).items():
             self._rng_cache[rid] = state

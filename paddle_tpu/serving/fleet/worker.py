@@ -36,9 +36,11 @@ signal handlers); the engine starts its drain at the next ``step``
 RPC and the aborts ride back to the router with their RNG states.
 
 Threading: the service loop is single-threaded. The one extra thread
-heartbeats the registry and shares nothing with the engine — only the
-stop event and immutable strings — so a heartbeat can never observe a
-half-stepped engine (and lockcheck agrees).
+heartbeats the registry and shares NO engine state with the service
+loop — only the stop event and the lock-guarded :class:`_HeartbeatMeta`
+box the service loop publishes its prefix digest into after each
+reply, so a heartbeat can never observe a half-stepped engine (and
+lockcheck agrees).
 """
 from __future__ import annotations
 
@@ -47,6 +49,31 @@ import json
 import os
 import socket
 import threading
+from typing import Optional
+
+
+class _HeartbeatMeta:
+    """The ONLY state the heartbeat thread shares with the service
+    loop: a dict of JSON-shaped meta values behind one lock. The
+    service loop writes (``update``) between replies; the heartbeat
+    thread reads a copy (``get``) each beat. Values are replaced whole,
+    never mutated in place, so a reader can never see a torn entry."""
+
+    def __init__(self, initial: Optional[dict] = None):
+        self._lock = threading.Lock()
+        self._meta = dict(initial or {})
+
+    def update(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                if v is None:
+                    self._meta.pop(k, None)
+                else:
+                    self._meta[k] = v
+
+    def get(self) -> dict:
+        with self._lock:
+            return dict(self._meta)
 
 
 def build_model(spec: dict):
@@ -68,25 +95,24 @@ def build_model(spec: dict):
 
 def _start_heartbeat(replica_id: str, store_dir: str, interval_s: float,
                      ttl_s: float,
-                     role: str = None) -> threading.Event:
+                     meta: _HeartbeatMeta = None) -> threading.Event:
     """Daemon heartbeat thread. Isolated on purpose: it builds its own
-    store/registry and touches nothing the service loop owns. The
-    record's meta carries the worker's disaggregation ``role`` so a
-    restarted router re-learns the fleet topology from the registry."""
+    store/registry and touches nothing the service loop owns except the
+    lock-guarded ``meta`` box. The record's meta carries the worker's
+    disaggregation ``role`` (so a restarted router re-learns the fleet
+    topology from the registry) and the engine's current ``prefix``
+    digest (the fleet prefix-cache advertisement)."""
     from paddle_tpu.distributed.replica_registry import ReplicaRegistry
     from paddle_tpu.distributed.store import FileStore
 
     stop = threading.Event()
-    pid = os.getpid()
+    meta = meta or _HeartbeatMeta()
 
     def beat():
         reg = ReplicaRegistry(FileStore(store_dir), ttl_s=ttl_s)
-        meta = {"pid": pid}
-        if role:
-            meta["role"] = role
         while True:
             try:
-                reg.heartbeat(replica_id, meta=meta)
+                reg.heartbeat(replica_id, meta=meta.get())
             except OSError:
                 pass  # store dir vanished (teardown); keep trying
             if stop.wait(interval_s):
@@ -123,10 +149,22 @@ def main() -> int:
         model, EngineConfig(**spec.get("engine", {})),
         replica_id=replica_id, monitor=monitor, role=role)
 
+    hb_meta = _HeartbeatMeta({"pid": os.getpid()})
+    if role:
+        hb_meta.update(role=role)
+    hb_meta.update(prefix=replica.prefix_digest())
+
     hb_stop = None
+    publish_digest = None
     if store_dir:
         hb_stop = _start_heartbeat(replica_id, store_dir, hb_interval,
-                                   ttl_s, role=role)
+                                   ttl_s, meta=hb_meta)
+
+        def publish_digest() -> None:
+            # service-loop side of the advertisement: refresh the
+            # digest after each reply (O(1) between trie changes); the
+            # next beat carries it to the registry
+            hb_meta.update(prefix=replica.prefix_digest())
 
     def drained_out() -> bool:
         # SIGTERM path: the drain aborts (with RNG states) went out in
@@ -135,7 +173,8 @@ def main() -> int:
                 and not replica.has_unfinished())
 
     try:
-        ReplicaServicer(replica).serve(sock, should_stop=drained_out)
+        ReplicaServicer(replica, on_tick=publish_digest).serve(
+            sock, should_stop=drained_out)
     finally:
         if hb_stop is not None:
             hb_stop.set()

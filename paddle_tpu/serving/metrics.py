@@ -57,7 +57,11 @@ class ServingMetrics:
               "sampled_steps",
               # disaggregated serving (ISSUE 13): requests admitted
               # mid-context with shipped KV instead of recompute
-              "continuation_admits")
+              "continuation_admits",
+              # fleet-global prefix cache (ISSUE 14): whole cached
+              # prefixes shipped to/from peer replicas, no request
+              # attached
+              "prefix_exports", "prefix_imports")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -85,6 +89,8 @@ class ServingMetrics:
         "spec_accepted": lambda eng: eng.num_spec_accepted,
         "sampled_steps": lambda eng: eng.num_sampled_steps,
         "continuation_admits": lambda eng: eng.num_continuation_admits,
+        "prefix_exports": lambda eng: eng.num_prefix_exports,
+        "prefix_imports": lambda eng: eng.num_prefix_imports,
     }
 
     def __init__(self, engine):

@@ -22,6 +22,12 @@
 #                            KV-block shipping prefill→decode, a real
 #                            SIGKILL of a decode worker mid-run; token
 #                            parity + ship counters; ~2 min)
+#   scripts/ci.sh --prefix   fleet prefix-cache smoke only (2 tiny
+#                            replicas, shared-prefix workload; asserts
+#                            a proactive hot-prefix ship, a positive
+#                            fleet hit rate on the second replica
+#                            WITHOUT it ever prefilling the shared
+#                            header, and token parity; ~1 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -96,6 +102,17 @@ run_disagg() {
 
 if [[ "${1:-}" == "--disagg" ]]; then
     run_disagg
+    exit 0
+fi
+
+run_prefix() {
+    echo "== prefix smoke =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/prefix_smoke.py
+}
+
+if [[ "${1:-}" == "--prefix" ]]; then
+    run_prefix
     exit 0
 fi
 

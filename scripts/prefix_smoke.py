@@ -1,0 +1,123 @@
+"""Fleet-global prefix cache smoke: ship a hot prefix, serve on it.
+
+The ``scripts/ci.sh --prefix`` stage, two phases over a two-replica
+:class:`FleetRouter` on XLA:CPU with a shared 3-block header:
+
+1. **warm + ship** — four shared-header requests served one at a time
+   all land on ``x0`` (prefix-affine dispatch concentrates them), the
+   router's hot-prefix tracker crosses its ship threshold, and the
+   shared header is PROACTIVELY shipped to cold ``x1`` — which must
+   now hold the header as cached-free blocks while having computed
+   ZERO prompt tokens;
+2. **serve on the shipped copy** — ``x0`` retires, three more
+   shared-header requests land on ``x1``, and every one must
+   prefix-hit the shipped header: ``x1`` computes exactly the
+   non-shared suffixes (it never prefills the shared header — its
+   ``num_prompt_tokens`` proves it), the fleet-wide hit rate goes
+   positive, and all seven token streams are bit-identical to an
+   uninterrupted single-engine reference.
+
+Exit 0 on success; any broken invariant raises.
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, InProcessReplica,
+)
+
+_ENGINE = dict(block_size=4, max_num_seqs=4, max_model_len=64,
+               drain_grace_s=0.0)
+MAX_NEW = 8
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    vocab = model.config.vocab_size
+
+    rng = np.random.default_rng(11)
+    shared = [int(t) for t in rng.integers(1, vocab, size=12)]  # 3 blocks
+    tails = [[int(t) for t in rng.integers(1, vocab, size=4)]
+             for _ in range(7)]
+    prompts = [shared + t for t in tails]
+    ids = [f"p{i}" for i in range(7)]
+    sp = SamplingParams(max_new_tokens=MAX_NEW)
+
+    # uninterrupted single-engine reference (greedy: placement must
+    # never change tokens)
+    eng = LLMEngine(model, EngineConfig(**_ENGINE))
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    ref = {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+    router = FleetRouter(
+        [InProcessReplica(model, EngineConfig(**_ENGINE),
+                          replica_id=f"x{i}") for i in range(2)],
+        FleetConfig(prefix_ship_threshold=2, prefix_decay_s=30.0))
+    x0, x1 = router.replicas
+
+    # phase 1: serial shared-header traffic concentrates on x0 and
+    # heats the shared chain past the ship threshold
+    got = {}
+    for rid, p in zip(ids[:4], prompts[:4]):
+        router.add_request(rid, p, sampling=sp)
+        while router.has_unfinished():
+            router.step()
+        got[rid] = list(router.release_request(rid).generated)
+    for _ in range(3):
+        router.step()  # let a threshold crossed on the last dispatch ship
+
+    assert router.num_prefix_ships >= 1, router.num_prefix_ships
+    assert router.num_prefix_ship_bytes > 0
+    assert x0.engine.metrics.num_prompt_tokens > 0
+    # the shipped header landed on x1 without x1 computing ANYTHING
+    assert x1.engine.num_prefix_imports >= 1, x1.engine.num_prefix_imports
+    assert x1.engine.metrics.num_prompt_tokens == 0, \
+        x1.engine.metrics.num_prompt_tokens
+    assert x1.engine.block_manager.match_prefix(shared) == len(shared)
+
+    # phase 2: x0 retires; the remaining traffic must serve on x1's
+    # SHIPPED copy of the header — computing only the 4-token suffixes
+    router.retire_replica(x0)
+    for rid, p in zip(ids[4:], prompts[4:]):
+        router.add_request(rid, p, sampling=sp)
+    steps = 0
+    while router.has_unfinished():
+        router.step()
+        steps += 1
+        assert steps < 500, "router failed to converge"
+    for rid in ids[4:]:
+        fr = router.get_request(rid)
+        assert fr.finish_reason == "length", (rid, fr.finish_reason)
+        got[rid] = list(router.release_request(rid).generated)
+
+    assert got == ref, "prefix-cache path changed tokens"
+    n, suffix = len(ids[4:]), len(tails[0])
+    assert x1.engine.metrics.num_prompt_tokens == n * suffix, \
+        x1.engine.metrics.num_prompt_tokens
+    assert (x1.engine.block_manager.num_prefix_hit_tokens
+            >= n * len(shared))
+    snap = router.snapshot()
+    assert snap["fleet_prefix_hit_rate"] > 0, snap["fleet_prefix_hit_rate"]
+    assert snap["replicas"]["x1"]["serving_prefix_cache_hit_tokens"] \
+        >= n * len(shared)
+    print("PREFIX_SMOKE_OK ships=%d bytes=%d x1_hit_tokens=%d "
+          "x1_computed=%d fleet_hit_rate=%.4f"
+          % (router.num_prefix_ships, router.num_prefix_ship_bytes,
+             x1.engine.block_manager.num_prefix_hit_tokens,
+             x1.engine.metrics.num_prompt_tokens,
+             snap["fleet_prefix_hit_rate"]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -423,8 +423,34 @@ class TestLoopbackE2E:
             router = FleetRouter(
                 [lb.handle for lb in lbs],
                 FleetConfig(roles={f"S{seed}0": "prefill",
-                                   f"S{seed}1": "decode"}))
-            for i, (rid, p) in enumerate(zip(ids, prompts)):
+                                   f"S{seed}1": "decode"},
+                            prefix_ship_threshold=1))
+            # fleet prefix layer under fire: warm one 2-block shared
+            # header pre-faults, then advertise every replica's digest
+            # via manual registry beats (loopback handles are
+            # self_heartbeat — in tests nothing beats for them), so
+            # the storm dispatches on adverts that go stale the moment
+            # churn evicts the blocks. Threshold 1: the first affinity
+            # match already makes the header ship-eligible.
+            shared = [int(t) for t in sched.integers(
+                1, tiny_model.config.vocab_size, size=8)]
+            router.add_request(f"c{seed}-warm", shared + [7, 8, 9],
+                               sampling=_sp(False))
+            _drain_router(router)
+            for lb in lbs:
+                router.registry.heartbeat(
+                    lb.handle.replica_id,
+                    meta={"prefix": lb.handle.prefix_digest()})
+            for i in range(4):
+                rid = f"c{seed}-h{i}"
+                ids.append(rid)
+                tail = [int(t) for t in sched.integers(
+                    1, tiny_model.config.vocab_size,
+                    size=3 + int(sched.integers(0, 3)))]
+                prompts.append(shared + tail)
+                router.add_request(rid, shared + tail,
+                                   sampling=_sp(i % 2 == 0))
+            for i, (rid, p) in enumerate(zip(ids[:n], prompts[:n])):
                 router.add_request(rid, p, sampling=_sp(i % 2 == 1))
             spec = ";".join([
                 f"fleet.worker_kill:flag:S{seed}0"
@@ -443,6 +469,13 @@ class TestLoopbackE2E:
                 f"*{sched.integers(1, 3)}",
                 f"fleet.kv_ship_delay:flag:0.005@{sched.integers(1, 8)}"
                 f"*{sched.integers(1, 3)}",
+                # proactive prefix ships under the same fire: dropped
+                # or corrupted ships must leave the destination merely
+                # cold, never corrupt
+                f"fleet.prefix_ship_drop:flag@{sched.integers(0, 2)}"
+                f"*{sched.integers(1, 2)}",
+                f"fleet.prefix_ship_corrupt:flag@{sched.integers(0, 2)}"
+                f"*{sched.integers(1, 2)}",
             ])
             faults.install(spec)
             outs = _drain_router(router, max_steps=400)
@@ -471,6 +504,10 @@ class TestLoopbackE2E:
                     bm = lb.inner.engine.block_manager
                     assert bm.num_free_blocks == bm.num_blocks
                     assert bm.num_free_host_blocks == bm.num_host_blocks
+            # the prefix layer was actually exercised: at least one
+            # proactive ship was attempted (landed or failed cleanly)
+            assert (router.num_prefix_ships
+                    + router.num_prefix_ship_failures) >= 1
 
 
 # ---------------------------------------------------------------------------
