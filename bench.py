@@ -945,6 +945,129 @@ def bench_fleet(tiny=False, replicas=2, n_requests=16,
     }
 
 
+def bench_peer(tiny=False, replicas=4, n_requests=16,
+               max_new_tokens=32, max_num_seqs=4, seed=0):
+    """Peer data plane vs router relay (``--serving --peer``): the
+    disaggregated scenario of :func:`bench_fleet` — first half prefill,
+    second half decode, every request's KV shipped across the role
+    boundary — run TWICE over the same prompts and weights. The peer
+    variant brings up a :class:`PeerListener` per replica and ships
+    every block worker↔worker under router-issued tickets (zero KV
+    payload bytes through the router, asserted); the relay variant
+    pins ``peer_data_plane=False`` so the router itself carries every
+    byte (the pre-peer path, still the ladder's middle rung). The
+    primary value is peer-path tokens/s; ``vs_baseline`` is the relay
+    number, so the ratio IS the control/data-plane split's cost or win
+    on this box. Token streams must match between variants."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, SamplingParams
+    from paddle_tpu.serving.fleet import (
+        FleetConfig, FleetRouter, InProcessReplica,
+    )
+
+    paddle.seed(seed)
+    paddle.set_default_dtype("float32")
+    cfg = _fleet_model_cfg(tiny)
+    if tiny:
+        n_requests, max_new_tokens = min(n_requests, 12), min(
+            max_new_tokens, 8)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    n_pre = max(1, replicas // 2)
+    roles = {f"r{i}": ("prefill" if i < n_pre else "decode")
+             for i in range(replicas)}
+    sp = SamplingParams(max_new_tokens=max_new_tokens)
+
+    def run(peer):
+        reps = [InProcessReplica(
+            model, EngineConfig(
+                max_num_seqs=max_num_seqs,
+                max_model_len=min(cfg.max_position_embeddings, 1024)),
+            replica_id=f"r{i}") for i in range(replicas)]
+        if peer:
+            for r in reps:
+                r.start_peer()
+        router = FleetRouter(reps, FleetConfig(
+            roles=roles, peer_data_plane=peer))
+        rng = np.random.RandomState(seed)
+
+        def prompts(n, base):
+            return [list(rng.randint(0, cfg.vocab_size,
+                                     size=base + 3 * (i % 5) + 1))
+                    for i in range(n)]
+
+        # warmup compiles every bucketed shape on both roles
+        for p in prompts(replicas * max_num_seqs + 2, 5):
+            router.add_request(p, sampling=sp)
+        while router.has_unfinished():
+            router.step()
+        tokens0 = router.num_tokens_emitted
+
+        t0 = time.perf_counter()
+        rids = [router.add_request(p, sampling=sp)
+                for p in prompts(n_requests, 5)]
+        while router.has_unfinished():
+            router.step()
+        dt = time.perf_counter() - t0
+        tokens = router.num_tokens_emitted - tokens0
+        assert all(router.get_request(r).finish_reason == "length"
+                   for r in rids)
+        snap = router.snapshot()
+        # both variants ship every measured request's blocks — nothing
+        # recomputed on either path
+        assert snap["fleet_kv_ship_requests"] >= n_requests, snap
+        assert snap["fleet_recompute_fallbacks"] == 0, snap
+        assert snap["fleet_tokens_recomputed"] == 0, snap
+        assert snap["fleet_tickets_issued"] == sum(
+            router.ticket_outcomes.values()), snap
+        if peer:
+            # steady state: the payload NEVER touches the router
+            assert snap["fleet_relay_bytes"] == 0, snap
+            assert snap["fleet_peer_ship_bytes"] > 0, snap
+        else:
+            assert snap["fleet_tickets_issued"] == 0, snap
+            assert snap["fleet_relay_bytes"] > 0, snap
+        gen = [list(router.get_request(r).generated) for r in rids]
+        for r in reps:
+            r.close_peer()
+        return gen, {
+            "tokens_per_sec": round(tokens / dt, 2),
+            "wall_s": round(dt, 3),
+            "ship_requests": snap["fleet_kv_ship_requests"],
+            "ship_blocks": snap["fleet_kv_ship_blocks"],
+            "ship_bytes": snap["fleet_kv_ship_bytes"],
+            "ship_ms_avg": snap["fleet_kv_ship_ms_avg"],
+            "peer_ship_bytes": snap["fleet_peer_ship_bytes"],
+            "router_relay_bytes": snap["fleet_relay_bytes"],
+            "tickets_issued": snap["fleet_tickets_issued"],
+            "ticket_outcomes": snap["fleet_ticket_outcomes"],
+        }
+
+    gen_p, peer = run(peer=True)
+    gen_r, relay = run(peer=False)
+    assert gen_p == gen_r, "peer/relay token streams diverged"
+
+    return {
+        "metric": "peer_data_plane_tokens_per_sec",
+        "value": peer["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": relay["tokens_per_sec"],
+        "extra": {
+            "config": ("tiny" if tiny else "gpt-small-serving")
+                      + f" replicas={replicas} disagg {n_pre}p/"
+                      f"{replicas - n_pre}d n_req={n_requests}"
+                      f" max_new={max_new_tokens}"
+                      f" max_num_seqs={max_num_seqs}",
+            "peer": peer,
+            "relay": relay,
+        },
+    }
+
+
 def _pp_schedules_worker():
     """Measure per-schedule pipeline step time on the 8-device virtual
     CPU mesh (VERDICT r4 #3/#10: measured numbers, not hardcoded
@@ -1175,7 +1298,12 @@ if __name__ == "__main__":
         # the fleet router instead (fleet counters in extra); --disagg
         # splits it into prefill/decode roles with KV-block shipping
         # (ship counters + recompute comparison in extra.disagg).
-        if "--replicas" in sys.argv:
+        if "--peer" in sys.argv:
+            # peer data plane vs router relay over the same disagg
+            # scenario (ship bytes + tokens/s per variant in extra)
+            print("BENCH_serving_peer " + json.dumps(
+                bench_peer(tiny="--tiny" in sys.argv)))
+        elif "--replicas" in sys.argv:
             n = int(sys.argv[sys.argv.index("--replicas") + 1])
             print("BENCH_serving_fleet " + json.dumps(
                 bench_fleet(tiny="--tiny" in sys.argv, replicas=n,

@@ -77,13 +77,24 @@ class ReplicaRegistry:
     returns False) until it heartbeats again. ``now`` parameters exist
     so tests can drive the clock instead of sleeping."""
 
+    # heartbeat meta is topology advertisement, not a payload channel:
+    # keys the fleet cannot function without are NEVER dropped by the
+    # size guard, everything else (the prefix digest first — it is the
+    # only unbounded-ish tenant) goes before a record exceeds the cap
+    ESSENTIAL_META_KEYS = ("role", "peer", "pid")
+
     def __init__(self, store=None, prefix: str = "serving_fleet",
-                 ttl_s: float = 5.0):
+                 ttl_s: float = 5.0, meta_cap_bytes: int = 4096):
         if ttl_s <= 0:
             raise ValueError("ttl_s must be > 0")
+        if meta_cap_bytes <= 0:
+            raise ValueError("meta_cap_bytes must be > 0")
         self.store = store if store is not None else MemStore()
         self.prefix = prefix
         self.ttl_s = ttl_s
+        self.meta_cap_bytes = meta_cap_bytes
+        # size-guard drops, counted loudly instead of truncating silently
+        self.num_meta_keys_dropped = 0
         # write side: per-key heartbeat counter under a writer nonce
         self._nonce = f"{os.getpid():x}.{id(self) & 0xFFFFFF:x}"
         self._seq: Dict[str, int] = {}
@@ -111,10 +122,32 @@ class ReplicaRegistry:
         rec = {"ts": time.time() if now is None else now,
                "seq": [self._nonce, n]}
         if meta:
-            rec["meta"] = meta
+            rec["meta"] = self._bounded_meta(dict(meta))
         if load:
             rec["load"] = load
         self.store.set(self._key(replica_id), json.dumps(rec))
+
+    def _bounded_meta(self, meta: dict) -> dict:
+        """Enforce ``meta_cap_bytes`` on the serialized meta. Drop
+        order: the prefix digest first, then the remaining
+        non-essential keys (name order, for determinism) — never the
+        role / peer endpoint / pid. Each dropped key bumps
+        ``num_meta_keys_dropped``; an all-essential meta that still
+        exceeds the cap is sent as-is (better a fat beat than a fleet
+        that forgets its own topology)."""
+        if len(json.dumps(meta)) <= self.meta_cap_bytes:
+            return meta
+        droppable = ["prefix"] + sorted(
+            k for k in meta
+            if k != "prefix" and k not in self.ESSENTIAL_META_KEYS)
+        for k in droppable:
+            if k not in meta:
+                continue
+            meta.pop(k)
+            self.num_meta_keys_dropped += 1
+            if len(json.dumps(meta)) <= self.meta_cap_bytes:
+                break
+        return meta
 
     def deregister(self, replica_id: str) -> None:
         self.store.delete(self._key(replica_id))

@@ -817,12 +817,22 @@ class LLMEngine:
             table = self.block_manager.import_blocks(request_id, covered)
         except NoFreeBlocksError as e:
             raise ValueError(str(e)) from e
-        k_np = np.frombuffer(payload, dtype=dtype,
-                             count=want_bytes // dtype.itemsize)
-        v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
-                             count=want_bytes // dtype.itemsize)
-        self._swapper.scatter(table, k_np.reshape(want_shape),
-                              v_np.reshape(want_shape))
+        try:
+            # partial-failure cleanup: blocks are allocated but nothing
+            # is registered yet — a scatter fault must not leak them
+            # (the fault point stands in for a device OOM/transfer error)
+            faults.fire("serving.kv_scatter")
+            k_np = np.frombuffer(payload, dtype=dtype,
+                                 count=want_bytes // dtype.itemsize)
+            v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
+                                 count=want_bytes // dtype.itemsize)
+            self._swapper.scatter(table, k_np.reshape(want_shape),
+                                  v_np.reshape(want_shape))
+        except Exception as e:
+            self.block_manager.free(request_id)
+            raise ValueError(
+                f"request {request_id!r}: KV scatter failed after "
+                f"block allocation ({e}); blocks freed") from e
         req.num_cached = covered
         self._requests[request_id] = req
         self.scheduler.add_continuation(req)
@@ -937,13 +947,22 @@ class LLMEngine:
             table = self.block_manager.import_blocks(rid, covered)
         except NoFreeBlocksError as e:
             raise ValueError(str(e)) from e
-        k_np = np.frombuffer(payload, dtype=dtype,
-                             count=want_bytes // dtype.itemsize)
-        v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
-                             count=want_bytes // dtype.itemsize)
-        self._swapper.scatter(table, k_np.reshape(want_shape),
-                              v_np.reshape(want_shape))
-        self.block_manager.commit_prefix(rid, tokens, covered)
+        try:
+            # same partial-failure discipline as import_kv: a scatter
+            # fault after allocation frees the synthetic claim whole
+            faults.fire("serving.kv_scatter")
+            k_np = np.frombuffer(payload, dtype=dtype,
+                                 count=want_bytes // dtype.itemsize)
+            v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
+                                 count=want_bytes // dtype.itemsize)
+            self._swapper.scatter(table, k_np.reshape(want_shape),
+                                  v_np.reshape(want_shape))
+            self.block_manager.commit_prefix(rid, tokens, covered)
+        except Exception as e:
+            self.block_manager.free(rid)
+            raise ValueError(
+                f"prefix import scatter failed after block allocation "
+                f"({e}); blocks freed") from e
         self.block_manager.free(rid)
         self.num_prefix_imports += 1
         return covered

@@ -36,8 +36,9 @@ from paddle_tpu.serving.fleet.supervisor import (  # noqa: F401
 )
 from paddle_tpu.serving.fleet.tenant import TenantQueue  # noqa: F401
 from paddle_tpu.serving.fleet.transport import (  # noqa: F401
-    ReplicaGone, ReplicaServicer, RpcClient, RpcError, RpcRemoteError,
-    RpcTimeout, SubprocessReplica,
+    PeerListener, ReplicaGone, ReplicaServicer, RpcClient, RpcError,
+    RpcRemoteError, RpcTimeout, SubprocessReplica, peer_push,
+    peer_secret, sign_ticket,
 )
 
 __all__ = ["AutoscalePolicy", "FleetController", "LoadThresholdPolicy",
@@ -45,5 +46,7 @@ __all__ = ["AutoscalePolicy", "FleetController", "LoadThresholdPolicy",
            "ReplicaLoad", "FleetConfig", "FleetRouter",
            "HANDOFF_REASONS", "TenantQueue",
            "ReplicaSupervisor", "SupervisorConfig", "WorkerSpec",
-           "ReplicaGone", "ReplicaServicer", "RpcClient", "RpcError",
-           "RpcRemoteError", "RpcTimeout", "SubprocessReplica"]
+           "PeerListener", "ReplicaGone", "ReplicaServicer",
+           "RpcClient", "RpcError", "RpcRemoteError", "RpcTimeout",
+           "SubprocessReplica", "peer_push", "peer_secret",
+           "sign_ticket"]

@@ -36,7 +36,10 @@ class FleetMetrics:
               "tokens_recomputed", "prefix_hit_tokens",
               "prefix_affine_dispatches", "prefix_ships",
               "prefix_ship_bytes", "prefix_ship_failures",
-              "kv_snapshot_skipped")
+              "kv_snapshot_skipped", "tickets_issued",
+              "peer_ship_requests", "peer_ship_blocks",
+              "peer_ship_bytes", "relay_fallbacks", "relay_bytes",
+              "ship_skipped_expired")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
@@ -67,6 +70,17 @@ class FleetMetrics:
         "prefix_ships": lambda r: r.num_prefix_ships,
         "prefix_ship_bytes": lambda r: r.num_prefix_ship_bytes,
         "prefix_ship_failures": lambda r: r.num_prefix_ship_failures,
+        # peer data plane: ticketed worker<->worker transfers. The
+        # kv_ship_* gauges above stay the AGGREGATE success counters
+        # (peer or relay); these split the path taken and account every
+        # issued ticket (sum(ticket_outcomes) == tickets_issued)
+        "tickets_issued": lambda r: r.num_tickets_issued,
+        "peer_ship_requests": lambda r: r.num_peer_ship_requests,
+        "peer_ship_blocks": lambda r: r.num_peer_ship_blocks,
+        "peer_ship_bytes": lambda r: r.num_peer_ship_bytes,
+        "relay_fallbacks": lambda r: r.num_relay_fallbacks,
+        "relay_bytes": lambda r: r.num_relay_bytes,
+        "ship_skipped_expired": lambda r: r.num_ship_skipped_expired,
         # drain KV snapshots dropped at the frame cap, summed over
         # worker-backed handles (the PR 12 silent-skip, now counted)
         "kv_snapshot_skipped": lambda r: sum(
@@ -94,6 +108,7 @@ class FleetMetrics:
             r.num_tokens_emitted / dt if dt > 0 else 0.0, 2)
         out["fleet_load"] = round(r.load(), 4)
         out["fleet_finish"] = dict(sorted(r.finish_counts.items()))
+        out["fleet_ticket_outcomes"] = dict(r.ticket_outcomes)
         tenants = {}
         waiting = r._queue.waiting_by_tenant()
         for t in sorted(set(waiting) | set(r.tenant_wait_s)):

@@ -72,6 +72,11 @@ class ReplicaHandle:
     # None (serves both). Advertised through the registry heartbeat so
     # a restarted handle re-learns it (see FleetRouter._health_sweep)
     role: Optional[str] = None
+    # peer data plane: "host:port" of the replica's PeerListener, or
+    # None when the replica has no direct channel — the router then
+    # relays the bytes itself (the pre-peer path, kept as a ladder
+    # rung). Advertised through the registry heartbeat like the role.
+    peer_endpoint: Optional[str] = None
 
     # -- dispatch-side reads ---------------------------------------------
     def admission_verdict(self, prompt_tokens: int) -> Optional[str]:
@@ -125,6 +130,35 @@ class ReplicaHandle:
         rejection (the router falls back to recompute)."""
         return False
 
+    # -- peer data plane (optional capability; default: unsupported) ------
+    def park_kv(self, request_id: str) -> Optional[dict]:
+        """Gather the request's committed KV to replica-local host
+        memory so it survives the engine-side release and can be pushed
+        (or relayed) later. Returns a small summary dict
+        ({"bytes", "blocks", "tokens_covered"}) or None when
+        unsupported/refused — the router then captures the bytes
+        router-side as before."""
+        return None
+
+    def drop_parked(self, request_id: str) -> None:
+        """Release a parked KV snapshot (transfer done or abandoned)."""
+
+    def peer_send(self, ticket: dict, endpoint: str) -> Optional[dict]:
+        """Push this replica's payload for ``ticket`` straight to the
+        destination's peer listener. Returns a receipt summary dict on
+        a staged delivery, None on any failure (dead rung)."""
+        return None
+
+    def peer_commit(self, ticket_id: str, *, kind: str = "kv",
+                    request_id: Optional[str] = None,
+                    prompt_ids: Optional[Sequence[int]] = None,
+                    sampling: Optional[SamplingParams] = None,
+                    rng_state=None) -> bool:
+        """Commit a staged peer delivery into the engine; False when
+        nothing is staged under ``ticket_id`` or the import is cleanly
+        refused."""
+        return False
+
     # -- fleet prefix cache (optional capability; default: none) ----------
     def prefix_digest(self) -> Optional[dict]:
         """Bounded advertisement of the replica's committed prefix trie
@@ -172,6 +206,13 @@ class InProcessReplica(ReplicaHandle):
         self.retiring = False
         self.role = role
         self.created_at = time.monotonic()
+        # peer data plane: host-side KV snapshots parked for a ticketed
+        # transfer (survive engine-side release), plus the listener that
+        # stages inbound peer deliveries. Single-threaded access: only
+        # the service/router thread touches _parked; the listener's own
+        # accept thread never reaches in here.
+        self._parked: Dict[str, tuple] = {}
+        self._peer = None
         if monitor is not None:
             self.engine.install_preemption_handler(monitor)
 
@@ -239,6 +280,9 @@ class InProcessReplica(ReplicaHandle):
     def export_kv(self, request_id: str):
         if not self.alive:
             return None
+        parked = self._parked.get(request_id)
+        if parked is not None:
+            return parked  # survives release; the router-relay rung
         return self.engine.export_kv(request_id)
 
     def import_kv(self, request_id: str, prompt_ids: Sequence[int],
@@ -253,6 +297,88 @@ class InProcessReplica(ReplicaHandle):
             return True
         except ValueError:
             return False
+
+    # -- peer data plane ---------------------------------------------------
+    def start_peer(self) -> str:
+        """Open this replica's peer listener (idempotent) and return
+        its endpoint. Workers call this at boot; in-process fleets and
+        tests opt in per replica."""
+        if self._peer is None:
+            from paddle_tpu.serving.fleet.transport import PeerListener
+            self._peer = PeerListener()
+            self.peer_endpoint = self._peer.endpoint
+        return self.peer_endpoint
+
+    def close_peer(self) -> None:
+        if self._peer is not None:
+            self._peer.close()
+            self._peer = None
+            self.peer_endpoint = None
+
+    @property
+    def peer_listener(self):
+        return self._peer
+
+    def park_kv(self, request_id: str) -> Optional[dict]:
+        if not self.alive:
+            return None
+        res = self.export_kv(request_id)
+        if res is None:
+            return None
+        meta, payload = res
+        self._parked[request_id] = (meta, payload)
+        while len(self._parked) > 16:  # bounded host-memory stash
+            self._parked.pop(next(iter(self._parked)))
+        return {"bytes": len(payload),
+                "blocks": int(meta.get("blocks", 0)),
+                "tokens_covered": int(meta.get("tokens_covered", 0))}
+
+    def drop_parked(self, request_id: str) -> None:
+        self._parked.pop(request_id, None)
+
+    def peer_send(self, ticket: dict, endpoint: str) -> Optional[dict]:
+        if not self.alive:
+            return None
+        kind = ticket.get("kind", "kv")
+        if kind == "prefix":
+            res = self.export_prefix(ticket.get("chain_hash"))
+        else:
+            res = self.export_kv(ticket.get("request_id"))
+        if res is None:
+            return None
+        meta, payload = res
+        from paddle_tpu.serving.fleet.transport import peer_push
+        timeout_s = max(0.05, float(ticket.get("deadline_ms", 30e3)) / 1e3)
+        try:
+            receipt = peer_push(endpoint, ticket, meta, payload,
+                                timeout_s=timeout_s)
+        except (OSError, ValueError):
+            return None
+        if not receipt.get("ok"):
+            return None
+        return {"bytes": len(payload),
+                "blocks": int(meta.get("blocks", 0)),
+                "tokens_covered": int(meta.get("tokens_covered", 0)),
+                "tokens": len(meta.get("tokens") or ())}
+
+    def peer_commit(self, ticket_id: str, *, kind: str = "kv",
+                    request_id: Optional[str] = None,
+                    prompt_ids: Optional[Sequence[int]] = None,
+                    sampling: Optional[SamplingParams] = None,
+                    rng_state=None) -> bool:
+        if not self.alive or self._peer is None:
+            return False
+        ent = self._peer.take(ticket_id)
+        if ent is None:
+            return False  # never delivered / already committed / GC'd
+        ticket, meta, payload = ent
+        if ticket.get("kind", kind) == "prefix":
+            return self.import_prefix(meta=meta, payload=payload)
+        if request_id is None or sampling is None:
+            return False
+        return self.import_kv(request_id, list(prompt_ids or []),
+                              sampling, meta=meta, payload=payload,
+                              rng_state=rng_state)
 
     # -- fleet prefix cache ------------------------------------------------
     def prefix_digest(self) -> Optional[dict]:
@@ -278,6 +404,8 @@ class InProcessReplica(ReplicaHandle):
     def step(self) -> List[RequestOutput]:
         if not self.alive:
             return []
+        if self._peer is not None:
+            self._peer.gc()  # orphan-ticket sweep rides the step cadence
         try:
             return self.engine.step()
         except EngineStepError as e:

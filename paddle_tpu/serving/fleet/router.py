@@ -61,6 +61,17 @@ KV-ship fault points (disaggregated serving — queried at each ship):
                             falls back to recompute — the request is
                             never duplicated or lost either way
 =========================  ==============================================
+
+Peer data plane (ISSUE 15): with ``peer_data_plane`` on (the default)
+KV payloads move worker→worker instead of twice through the router.
+The source PARKS the gathered bytes host-side at ship time; at the
+next dispatch the router issues a small signed ticket and walks a
+degradation ladder — peer-push → router-relay (the pre-peer path,
+kept) → recompute — with exactly one counted outcome per ticket
+(``ticket_outcomes``) and per-rung deadlines carved from the request's
+remaining deadline budget. The transport adds four peer fault points
+(``fleet.peer_{connect_fail,send_drop,frame_corrupt,stall}``) that
+fire inside the source's push, driving the ladder down a rung.
 """
 from __future__ import annotations
 
@@ -122,10 +133,21 @@ class FleetConfig:
     prefix_ship_threshold: int = 3
     max_prefix_ships_per_step: int = 1
     prefix_decay_s: float = 10.0
+    # peer data plane: ticketed worker→worker KV transfers with the
+    # router as pure control plane. False pins every transfer to the
+    # router-relay path (the pre-peer behavior — also the bench
+    # comparison baseline). peer_deadline_s caps each ladder rung's
+    # deadline; a request with its own deadline budget gets the
+    # smaller of the cap and a third of what remains (leaving room
+    # for the relay and recompute rungs below)
+    peer_data_plane: bool = True
+    peer_deadline_s: float = 30.0
 
     def __post_init__(self):
         if self.heartbeat_interval_s < 0:
             raise ValueError("heartbeat_interval_s must be >= 0")
+        if self.peer_deadline_s <= 0:
+            raise ValueError("peer_deadline_s must be > 0")
         if self.max_handoffs < 0:
             raise ValueError("max_handoffs must be >= 0")
         if self.prefix_ship_threshold < 1:
@@ -166,6 +188,12 @@ class _FleetRequest:
     # bytes live router-side, so the payload survives the SOURCE
     # replica dying while the request waits in the queue
     kv: Optional[tuple] = None
+    # peer data plane: replica that PARKED this request's KV host-side
+    # at ship time — the bytes stay at the source and move worker→
+    # worker (or router-relay) when the next dispatch runs the ticket
+    # ladder. Mutually exclusive with ``kv`` (which is the drain
+    # piggyback / relay-capture path)
+    ship_src: Optional[str] = None
     # set once the request's prefill completed on a prefill-role
     # replica: from then on it belongs on the decode side, WITH the
     # shipped KV or (fallback) by recompute there — re-prefilling on
@@ -214,13 +242,32 @@ class FleetRouter:
         self.num_scale_downs = 0
         self.num_autoscale_decisions = 0
         self.num_tokens_emitted = 0
-        # KV-ship accounting (disaggregated serving)
+        # KV-ship accounting (disaggregated serving). kv_ship_* stays
+        # the AGGREGATE successful-transfer view (peer or relay alike);
+        # the peer data plane splits it below
         self.num_kv_ship_requests = 0
         self.num_kv_ship_blocks = 0
         self.num_kv_ship_bytes = 0
         self.kv_ship_time_s = 0.0
         self.num_recompute_fallbacks = 0
         self.num_tokens_recomputed = 0
+        # peer data plane: per-ticket outcome partition (exactly one
+        # outcome per issued ticket — the accounting invariant tests
+        # pin is sum(ticket_outcomes.values()) == num_tickets_issued),
+        # plus the peer/relay byte split. relay_bytes counts every KV
+        # payload byte that crossed the ROUTER process (drain
+        # piggybacks, relay rungs, prefix relays) — zero in a steady
+        # peer-plane fleet
+        self.num_tickets_issued = 0
+        self.ticket_outcomes: Dict[str, int] = {
+            "peer": 0, "relay": 0, "recompute": 0, "cold": 0}
+        self.num_peer_ship_requests = 0
+        self.num_peer_ship_blocks = 0
+        self.num_peer_ship_bytes = 0
+        self.num_relay_fallbacks = 0
+        self.num_relay_bytes = 0
+        self.num_ship_skipped_expired = 0
+        self._ticket_seq = itertools.count()
         # fleet-global prefix cache: eventually-consistent adverts
         # (replica_id -> last heartbeat digest), per-prefix dispatch
         # hit counts, and the recent-ship cooldown table
@@ -487,6 +534,9 @@ class FleetRouter:
                 role = getattr(h, "role", None)
                 if role:
                     meta["role"] = role
+                peer = getattr(h, "peer_endpoint", None)
+                if peer:
+                    meta["peer"] = peer
                 dig = h.prefix_digest()
                 if dig is not None:
                     meta["prefix"] = dig
@@ -498,13 +548,19 @@ class FleetRouter:
         view = self.registry.alive()
         self._refresh_adverts(view)
         for h in list(self.replicas):
-            if h.alive and getattr(h, "role", None) is None:
-                # a restarted worker advertises its role through the
-                # registry heartbeat meta; re-learn it so the fresh
-                # handle rejoins the right side of the disaggregation
+            if h.alive and (getattr(h, "role", None) is None
+                            or getattr(h, "peer_endpoint", None) is None):
+                # a restarted worker advertises its role AND its peer
+                # endpoint through the registry heartbeat meta; re-learn
+                # both so a fresh router rejoins the topology (and can
+                # ticket peer transfers) without re-plumbing anything
                 meta = (view.get(h.replica_id) or {}).get("meta") or {}
-                if meta.get("role") in ("prefill", "decode"):
+                if (getattr(h, "role", None) is None
+                        and meta.get("role") in ("prefill", "decode")):
                     h.role = meta["role"]
+                if (getattr(h, "peer_endpoint", None) is None
+                        and meta.get("peer")):
+                    h.peer_endpoint = meta["peer"]
             if h.alive and h.replica_id not in view:
                 self.kill_replica(h.replica_id, "heartbeat lost", outputs)
             elif not h.alive and self._assigned.get(h.replica_id):
@@ -524,6 +580,11 @@ class FleetRouter:
                 continue  # aborted while queued
             now = time.monotonic()
             if fr.deadline_abs is not None and now >= fr.deadline_abs:
+                if fr.ship_src is not None or fr.kv is not None:
+                    # expire-before-ship: a pending KV transfer for a
+                    # request that can no longer finish is abandoned,
+                    # never shipped (the parked snapshot is released)
+                    self.num_ship_skipped_expired += 1
                 self._finalize(fr, "expired", None, outputs)
                 continue
             prompt = fr.prompt_ids + fr.base_generated
@@ -549,6 +610,9 @@ class FleetRouter:
                     self.num_kv_ship_requests += 1
                     self.num_kv_ship_blocks += int(meta.get("blocks", 0))
                     self.num_kv_ship_bytes += len(payload)
+                    # the payload lived router-side (drain piggyback /
+                    # relay capture): those bytes crossed the router
+                    self.num_relay_bytes += len(payload)
                     self.num_tokens_recomputed += max(
                         0, len(prompt) - 1
                         - int(meta.get("tokens_covered", 0)))
@@ -557,6 +621,8 @@ class FleetRouter:
                     # capability missing): recompute on the same handle
                     self.num_recompute_fallbacks += 1
                 fr.kv = None  # consumed either way
+            elif fr.ship_src is not None:
+                shipped = self._ticket_ladder(fr, handle, prompt, now)
             if not shipped:
                 handle.add_request(rid, prompt,
                                    self._effective_sampling(fr, now),
@@ -740,22 +806,53 @@ class FleetRouter:
             # (no uncached headroom, draining) will refuse again soon
             self._shipped[(ch, dst.replica_id)] = now
             ok = False
-            kv = self._export_prefix_guarded(src, ch)
-            if kv is not None:
-                meta, payload = kv
-                ok = bool(dst.import_prefix(meta=meta, payload=payload))
-                if ok:
+            ticket = None
+            # prefix ships walk the same ladder as KV ships: peer-push
+            # first (payload never touches the router), router-relay as
+            # the fallback, "stay cold" as the harmless floor
+            if (cfg.peer_data_plane
+                    and getattr(dst, "peer_endpoint", None)):
+                ticket = self._issue_ticket(
+                    src, dst, "prefix", ch, cfg.peer_deadline_s * 1e3)
+                receipt = src.peer_send(ticket, dst.peer_endpoint)
+                if receipt is not None and dst.peer_commit(
+                        ticket["ticket_id"], kind="prefix"):
+                    nbytes = int(receipt.get("bytes", 0))
                     self.num_prefix_ships += 1
-                    self.num_prefix_ship_bytes += len(payload)
-                    # optimistic advert update so affinity can use the
-                    # shipped prefix before the next heartbeat confirms
+                    self.num_prefix_ship_bytes += nbytes
+                    self.num_peer_ship_bytes += nbytes
                     adv = self._adverts.setdefault(
-                        dst.replica_id,
-                        {"bs": meta.get("block_size"), "n": 0, "h": {}})
-                    if adv.get("bs") == meta.get("block_size"):
-                        adv["h"][ch] = len(meta.get("tokens", ()))
+                        dst.replica_id, {"bs": None, "n": 0, "h": {}})
+                    adv["h"][ch] = int(receipt.get("tokens", 0))
+                    ok = True
+                    self.ticket_outcomes["peer"] += 1
+            if not ok:
+                kv = self._export_prefix_guarded(src, ch)
+                if kv is not None:
+                    meta, payload = kv
+                    ok = bool(dst.import_prefix(meta=meta,
+                                                payload=payload))
+                    if ok:
+                        self.num_prefix_ships += 1
+                        self.num_prefix_ship_bytes += len(payload)
+                        self.num_relay_bytes += len(payload)
+                        if ticket is not None:
+                            self.num_relay_fallbacks += 1
+                            self.ticket_outcomes["relay"] += 1
+                        # optimistic advert update so affinity can use
+                        # the shipped prefix before a heartbeat confirms
+                        adv = self._adverts.setdefault(
+                            dst.replica_id,
+                            {"bs": meta.get("block_size"), "n": 0,
+                             "h": {}})
+                        if adv.get("bs") == meta.get("block_size"):
+                            adv["h"][ch] = len(meta.get("tokens", ()))
             if not ok:
                 self.num_prefix_ship_failures += 1
+                if ticket is not None:
+                    # a ticketed prefix ship has no recompute rung —
+                    # the destination just stays cold
+                    self.ticket_outcomes["cold"] += 1
 
     def _effective_sampling(self, fr: _FleetRequest,
                             now: float) -> SamplingParams:
@@ -787,13 +884,16 @@ class FleetRouter:
         return getattr(handle, "role", None)
 
     def _export_kv_guarded(self, handle: ReplicaHandle, request_id: str,
-                           *, expected: bool):
+                           *, expected: bool,
+                           count_fallback: bool = True):
         """``export_kv`` with the ``fleet.kv_ship_*`` fault points
         applied. Returns ``(meta, payload)`` or None — None means the
         next dispatch resumes by recompute. ``expected`` marks exports
         that SHOULD succeed (prefill just completed), so a bare failure
         counts as a recompute fallback; a drain export of a request
-        that never ran has nothing to ship and is not a fallback."""
+        that never ran has nothing to ship and is not a fallback.
+        ``count_fallback=False`` leaves ALL fallback accounting to the
+        caller (the ticket ladder does its own single-point counting)."""
         for arg in faults.check("fleet.kv_ship_delay"):
             time.sleep(float(arg) if arg else 0.01)
         try:
@@ -805,7 +905,7 @@ class FleetRouter:
         if dropped:
             kv = None
         if kv is None:
-            if expected or dropped:
+            if count_fallback and (expected or dropped):
                 self.num_recompute_fallbacks += 1
             return None
         if faults.check("fleet.kv_ship_corrupt"):
@@ -823,23 +923,167 @@ class FleetRouter:
         """Prefill complete on a prefill-role replica: migrate the
         request to the decode side, shipping its committed KV blocks so
         the peer recomputes nothing. A planned transfer, not a failure
-        hand-off — it spends no hand-off budget; a failed export
+        hand-off — it spends no hand-off budget; a failed export/park
         degrades to resume-by-recompute and the request migrates
-        anyway."""
+        anyway.
+
+        With the peer data plane on, the SOURCE parks the gathered
+        bytes host-side (surviving the engine-side release) and the
+        payload moves worker→worker at the next dispatch's ticket
+        ladder; otherwise — or when the handle cannot park — the bytes
+        are captured router-side as before (the relay path)."""
+        now = time.monotonic()
+        if fr.deadline_abs is not None and now >= fr.deadline_abs:
+            # expire-before-ship guard: don't gather/park/ship KV for
+            # a request that cannot finish in time — surface expired
+            self.num_ship_skipped_expired += 1
+            handle.abort_request(fr.request_id)
+            handle.release_request(fr.request_id)
+            self._assigned.get(handle.replica_id, set()).discard(
+                fr.request_id)
+            self._finalize(fr, "expired", None, self._pending_outputs)
+            return
         state = handle.rng_state(fr.request_id)
         if state is not None:
             fr.rng_state = state
         fr.decode_bound = True
-        t0 = time.monotonic()
-        fr.kv = self._export_kv_guarded(handle, fr.request_id,
-                                        expected=True)
-        if fr.kv is not None:
-            self.kv_ship_time_s += time.monotonic() - t0
+        parked = None
+        if self.cfg.peer_data_plane:
+            try:
+                parked = handle.park_kv(fr.request_id)
+            except (KeyError, ValueError, OSError):
+                parked = None
+        if parked:
+            fr.ship_src = handle.replica_id
+        else:
+            t0 = time.monotonic()
+            fr.kv = self._export_kv_guarded(handle, fr.request_id,
+                                            expected=True)
+            if fr.kv is not None:
+                self.kv_ship_time_s += time.monotonic() - t0
         handle.abort_request(fr.request_id)
         handle.release_request(fr.request_id)
         self._assigned.get(handle.replica_id, set()).discard(
             fr.request_id)
         self._requeue(fr, count_handoff=False)
+
+    # -- peer data plane (ticketed transfers) ------------------------------
+    def _issue_ticket(self, src: ReplicaHandle, dst: ReplicaHandle,
+                      kind: str, ref: str, deadline_ms: float) -> dict:
+        """Mint one signed transfer ticket. The router never touches
+        the payload — the ticket is the entire control-plane cost."""
+        from paddle_tpu.serving.fleet.transport import sign_ticket
+        ticket = {"ticket_id": f"tkt-{next(self._ticket_seq)}",
+                  "src": src.replica_id, "dst": dst.replica_id,
+                  "kind": kind, "deadline_ms": int(max(1, deadline_ms))}
+        ticket["request_id" if kind == "kv" else "chain_hash"] = ref
+        ticket["sig"] = sign_ticket(ticket)
+        self.num_tickets_issued += 1
+        return ticket
+
+    def _rung_deadline_ms(self, fr: _FleetRequest, now: float) -> float:
+        """Per-rung deadline from the request's remaining budget,
+        capped at ``peer_deadline_s``. A third of what remains, so a
+        peer rung that eats its whole deadline still leaves room for
+        the relay and recompute rungs below it."""
+        cap = self.cfg.peer_deadline_s * 1e3
+        if fr.deadline_abs is None:
+            return cap
+        remaining = max(0.0, (fr.deadline_abs - now) * 1e3)
+        return max(1.0, min(cap, remaining / 3.0))
+
+    def _drop_pending_ship(self, fr: _FleetRequest) -> None:
+        """Abandon a request's pending KV transfer: release the
+        source-side parked snapshot and the router-side capture. Safe
+        on any request (no-op when nothing is pending)."""
+        if fr.ship_src is not None:
+            src = self._by_id(fr.ship_src)
+            if src is not None and src.alive:
+                src.drop_parked(fr.request_id)
+            fr.ship_src = None
+        fr.kv = None
+
+    def _ticket_ladder(self, fr: _FleetRequest, dst: ReplicaHandle,
+                       prompt: List[int], now: float) -> bool:
+        """Move a parked KV snapshot from ``fr.ship_src`` into ``dst``
+        down the degradation ladder: peer-push → router-relay →
+        recompute. Exactly one attempt per rung, exactly one counted
+        outcome per issued ticket; returns True when the destination
+        admitted the continuation (peer or relay), False for recompute
+        (the caller falls through to a plain ``add_request``).
+
+        Ambiguity safety: a timed-out ``peer_send`` leaves the source
+        alive (the destination's ticket-id idempotence absorbs a late
+        or duplicate delivery, and an uncommitted staged payload is
+        GC'd at its deadline); a timed-out ``peer_commit`` marks the
+        DESTINATION dead, which is exactly what keeps its possibly-
+        admitted continuation from ever emitting to the client."""
+        rid = fr.request_id
+        src = self._by_id(fr.ship_src)
+        fr.ship_src = None  # consumed: one ladder walk per park
+        sampling = self._effective_sampling(fr, now)
+        ticket: Optional[dict] = None
+        outcome: Optional[str] = None
+        receipt: Optional[dict] = None
+        if (self.cfg.peer_data_plane and src is not None and src.alive
+                and getattr(dst, "peer_endpoint", None)):
+            ticket = self._issue_ticket(
+                src, dst, "kv", rid, self._rung_deadline_ms(fr, now))
+            t0 = time.monotonic()
+            receipt = src.peer_send(ticket, dst.peer_endpoint)
+            if receipt is not None and dst.peer_commit(
+                    ticket["ticket_id"], kind="kv", request_id=rid,
+                    prompt_ids=prompt, sampling=sampling,
+                    rng_state=fr.rng_state):
+                self.kv_ship_time_s += time.monotonic() - t0
+                blocks = int(receipt.get("blocks", 0))
+                nbytes = int(receipt.get("bytes", 0))
+                self.num_peer_ship_requests += 1
+                self.num_peer_ship_blocks += blocks
+                self.num_peer_ship_bytes += nbytes
+                self.num_kv_ship_requests += 1
+                self.num_kv_ship_blocks += blocks
+                self.num_kv_ship_bytes += nbytes
+                self.num_tokens_recomputed += max(
+                    0, len(prompt) - 1
+                    - int(receipt.get("tokens_covered", 0)))
+                outcome = "peer"
+        if outcome is None and src is not None and src.alive \
+                and dst.alive:
+            # router-relay rung: the pre-peer path, kept as fallback —
+            # the parked snapshot answers the export even though the
+            # source engine already released the request
+            t0 = time.monotonic()
+            kv = self._export_kv_guarded(src, rid, expected=True,
+                                         count_fallback=False)
+            if kv is not None:
+                meta, payload = kv
+                if dst.import_kv(rid, prompt, sampling, meta=meta,
+                                 payload=payload,
+                                 rng_state=fr.rng_state):
+                    self.kv_ship_time_s += time.monotonic() - t0
+                    self.num_kv_ship_requests += 1
+                    self.num_kv_ship_blocks += int(meta.get("blocks", 0))
+                    self.num_kv_ship_bytes += len(payload)
+                    self.num_relay_bytes += len(payload)
+                    self.num_tokens_recomputed += max(
+                        0, len(prompt) - 1
+                        - int(meta.get("tokens_covered", 0)))
+                    if ticket is not None:
+                        self.num_relay_fallbacks += 1
+                        outcome = "relay"
+                    else:
+                        outcome = "direct"
+        if outcome is None:
+            self.num_recompute_fallbacks += 1
+            outcome = "recompute"
+        if src is not None and src.alive:
+            src.drop_parked(rid)
+        if ticket is not None:
+            # "direct" can't occur with a ticket: a ticketed walk ends
+            # peer | relay | recompute — the accounting partition
+            self.ticket_outcomes[outcome] += 1
+        return outcome in ("peer", "relay", "direct")
 
     def _role_candidates(self, cands: List[ReplicaHandle],
                          fr: _FleetRequest) -> List[ReplicaHandle]:
@@ -918,6 +1162,7 @@ class FleetRouter:
     def _finalize(self, fr: _FleetRequest, reason: Optional[str],
                   token: Optional[int],
                   outputs: List[RequestOutput]) -> None:
+        self._drop_pending_ship(fr)  # no KV snapshot outlives its request
         fr.finished = True
         fr.finish_reason = reason
         if reason is not None:

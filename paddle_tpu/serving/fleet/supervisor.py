@@ -49,7 +49,7 @@ from typing import Dict, List, Optional
 from paddle_tpu.distributed.replica_registry import ReplicaRegistry
 from paddle_tpu.distributed.store import FileStore
 from paddle_tpu.serving.fleet.transport import (
-    ReplicaGone, RpcClient, RpcError, SubprocessReplica,
+    ReplicaGone, RpcClient, RpcError, SubprocessReplica, peer_secret,
 )
 
 __all__ = ["WorkerSpec", "SupervisorConfig", "ReplicaSupervisor"]
@@ -67,6 +67,10 @@ class WorkerSpec:
     # The worker advertises it in its registry heartbeat meta, so a
     # router re-learns roles after a supervisor restart
     role: Optional[str] = None
+    # peer data plane: open a PeerListener in each worker and advertise
+    # its endpoint (heartbeat meta + ping reply). False pins the fleet
+    # to the router-relay path — the bench comparison knob.
+    peer: bool = True
 
 
 @dataclass
@@ -163,6 +167,10 @@ class ReplicaSupervisor:
         env["PADDLE_REPLICA_STORE"] = self.cfg.store_dir
         env["PADDLE_REPLICA_HB"] = str(self.cfg.hb_interval_s)
         env["PADDLE_REPLICA_TTL"] = str(self.cfg.ttl_s)
+        if self.spec.peer:
+            # mint the fleet-shared ticket secret BEFORE the fork so
+            # the worker inherits it (peer_secret() is env-idempotent)
+            peer_secret()
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving.fleet.worker"],
             env=env, pass_fds=[child.fileno()])
@@ -173,12 +181,16 @@ class ReplicaSupervisor:
                                    deadlines=self.cfg.deadlines,
                                    role=role)
         try:
-            client.call("ping", deadline_s=self.cfg.spawn_timeout_s)
+            pong = client.call("ping", deadline_s=self.cfg.spawn_timeout_s)
         except (RpcError, OSError) as e:
             client.close()
             proc.kill()
             proc.wait(timeout=10)
             raise RuntimeError(f"worker {rid} failed to boot: {e}")
+        if isinstance(pong, dict) and pong.get("peer"):
+            # first sight of the worker's peer endpoint; the registry
+            # heartbeat meta keeps it fresh after router restarts
+            handle.peer_endpoint = pong["peer"]
         slot.proc, slot.handle = proc, handle
         self.num_spawns += 1
         return handle

@@ -42,21 +42,39 @@ Fault points (client side, ``PADDLE_FAULTS``): ``fleet.rpc_delay``
 call times out). ``fleet.worker_kill`` lives in the router and
 SIGKILLs a worker via :meth:`SubprocessReplica.hard_kill`.
 
+Peer data plane (ISSUE 15): :class:`PeerListener` is the worker-side
+listening end of the direct worker↔worker KV channel; :func:`peer_push`
+is the pushing end, ticketed and HMAC-signed by the router
+(:func:`sign_ticket`). The listener is a pure staging area — it never
+touches the engine; the actual import happens on the worker's
+single-threaded service loop via the ``peer_commit`` verb. Peer-path
+fault points: ``fleet.peer_connect_fail``, ``fleet.peer_send_drop``,
+``fleet.peer_frame_corrupt`` (``flag``) and ``fleet.peer_stall``
+(``sleep:<s>`` — stalls the push against its ticket deadline).
+
 Threading (lockcheck-audited): the client is single-caller — the
 router thread issues calls; one daemon reader thread completes them
 through a pending table. ``_lock`` guards ONLY the table and the
-closed flag; no socket IO ever happens under it.
+closed flag; no socket IO ever happens under it. The peer listener's
+accept thread follows the same rule: its ``_lock`` guards only the
+staging inbox, committed-set and counters.
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac
 import json
 import logging
+import os
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.serving.fleet.replica import ReplicaHandle, ReplicaLoad
 from paddle_tpu.serving.request import RequestOutput, SamplingParams
@@ -67,6 +85,7 @@ __all__ = [
     "RpcClient", "ReplicaServicer", "SubprocessReplica",
     "send_frame", "recv_frame", "send_frame_with_blob",
     "IDEMPOTENT_METHODS", "DEFAULT_DEADLINES",
+    "PeerListener", "peer_push", "peer_secret", "sign_ticket",
 ]
 
 _log = logging.getLogger(__name__)
@@ -141,6 +160,233 @@ def recv_frame(sock: socket.socket) -> Optional[Any]:
     return msg
 
 
+# -- peer data plane -------------------------------------------------------
+# Workers push KV payloads straight to each other; the router only
+# issues small signed tickets and collects acks. A ticket is a dict
+# {ticket_id, src, dst, kind: "kv"|"prefix", request_id|chain_hash,
+#  deadline_ms, sig} — the signature keeps a confused or stale source
+# from staging bytes at a destination the router never paired it with.
+
+_SECRET_ENV = "PADDLE_PEER_SECRET"
+
+
+def peer_secret() -> bytes:
+    """Fleet-shared ticket-signing secret. First use in the router/
+    supervisor process mints one into the environment, and worker
+    subprocesses inherit it through ``Popen`` — no extra plumbing, and
+    every party derives the same HMAC key."""
+    tok = os.environ.get(_SECRET_ENV)
+    if not tok:
+        tok = uuid.uuid4().hex
+        os.environ[_SECRET_ENV] = tok
+    return tok.encode()
+
+
+def sign_ticket(ticket: dict, secret: Optional[bytes] = None) -> str:
+    """HMAC-SHA256 over the ticket's canonical JSON (sans ``sig``)."""
+    blob = json.dumps({k: v for k, v in ticket.items() if k != "sig"},
+                      sort_keys=True).encode()
+    return hmac.new(secret or peer_secret(), blob,
+                    hashlib.sha256).hexdigest()
+
+
+def _ticket_ok(ticket: dict, secret: bytes) -> bool:
+    sig = ticket.get("sig")
+    return isinstance(sig, str) and hmac.compare_digest(
+        sig, sign_ticket(ticket, secret))
+
+
+class PeerListener:
+    """Worker-side receiving end of the peer data plane.
+
+    A daemon accept-loop thread stages ticketed frames into a bounded
+    inbox; it NEVER touches the engine (which is not thread-safe). The
+    worker's single-threaded service loop later pops a staged payload
+    with :meth:`take` when the router sends ``peer_commit`` — only then
+    do bytes reach the engine. Consequences:
+
+    * duplicate delivery of a ticket is an idempotent no-op (the
+      committed-set remembers ticket ids);
+    * a ticket whose commit never arrives (router restart, src/dst
+      death mid-transfer) is garbage-collected at its deadline — the
+      destination provably holds no blocks for it, because staged bytes
+      are host memory, not engine blocks;
+    * CRC and signature are checked at the door, so a corrupt or forged
+      frame is refused in the receipt and the source reports the rung
+      dead immediately.
+
+    ``_lock`` guards the inbox, committed-set and counters only; all
+    socket IO happens outside it (same discipline as ``RpcClient``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", *,
+                 secret: Optional[bytes] = None, max_entries: int = 8,
+                 max_bytes: int = 4 * MAX_FRAME,
+                 io_timeout_s: float = 30.0):
+        self._secret = secret or peer_secret()
+        self._max_entries = max_entries
+        self._max_bytes = max_bytes
+        self._io_timeout_s = io_timeout_s
+        self._sock = socket.create_server((host, 0))
+        self.endpoint = "%s:%d" % (host, self._sock.getsockname()[1])
+        self._lock = threading.Lock()  # inbox + done-set + stats only
+        # ticket_id -> (expires_mono, ticket, meta, payload). Expiry is
+        # measured from RECEIPT (deadline_ms is a duration, not a wall
+        # timestamp) so src/dst clock skew can't pin an orphan forever.
+        self._inbox: Dict[str, Tuple[float, dict, dict, bytes]] = {}
+        self._inbox_bytes = 0
+        self._done: Dict[str, bool] = {}  # committed/taken ticket ids
+        self._stats = {"received": 0, "refused": 0, "duplicates": 0,
+                       "orphans_gcd": 0}
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True,
+            name=f"peer-listener-{self.endpoint}")
+        self._thread.start()
+
+    # -- accept thread -----------------------------------------------------
+    def _serve_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                self._serve_one(conn)
+            except (OSError, ValueError):
+                pass  # torn push: nothing staged, source sees the error
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        conn.settimeout(self._io_timeout_s)
+        msg = recv_frame(conn)
+        if not isinstance(msg, dict):
+            return
+        receipt = self._admit(dict(msg.get("ticket") or {}),
+                              dict(msg.get("meta") or {}),
+                              msg.get("_blob", b""))
+        send_frame(conn, receipt)
+
+    def _admit(self, ticket: dict, meta: dict, payload: bytes) -> dict:
+        tid = ticket.get("ticket_id")
+        if not tid or not _ticket_ok(ticket, self._secret):
+            with self._lock:
+                self._stats["refused"] += 1
+            return {"ok": False, "error": "bad ticket signature"}
+        if zlib.crc32(payload) != int(meta.get("crc32", -1)):
+            with self._lock:
+                self._stats["refused"] += 1
+            return {"ok": False, "error": "payload checksum mismatch"}
+        expires = time.monotonic() + float(
+            ticket.get("deadline_ms", 30e3)) / 1e3
+        self.gc()  # expired entries never block a fresh admission
+        with self._lock:
+            if tid in self._done or tid in self._inbox:
+                self._stats["duplicates"] += 1
+                return {"ok": True, "duplicate": True}
+            if (len(self._inbox) >= self._max_entries
+                    or self._inbox_bytes + len(payload) > self._max_bytes):
+                self._stats["refused"] += 1
+                return {"ok": False, "error": "staging inbox full"}
+            self._inbox[tid] = (expires, ticket, meta, payload)
+            self._inbox_bytes += len(payload)
+            self._stats["received"] += 1
+        return {"ok": True}
+
+    # -- service-loop side -------------------------------------------------
+    def take(self, ticket_id: str):
+        """Pop a staged ``(ticket, meta, payload)`` for commit, or None
+        if it was never delivered / already committed / GC'd. Taking
+        marks the ticket done, so a late duplicate delivery after the
+        commit stays a no-op."""
+        self.gc()
+        with self._lock:
+            ent = self._inbox.pop(ticket_id, None)
+            if ent is None:
+                return None
+            self._inbox_bytes -= len(ent[3])
+            self._done[ticket_id] = True
+            while len(self._done) > 1024:  # bounded duplicate memory
+                self._done.pop(next(iter(self._done)))
+        return ent[1], ent[2], ent[3]
+
+    def gc(self) -> int:
+        """Drop expired staged entries (orphaned tickets); returns the
+        number collected. Called from the worker's service-loop tick."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [tid for tid, ent in self._inbox.items()
+                    if ent[0] <= now]
+            for tid in dead:
+                ent = self._inbox.pop(tid)
+                self._inbox_bytes -= len(ent[3])
+                self._done[tid] = True
+                while len(self._done) > 1024:
+                    self._done.pop(next(iter(self._done)))
+                self._stats["orphans_gcd"] += 1
+        return len(dead)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["staged"] = len(self._inbox)
+            out["staged_bytes"] = self._inbox_bytes
+        return out
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._inbox)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()  # accept loop exits on the OSError
+        except OSError:
+            pass
+
+
+def peer_push(endpoint: str, ticket: dict, meta: dict, payload: bytes,
+              *, timeout_s: float = 30.0) -> dict:
+    """Source-side push of one ticketed frame to a peer listener.
+
+    Returns the listener's receipt dict (``{"ok": ...}``); raises
+    OSError/ValueError on any transport failure. One attempt, no
+    retry — a torn or timed-out push is a dead rung, and the ladder
+    above decides what happens next. Fault points:
+    ``fleet.peer_connect_fail`` / ``fleet.peer_send_drop`` /
+    ``fleet.peer_frame_corrupt`` (flags) and ``fleet.peer_stall``
+    (sleep action — a stall that outlives ``timeout_s`` fails the
+    push before any bytes move)."""
+    t0 = time.monotonic()
+    if faults.check("fleet.peer_connect_fail"):
+        raise OSError(f"peer connect to {endpoint} refused (injected)")
+    faults.fire("fleet.peer_stall")
+    if faults.check("fleet.peer_send_drop"):
+        raise OSError(f"peer frame to {endpoint} dropped (injected)")
+    if faults.check("fleet.peer_frame_corrupt") and payload:
+        buf = bytearray(payload)
+        buf[0] ^= 0xFF  # CRC refusal at the listener's door
+        payload = bytes(buf)
+    remaining = timeout_s - (time.monotonic() - t0)
+    if remaining <= 0:
+        raise OSError(
+            f"peer push to {endpoint} stalled past its "
+            f"{timeout_s:g}s deadline before connecting")
+    host, _, port = endpoint.rpartition(":")
+    with socket.create_connection((host, int(port)),
+                                  timeout=remaining) as s:
+        s.settimeout(max(0.05, timeout_s - (time.monotonic() - t0)))
+        send_frame_with_blob(s, {"ticket": dict(ticket),
+                                 "meta": dict(meta)}, payload)
+        receipt = recv_frame(s)
+    if not isinstance(receipt, dict):
+        raise OSError(f"peer receipt from {endpoint} lost")
+    return receipt
+
+
 # -- errors ----------------------------------------------------------------
 class RpcError(RuntimeError):
     """Base transport failure."""
@@ -179,6 +425,7 @@ DEFAULT_DEADLINES: Dict[str, float] = {
     "step": 600.0, "start_drain": 600.0,
     "export_kv": 120.0, "import_kv": 120.0,
     "export_prefix": 120.0, "import_prefix": 120.0,
+    "park_kv": 120.0, "peer_send": 120.0, "peer_commit": 120.0,
 }
 
 
@@ -210,19 +457,28 @@ class RpcClient:
     def __init__(self, sock: socket.socket, *,
                  default_deadline_s: float = 30.0, retries: int = 2,
                  backoff_base_s: float = 0.05,
-                 backoff_max_s: float = 1.0, name: str = "replica"):
+                 backoff_max_s: float = 1.0, name: str = "replica",
+                 jitter_seed: Optional[int] = None):
         self._sock = sock
         self.default_deadline_s = default_deadline_s
         self.retries = retries
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        # decorrelated-jitter retry backoff: after the first (base)
+        # sleep, each further retry sleeps uniform(base, prev*3) capped
+        # at backoff_max_s — N clients retrying after a router restart
+        # fan out instead of reconnecting in lockstep. Seedable so
+        # tests can pin the exact schedule.
+        self._jitter = random.Random(jitter_seed)
         self._lock = threading.Lock()  # pending table + closed flag only
         self._pending: Dict[int, _Call] = {}
         self._next_seq = 0
         self._closed = False
-        # wire-overhead accounting for bench (single-caller, no lock)
-        self.stats = {"calls": 0, "retries": 0, "timeouts": 0,
-                      "rpc_time_s": 0.0}
+        # wire-overhead accounting for bench (single-caller, no lock);
+        # "backoffs" records every retry sleep for the jitter tests
+        self.stats: Dict[str, Any] = {
+            "calls": 0, "retries": 0, "timeouts": 0, "rpc_time_s": 0.0,
+            "backoffs": []}
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
             name=f"rpc-reader-{name}")
@@ -279,8 +535,11 @@ class RpcClient:
         for attempt in range(attempts):
             if attempt:
                 self.stats["retries"] += 1
+                self.stats["backoffs"].append(delay)
                 time.sleep(delay)
-                delay = min(delay * 2.0, self.backoff_max_s)
+                delay = min(self._jitter.uniform(
+                    self.backoff_base_s, delay * 3.0),
+                    self.backoff_max_s)
             try:
                 return self._call_once(method, params or {}, deadline_s,
                                        blob)
@@ -486,7 +745,9 @@ class ReplicaServicer:
     def _dispatch(self, method: str, p: dict) -> Any:
         r = self.replica
         if method == "ping":
-            return {"replica_id": r.replica_id, "alive": bool(r.alive)}
+            return {"replica_id": r.replica_id, "alive": bool(r.alive),
+                    "peer": getattr(r, "peer_endpoint", None),
+                    "role": getattr(r, "role", None)}
         if method == "admission_verdict":
             return r.admission_verdict(int(p["prompt_tokens"]))
         if method == "estimated_ttft_ms":
@@ -553,6 +814,21 @@ class ReplicaServicer:
                 return False
             return bool(imp(meta=p["meta"],
                             payload=p.get("_blob", b"")))
+        if method == "park_kv":
+            return r.park_kv(p["request_id"])
+        if method == "drop_parked":
+            r.drop_parked(p["request_id"])
+            return True
+        if method == "peer_send":
+            return r.peer_send(dict(p["ticket"]), p["endpoint"])
+        if method == "peer_commit":
+            sp = p.get("sampling")
+            return bool(r.peer_commit(
+                p["ticket_id"], kind=p.get("kind", "kv"),
+                request_id=p.get("request_id"),
+                prompt_ids=[int(t) for t in p.get("prompt_ids") or []],
+                sampling=SamplingParams(**sp) if sp else None,
+                rng_state=p.get("rng_state")))
         if method == "shutdown":
             return True
         raise RpcError(f"unknown method {method!r}")
@@ -770,6 +1046,76 @@ class SubprocessReplica(ReplicaHandle):
                          if k not in ("off", "len")},
                 "rng_state": rng_state}, blob=payload))
         except ValueError:
+            return False
+
+    # -- peer data plane ---------------------------------------------------
+    def park_kv(self, request_id: str) -> Optional[dict]:
+        """Ask the worker to gather a request's committed KV to host
+        memory and hold it for a later ticketed transfer. Mutation
+        semantics (the stash is replica-side state); a clean remote
+        refusal returns None with the replica alive."""
+        if not self.alive:
+            return None
+        try:
+            res = self._mutate("park_kv", {"request_id": request_id})
+        except (ValueError, KeyError):
+            return None
+        return res if isinstance(res, dict) else None
+
+    def drop_parked(self, request_id: str) -> None:
+        if self.alive:
+            try:
+                self._mutate("drop_parked", {"request_id": request_id})
+            except (ValueError, KeyError):
+                pass
+
+    def peer_send(self, ticket: dict, endpoint: str) -> Optional[dict]:
+        """Tell the worker to push its parked/exported payload for this
+        ticket straight to ``endpoint``. One attempt. An ``RpcTimeout``
+        here means the RUNG died, not the replica — the worker's
+        service thread was blocked pushing against a slow or dead PEER,
+        and the destination-side ticket idempotence makes the ambiguity
+        safe — so the source is NOT marked dead. A torn connection or
+        an unexpected remote error still is."""
+        if not self.alive:
+            return None
+        deadline = (float(ticket.get("deadline_ms", 30e3)) / 1e3
+                    + self._deadline("peer_send"))
+        try:
+            res = self._client.call(
+                "peer_send", {"ticket": dict(ticket),
+                              "endpoint": endpoint},
+                idempotent=False, deadline_s=deadline)
+        except RpcTimeout:
+            return None
+        except (ReplicaGone, RpcRemoteError, OSError):
+            self._dead = True
+            return None
+        except (ValueError, KeyError):
+            return None
+        return res if isinstance(res, dict) else None
+
+    def peer_commit(self, ticket_id: str, *, kind: str = "kv",
+                    request_id: Optional[str] = None,
+                    prompt_ids: Optional[Sequence[int]] = None,
+                    sampling: Optional[SamplingParams] = None,
+                    rng_state=None) -> bool:
+        """Commit a staged peer delivery into the destination engine.
+        Full mutation semantics: a lost reply marks the destination
+        dead (which is exactly what keeps an ambiguous commit from
+        ever producing a duplicate emission); a clean remote refusal
+        crosses back as ValueError -> False, replica alive."""
+        if not self.alive:
+            return False
+        params = {"ticket_id": ticket_id, "kind": kind,
+                  "request_id": request_id,
+                  "prompt_ids": [int(t) for t in prompt_ids or []],
+                  "sampling": (dataclasses.asdict(sampling)
+                               if sampling is not None else None),
+                  "rng_state": rng_state}
+        try:
+            return bool(self._mutate("peer_commit", params))
+        except (ValueError, KeyError):
             return False
 
     # -- fleet prefix cache ------------------------------------------------

@@ -14,7 +14,12 @@ Env protocol (set by :class:`ReplicaSupervisor`):
 
         {"model": "tiny_llama" | "pkg.module:factory",
          "seed": 0, "engine": {...EngineConfig kwargs...},
-         "role": "prefill" | "decode" | null}
+         "role": "prefill" | "decode" | null,
+         "peer": true | false}
+
+    ``peer`` (default true) opens the worker's :class:`PeerListener`
+    — the direct worker↔worker KV data plane — and advertises its
+    endpoint in the heartbeat meta next to the role.
 
     ``tiny_llama`` builds the deterministic tiny-Llama every fleet
     test uses (``paddle.seed(seed)`` then ``LlamaConfig.tiny()`` — the
@@ -35,12 +40,15 @@ itself only sets the monitor flag (the PR-9 lockcheck rule: no work in
 signal handlers); the engine starts its drain at the next ``step``
 RPC and the aborts ride back to the router with their RNG states.
 
-Threading: the service loop is single-threaded. The one extra thread
-heartbeats the registry and shares NO engine state with the service
-loop — only the stop event and the lock-guarded :class:`_HeartbeatMeta`
-box the service loop publishes its prefix digest into after each
-reply, so a heartbeat can never observe a half-stepped engine (and
-lockcheck agrees).
+Threading: the service loop is single-threaded. Two extra daemon
+threads exist, neither of which touches the engine: the registry
+heartbeat (sharing only the stop event and the lock-guarded
+:class:`_HeartbeatMeta` box the service loop publishes its prefix
+digest into after each reply) and the peer listener's accept loop
+(staging inbound KV frames behind its own lock until the router's
+``peer_commit`` verb imports them ON the service loop). A heartbeat
+can never observe a half-stepped engine, and a peer delivery can
+never race one (and lockcheck agrees).
 """
 from __future__ import annotations
 
@@ -154,17 +162,29 @@ def main() -> int:
         hb_meta.update(role=role)
     hb_meta.update(prefix=replica.prefix_digest())
 
+    # peer data plane: open the worker's listener (a second daemon
+    # thread — pure staging, never touches the engine; see PeerListener)
+    # and advertise its endpoint next to the role, so the router learns
+    # where to ticket KV pushes even across its own restarts.
+    if spec.get("peer", True):
+        try:
+            hb_meta.update(peer=replica.start_peer())
+        except OSError:
+            pass  # no listener — the router relays, as before
+
     hb_stop = None
-    publish_digest = None
     if store_dir:
         hb_stop = _start_heartbeat(replica_id, store_dir, hb_interval,
                                    ttl_s, meta=hb_meta)
 
-        def publish_digest() -> None:
+    def on_tick() -> None:
+        if store_dir:
             # service-loop side of the advertisement: refresh the
             # digest after each reply (O(1) between trie changes); the
             # next beat carries it to the registry
             hb_meta.update(prefix=replica.prefix_digest())
+        if replica.peer_listener is not None:
+            replica.peer_listener.gc()  # orphan-ticket sweep
 
     def drained_out() -> bool:
         # SIGTERM path: the drain aborts (with RNG states) went out in
@@ -173,7 +193,7 @@ def main() -> int:
                 and not replica.has_unfinished())
 
     try:
-        ReplicaServicer(replica, on_tick=publish_digest).serve(
+        ReplicaServicer(replica, on_tick=on_tick).serve(
             sock, should_stop=drained_out)
     finally:
         if hb_stop is not None:

@@ -23,7 +23,12 @@ worker where to die. Spec grammar (specs separated by ``;``)::
 ``fleet.kill_replica`` / ``fleet.drain_replica`` / ``fleet.slow_replica``
 / ``fleet.worker_kill`` — queried once per step — ``@skip`` counts
 steps; the fleet transport's ``fleet.rpc_delay`` / ``fleet.rpc_drop``
-fire once per RPC attempt, so ``@skip`` counts calls).
+fire once per RPC attempt, so ``@skip`` counts calls; the peer data
+plane's ``fleet.peer_connect_fail`` / ``fleet.peer_send_drop`` /
+``fleet.peer_frame_corrupt`` / ``fleet.peer_stall`` fire once per
+``peer_push`` attempt; ``serving.kv_scatter`` fires inside the engine's
+KV/prefix import between block allocation and scatter — ``raise`` there
+exercises the partial-failure cleanup path).
 Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
 the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
 ``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test

@@ -22,6 +22,13 @@
 #                            KV-block shipping prefill→decode, a real
 #                            SIGKILL of a decode worker mid-run; token
 #                            parity + ship counters; ~2 min)
+#   scripts/ci.sh --peer     peer data plane smoke only (2 prefill +
+#                            2 decode subprocess workers, KV shipped
+#                            worker↔worker under signed tickets, a real
+#                            SIGKILL of a destination decode worker;
+#                            asserts peer_ship_bytes > 0, ZERO router
+#                            relay bytes in steady state, exact ticket
+#                            accounting, and token parity; ~2 min)
 #   scripts/ci.sh --prefix   fleet prefix-cache smoke only (2 tiny
 #                            replicas, shared-prefix workload; asserts
 #                            a proactive hot-prefix ship, a positive
@@ -102,6 +109,18 @@ run_disagg() {
 
 if [[ "${1:-}" == "--disagg" ]]; then
     run_disagg
+    exit 0
+fi
+
+run_peer() {
+    echo "== peer smoke =="
+    # 600s: four worker processes each build a model before first ping
+    timeout -k 10 600 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/peer_smoke.py
+}
+
+if [[ "${1:-}" == "--peer" ]]; then
+    run_peer
     exit 0
 fi
 

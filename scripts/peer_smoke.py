@@ -1,0 +1,127 @@
+"""Peer data plane smoke: ticketed worker↔worker KV over real processes.
+
+The ``scripts/ci.sh --peer`` stage. A :class:`ReplicaSupervisor`
+spawns 2 PREFILL + 2 DECODE worker processes with peer listeners on; 8
+sampled requests go in. Every request prefills on a prefill worker,
+whose KV blocks move STRAIGHT to a decode worker's peer listener under
+a router-issued signed ticket — the router carries only the ticket and
+the commit verb, never the payload. Mid-run one DECODE worker takes a
+real ``SIGKILL``; its continuations fall back down the ladder on the
+survivors. Asserts:
+
+* token streams bit-identical to an uninterrupted single-engine
+  reference (sampled, so RNG state rode the ticketed ship correctly);
+* ``fleet/peer_ship_bytes`` > 0 and, pre-kill (steady state), router
+  relay bytes == 0 — ZERO KV payload bytes through the router;
+* every issued ticket is accounted:
+  ``sum(ticket_outcomes) == tickets_issued``;
+* exactly one replica died and the fleet still converged.
+
+Exit 0 on success; any broken invariant raises.
+"""
+import os
+import signal
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetRouter, ReplicaSupervisor, SupervisorConfig, WorkerSpec,
+)
+
+_ENGINE = dict(block_size=4, max_num_seqs=8, max_model_len=64,
+               drain_grace_s=0.0)
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+
+    rng = np.random.default_rng(47)
+    prompts = [list(map(int, rng.integers(
+        0, model.config.vocab_size, size=5 + i % 4)))
+        for i in range(8)]
+    sp = SamplingParams(max_new_tokens=8, temperature=0.8, top_p=0.9)
+    ids = [f"p{i}" for i in range(8)]
+
+    # uninterrupted single-engine reference (worker twins: seed 0)
+    eng = LLMEngine(model, EngineConfig(**_ENGINE))
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    ref = {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+    sup = ReplicaSupervisor(
+        WorkerSpec(model="tiny_llama", seed=0, engine=dict(_ENGINE),
+                   peer=True),
+        SupervisorConfig(
+            store_dir=tempfile.mkdtemp(prefix="peer_smoke_hb_")))
+    try:
+        handles = ([sup.spawn(role="prefill") for _ in range(2)]
+                   + [sup.spawn(role="decode") for _ in range(2)])
+        for h in handles:
+            assert h.peer_endpoint, f"{h.replica_id} has no peer endpoint"
+        router = FleetRouter(handles, registry=sup.registry)
+        sup.router = router
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        for _ in range(4):
+            router.step()        # prefills ticketed+pushed, decodes going
+        peer_pre_kill = router.num_peer_ship_requests
+        assert peer_pre_kill >= 1, "no peer ship before the kill"
+        # steady state: the payload NEVER touched the router
+        assert router.num_relay_bytes == 0, (
+            "router relayed KV bytes with the peer plane up",
+            router.num_relay_bytes)
+        assert router.num_tokens_recomputed == 0, (
+            "peer path recomputed prompt tokens",
+            router.num_tokens_recomputed)
+
+        victim = handles[2]            # first decode worker: a transfer
+        os.kill(victim.proc.pid, signal.SIGKILL)   # DESTINATION dies
+        steps = 0
+        while router.has_unfinished():
+            router.step()
+            steps += 1
+            assert steps < 500, "router failed to converge"
+
+        got = {rid: list(router.get_request(rid).generated)
+               for rid in ids}
+        assert got == ref, "peer token streams diverged from reference"
+        for rid in ids:
+            assert router.get_request(rid).finish_reason == "length"
+        assert victim.proc.wait(timeout=10) == -signal.SIGKILL
+        assert router.num_replicas_dead == 1
+        # the kill forced the ladder down at least one rung somewhere
+        assert (router.num_relay_fallbacks + router.num_recompute_fallbacks
+                + router.num_handoffs) >= 1, "kill left no fallback trace"
+        assert router.num_tickets_issued == \
+            sum(router.ticket_outcomes.values()), (
+            router.num_tickets_issued, router.ticket_outcomes)
+        snap = router.snapshot()
+        assert snap["fleet_peer_ship_bytes"] > 0, snap
+        print("PEER_SMOKE_OK peer_ships=%d peer_bytes=%d relay_bytes=%d "
+              "tickets=%d outcomes=%s recomputes=%d dead=%d"
+              % (snap["fleet_peer_ship_requests"],
+                 snap["fleet_peer_ship_bytes"],
+                 snap["fleet_relay_bytes"],
+                 snap["fleet_tickets_issued"],
+                 snap["fleet_ticket_outcomes"],
+                 snap["fleet_recompute_fallbacks"],
+                 snap["fleet_replicas_dead"]),
+              flush=True)
+    finally:
+        sup.shutdown()
+
+
+if __name__ == "__main__":
+    main()

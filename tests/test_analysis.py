@@ -1095,6 +1095,55 @@ class TestUnlockedSharedState:
         """)
         assert rules_of(fs) == ["unlocked-shared-state"]
 
+    def test_peer_listener_unlocked_inbox_flagged(self):
+        # the peer-listener concurrency root pattern (ISSUE 15): an
+        # accept-loop thread staging frames into an inbox dict the
+        # service loop pops from — unlocked, that's a real race
+        fs = run("""
+            import threading
+
+            class Listener:
+                def __init__(self):
+                    self._inbox = {}
+                    self._t = threading.Thread(target=self._serve,
+                                               daemon=True)
+                    self._t.start()
+
+                def _serve(self):
+                    while True:
+                        self._inbox = dict(self._inbox, t1=b"frame")
+
+                def take(self, ticket_id):
+                    return self._inbox.pop(ticket_id, None)
+        """)
+        assert rules_of(fs) == ["unlocked-shared-state"]
+        assert "_inbox" in fs[0].message
+
+    def test_peer_listener_locked_inbox_clean(self):
+        # near miss: the shipped PeerListener discipline — every inbox
+        # touch under one lock, socket IO outside it — is clean
+        fs = run("""
+            import threading
+
+            class Listener:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._inbox = {}
+                    self._t = threading.Thread(target=self._serve,
+                                               daemon=True)
+                    self._t.start()
+
+                def _serve(self):
+                    while True:
+                        with self._lock:
+                            self._inbox = dict(self._inbox, t1=b"f")
+
+                def take(self, ticket_id):
+                    with self._lock:
+                        return self._inbox.pop(ticket_id, None)
+        """)
+        assert fs == []
+
     def test_suppression_with_reason_honored(self):
         fs = run("""
             import threading

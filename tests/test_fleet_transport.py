@@ -418,6 +418,10 @@ class TestLoopbackE2E:
             lbs = [Loopback(InProcessReplica(tiny_model, _ecfg(),
                                              replica_id=f"S{seed}{j}"))
                    for j in range(3)]
+            for lb in lbs:
+                # peer data plane on: KV/prefix ships go worker↔worker
+                # by ticket, degrading to relay/recompute under fire
+                lb.handle.peer_endpoint = lb.inner.start_peer()
             # disaggregated roles (third replica serves both) so the
             # storm exercises prefill->decode ships under fire too
             router = FleetRouter(
@@ -476,6 +480,16 @@ class TestLoopbackE2E:
                 f"*{sched.integers(1, 2)}",
                 f"fleet.prefix_ship_corrupt:flag@{sched.integers(0, 2)}"
                 f"*{sched.integers(1, 2)}",
+                # peer-rung chaos: failed pushes must degrade one rung
+                # (relay, then recompute) with every ticket accounted
+                f"fleet.peer_connect_fail:flag@{sched.integers(0, 3)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.peer_send_drop:flag@{sched.integers(0, 3)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.peer_frame_corrupt:flag@{sched.integers(0, 3)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.peer_stall:sleep:0.05@{sched.integers(0, 3)}"
+                f"*{sched.integers(1, 2)}",
             ])
             faults.install(spec)
             outs = _drain_router(router, max_steps=400)
@@ -504,6 +518,15 @@ class TestLoopbackE2E:
                     bm = lb.inner.engine.block_manager
                     assert bm.num_free_blocks == bm.num_blocks
                     assert bm.num_free_host_blocks == bm.num_host_blocks
+                    # no survivor holds uncommitted staged peer payloads
+                    lis = lb.inner.peer_listener
+                    if lis is not None:
+                        lis.gc()
+                        assert lis.pending_count == 0
+            # ticket accounting survives the storm: every issued ticket
+            # ended in exactly one counted outcome
+            assert router.num_tickets_issued == \
+                sum(router.ticket_outcomes.values())
             # the prefix layer was actually exercised: at least one
             # proactive ship was attempted (landed or failed cleanly)
             assert (router.num_prefix_ships

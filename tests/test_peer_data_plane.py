@@ -1,0 +1,751 @@
+"""Peer-to-peer KV data plane pins (ISSUE 15).
+
+Layers, cheapest first:
+
+* ticket/listener units — HMAC signature at the door, CRC refusal,
+  duplicate idempotence, bounded staging inbox, orphan-ticket GC;
+* **loopback** fleet tests — the full ticketed path over real sockets:
+  the router issues a signed ticket, the prefill-side replica pushes
+  the KV frame straight to the decode-side listener, the commit verb
+  imports it, and ZERO payload bytes cross the router. Every peer
+  fault point degrades one rung down the ladder (peer-push →
+  router-relay → recompute) with bit-identical output and every
+  issued ticket accounted (``sum(ticket_outcomes) == tickets_issued``);
+* satellite pins — expire-before-ship, import partial-failure cleanup
+  (``serving.kv_scatter``), decorrelated RPC retry jitter, and the
+  registry heartbeat-meta size guard.
+"""
+import socket
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.replica_registry import MemStore, ReplicaRegistry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    EngineConfig, LLMEngine, SamplingParams,
+)
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, InProcessReplica, PeerListener,
+    ReplicaHandle, ReplicaLoad, ReplicaServicer, RpcClient, RpcTimeout,
+    SubprocessReplica, peer_push, sign_ticket,
+)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    return model
+
+
+def _ecfg(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("drain_grace_s", 0.0)
+    return EngineConfig(**kw)
+
+
+def _prompts(model, n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, model.config.vocab_size,
+                                       size=3 + i % 4)))
+            for i in range(n)]
+
+
+def _reference(model, prompts, sp, ids):
+    eng = LLMEngine(model, _ecfg())
+    for rid, p in zip(ids, prompts):
+        eng.add_request(rid, p, sampling=sp)
+    while eng.has_unfinished():
+        eng.step()
+    return {rid: list(eng.get_request(rid).generated) for rid in ids}
+
+
+def _drain_router(router, max_steps=400):
+    outs = []
+    for _ in range(max_steps):
+        if not router.has_unfinished():
+            return outs
+        outs.extend(router.step())
+    raise AssertionError("router failed to converge")
+
+
+def _sp(sampled):
+    if sampled:
+        return SamplingParams(max_new_tokens=8, temperature=0.8,
+                              top_p=0.9)
+    return SamplingParams(max_new_tokens=8)
+
+
+def _token_counts(outs):
+    counts = {}
+    for o in outs:
+        if o.token is not None:
+            counts[o.request_id] = counts.get(o.request_id, 0) + 1
+    return counts
+
+
+def _ticket(listener, tid="t1", deadline_ms=30_000, **over):
+    t = {"ticket_id": tid, "src": "a", "dst": "b", "kind": "kv",
+         "request_id": "r0", "deadline_ms": deadline_ms}
+    t.update(over)
+    t["sig"] = sign_ticket(t, listener._secret)
+    return t
+
+
+def _meta(payload):
+    return {"crc32": zlib.crc32(payload) & 0xFFFFFFFF}
+
+
+# ---------------------------------------------------------------------------
+# ticket + listener units
+# ---------------------------------------------------------------------------
+class TestPeerListener:
+    def test_push_take_roundtrip(self):
+        lis = PeerListener()
+        try:
+            payload = b"kv-bytes" * 100
+            t = _ticket(lis)
+            receipt = peer_push(lis.endpoint, t, _meta(payload), payload)
+            assert receipt["ok"] is True
+            ticket, meta, got = lis.take("t1")
+            assert got == payload
+            assert ticket["ticket_id"] == "t1"
+            assert meta["crc32"] == zlib.crc32(payload) & 0xFFFFFFFF
+            assert lis.stats()["received"] == 1
+            assert lis.pending_count == 0
+        finally:
+            lis.close()
+
+    def test_signature_checked_at_the_door(self):
+        # the listener's secret differs from the sender's: forged or
+        # cross-fleet tickets are refused in the receipt, never staged
+        lis = PeerListener(secret=b"other-fleet-secret")
+        try:
+            payload = b"x" * 64
+            t = _ticket(lis)
+            t["sig"] = "0" * 64            # forged
+            receipt = peer_push(lis.endpoint, t, _meta(payload), payload)
+            assert receipt["ok"] is False
+            assert "signature" in receipt["error"]
+            assert lis.take("t1") is None
+            assert lis.stats()["refused"] == 1
+        finally:
+            lis.close()
+
+    def test_tampered_ticket_field_fails_signature(self):
+        lis = PeerListener()
+        try:
+            payload = b"x" * 64
+            t = _ticket(lis)
+            t["dst"] = "someone-else"      # signed fields are sealed
+            receipt = peer_push(lis.endpoint, t, _meta(payload), payload)
+            assert receipt["ok"] is False
+        finally:
+            lis.close()
+
+    def test_crc_mismatch_refused(self):
+        lis = PeerListener()
+        try:
+            payload = b"y" * 64
+            meta = _meta(payload)
+            corrupt = b"\x00" + payload[1:]
+            receipt = peer_push(lis.endpoint, _ticket(lis), meta, corrupt)
+            assert receipt["ok"] is False
+            assert "checksum" in receipt["error"]
+            assert lis.take("t1") is None
+        finally:
+            lis.close()
+
+    def test_duplicate_delivery_idempotent(self):
+        # ambiguous peer_send timeouts make duplicates NORMAL: the
+        # second delivery acks ok without re-staging, and a duplicate
+        # AFTER the commit stays a no-op too
+        lis = PeerListener()
+        try:
+            payload = b"z" * 32
+            t = _ticket(lis)
+            assert peer_push(lis.endpoint, t, _meta(payload),
+                             payload)["ok"]
+            dup = peer_push(lis.endpoint, t, _meta(payload), payload)
+            assert dup["ok"] and dup.get("duplicate")
+            assert lis.pending_count == 1      # staged once
+            assert lis.take("t1") is not None
+            late = peer_push(lis.endpoint, t, _meta(payload), payload)
+            assert late["ok"] and late.get("duplicate")
+            assert lis.take("t1") is None      # committed: gone for good
+            assert lis.stats()["duplicates"] == 2
+        finally:
+            lis.close()
+
+    def test_inbox_capacity_refusal(self):
+        lis = PeerListener(max_entries=1)
+        try:
+            p = b"a" * 16
+            assert peer_push(lis.endpoint, _ticket(lis, "t1"), _meta(p),
+                             p)["ok"]
+            full = peer_push(lis.endpoint, _ticket(lis, "t2"), _meta(p), p)
+            assert full["ok"] is False
+            assert "full" in full["error"]
+            assert lis.take("t1") is not None  # original undisturbed
+        finally:
+            lis.close()
+
+    def test_orphan_ticket_gc(self):
+        # a staged frame whose commit never arrives (router died
+        # mid-transfer) is collected at its deadline and the late
+        # commit finds nothing
+        lis = PeerListener()
+        try:
+            p = b"orphan" * 10
+            t = _ticket(lis, deadline_ms=20)
+            assert peer_push(lis.endpoint, t, _meta(p), p)["ok"]
+            assert lis.pending_count == 1
+            time.sleep(0.05)
+            assert lis.gc() == 1
+            assert lis.take("t1") is None
+            st = lis.stats()
+            assert st["orphans_gcd"] == 1
+            assert st["staged_bytes"] == 0
+        finally:
+            lis.close()
+
+    def test_peer_fault_points(self):
+        lis = PeerListener()
+        try:
+            p = b"f" * 32
+            with faults.injected("fleet.peer_connect_fail:flag*1"):
+                with pytest.raises(OSError):
+                    peer_push(lis.endpoint, _ticket(lis), _meta(p), p)
+            with faults.injected("fleet.peer_send_drop:flag*1"):
+                with pytest.raises(OSError):
+                    peer_push(lis.endpoint, _ticket(lis), _meta(p), p)
+            with faults.injected("fleet.peer_frame_corrupt:flag*1"):
+                r = peer_push(lis.endpoint, _ticket(lis), _meta(p), p)
+                assert r["ok"] is False    # CRC refusal at the door
+            with faults.injected("fleet.peer_stall:sleep:0.1"):
+                with pytest.raises(OSError):   # stall ate the deadline
+                    peer_push(lis.endpoint, _ticket(lis), _meta(p), p,
+                              timeout_s=0.05)
+        finally:
+            lis.close()
+
+
+# ---------------------------------------------------------------------------
+# loopback fleet: the ticketed peer path end to end
+# ---------------------------------------------------------------------------
+class Loopback:
+    def __init__(self, inner, client_kw=None, peer=True):
+        self.inner = inner
+        a, b = socket.socketpair()
+        self._server_sock = b
+        threading.Thread(target=ReplicaServicer(inner).serve, args=(b,),
+                         daemon=True).start()
+        self.client = RpcClient(a, name=inner.replica_id,
+                                **(client_kw or {}))
+        self.handle = SubprocessReplica(inner.replica_id, self.client)
+        self.handle.hard_kill = self.sever
+        if peer:
+            # what the supervisor learns from the worker's first ping
+            self.handle.peer_endpoint = inner.start_peer()
+
+    def sever(self):
+        try:
+            self._server_sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._server_sock.close()
+
+
+def _peer_pair(model, prefix="P", **cfg_kw):
+    lb_p = Loopback(InProcessReplica(model, _ecfg(),
+                                     replica_id=f"{prefix}pre"))
+    lb_d = Loopback(InProcessReplica(model, _ecfg(),
+                                     replica_id=f"{prefix}dec"))
+    router = FleetRouter(
+        [lb_p.handle, lb_d.handle],
+        FleetConfig(roles={f"{prefix}pre": "prefill",
+                           f"{prefix}dec": "decode"}, **cfg_kw))
+    return lb_p, lb_d, router
+
+
+def _assert_ticket_accounting(router):
+    # the acceptance invariant: every issued ticket ends in exactly one
+    # counted outcome — none lost, none double-counted
+    assert router.num_tickets_issued == \
+        sum(router.ticket_outcomes.values())
+
+
+class TestPeerShipE2E:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_peer_ship_parity_zero_router_bytes(self, tiny_model,
+                                                sampled):
+        # THE tentpole pin: prefill→decode KV moves worker↔worker over
+        # the ticketed peer channel; token streams stay bit-identical
+        # to an uninterrupted single engine and the router carries ZERO
+        # payload bytes (relay_bytes == 0) in steady state.
+        sp = _sp(sampled)
+        n = 5
+        prompts = _prompts(tiny_model, n)
+        ids = [f"p{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model,
+                                        "S" if sampled else "G")
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert router.num_peer_ship_requests == n
+        assert router.num_peer_ship_bytes > 0
+        assert router.num_peer_ship_blocks > 0
+        # aggregate ship counters still count the peer path
+        assert router.num_kv_ship_requests == n
+        assert router.num_tokens_recomputed == 0
+        assert router.num_recompute_fallbacks == 0
+        assert router.num_handoffs == 0
+        # zero KV payload bytes through the router
+        assert router.num_relay_bytes == 0
+        assert router.num_relay_fallbacks == 0
+        assert router.num_tickets_issued >= n
+        assert router.ticket_outcomes["peer"] >= n
+        _assert_ticket_accounting(router)
+        assert lb_d.inner.engine.num_continuation_admits == n
+        # no destination is left holding uncommitted staged payloads
+        assert lb_d.inner.peer_listener.pending_count == 0
+        assert lb_p.inner._parked == {}    # sources released their stash
+        snap = router.snapshot()
+        assert snap["fleet_peer_ship_requests"] == n
+        assert snap["fleet_relay_bytes"] == 0
+        assert snap["fleet_ticket_outcomes"]["peer"] >= n
+
+    @pytest.mark.parametrize("fault", [
+        "fleet.peer_connect_fail:flag",
+        "fleet.peer_send_drop:flag",
+        "fleet.peer_frame_corrupt:flag",
+    ], ids=["connect_fail", "send_drop", "frame_corrupt"])
+    def test_peer_fault_degrades_to_relay(self, tiny_model, fault):
+        # rung 2: a dead/corrupt peer push falls back to the
+        # router-relay path — same bytes, same tokens, one counted
+        # relay fallback per ticket, ZERO recomputes
+        sp = _sp(True)
+        n = 4
+        prompts = _prompts(tiny_model, n)
+        ids = [f"r{fault[11]}{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, fault[11:13].upper())
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install(f"{fault}*{n}")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert router.num_peer_ship_requests == 0
+        assert router.num_kv_ship_requests == n     # relay landed them
+        assert router.num_relay_fallbacks == n
+        assert router.num_relay_bytes > 0
+        assert router.num_recompute_fallbacks == 0
+        assert router.ticket_outcomes["relay"] == n
+        _assert_ticket_accounting(router)
+        assert lb_d.inner.engine.num_continuation_admits == n
+
+    def test_peer_stall_degrades_to_relay(self, tiny_model):
+        # rung deadline: a stalled push that outlives the ticket's
+        # deadline budget fails the rung; the ladder relays
+        sp = _sp(False)
+        n = 2
+        prompts = _prompts(tiny_model, n)
+        ids = [f"st{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "T",
+                                        peer_deadline_s=0.05)
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install(f"fleet.peer_stall:sleep:0.2*{n}")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert router.num_peer_ship_requests == 0
+        assert router.num_relay_fallbacks == n
+        assert router.num_recompute_fallbacks == 0
+        _assert_ticket_accounting(router)
+
+    def test_peer_and_relay_faults_degrade_to_recompute(self,
+                                                        tiny_model):
+        # rung 3: peer push dies AND the relay export is dropped — the
+        # ladder bottoms out at recompute, still bit-identical
+        sp = _sp(True)
+        n = 3
+        prompts = _prompts(tiny_model, n)
+        ids = [f"rc{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "R")
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install(f"fleet.peer_connect_fail:flag*{n};"
+                       f"fleet.kv_ship_drop:flag*{n}")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert router.num_peer_ship_requests == 0
+        assert router.num_kv_ship_requests == 0
+        assert router.num_recompute_fallbacks == n
+        assert router.ticket_outcomes["recompute"] == n
+        assert router.num_tokens_recomputed > 0
+        _assert_ticket_accounting(router)
+        assert lb_d.inner.engine.num_continuation_admits == 0
+
+    def test_src_sigkill_mid_transfer_recomputes(self, tiny_model):
+        # the SOURCE dies after parking but before the ticketed push:
+        # both data rungs are gone and the request resumes by recompute
+        sp = _sp(True)
+        n = 3
+        prompts = _prompts(tiny_model, n)
+        ids = [f"sk{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "K")
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = []
+        for _ in range(200):
+            outs.extend(router.step())
+            if any(router._requests[r].ship_src is not None
+                   for r in ids):
+                break
+        else:
+            raise AssertionError("no request ever parked")
+        lb_p.sever()                      # SIGKILL as the client sees it
+        outs += _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert not lb_p.handle.alive
+        assert router.num_recompute_fallbacks >= 1
+        _assert_ticket_accounting(router)
+
+    def test_dst_sigkill_mid_run_recovers(self, tiny_model):
+        # the DESTINATION dies mid-decode: its continuations re-enqueue
+        # from router bookkeeping and land on the surviving decode
+        # replica — bit-identical, every ticket still accounted
+        sp = _sp(True)
+        n = 4
+        prompts = _prompts(tiny_model, n)
+        ids = [f"dk{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                         replica_id="Dpre"))
+        lb_d0 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                          replica_id="Ddec0"))
+        lb_d1 = Loopback(InProcessReplica(tiny_model, _ecfg(),
+                                          replica_id="Ddec1"))
+        router = FleetRouter(
+            [lb_p.handle, lb_d0.handle, lb_d1.handle],
+            FleetConfig(roles={"Dpre": "prefill", "Ddec0": "decode",
+                               "Ddec1": "decode"}))
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install("fleet.worker_kill:flag:Ddec0@4*1")
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert _token_counts(outs) == {r: len(ref[r]) for r in ids}
+        assert not lb_d0.handle.alive
+        assert router.num_replicas_dead == 1
+        _assert_ticket_accounting(router)
+        for lb in (lb_p, lb_d1):
+            assert lb.inner.peer_listener.pending_count == 0
+            bm = lb.inner.engine.block_manager
+            assert bm.num_free_blocks == bm.num_blocks
+
+    def test_expire_before_ship_skips_transfer(self, tiny_model):
+        # satellite: a request whose deadline passed while its KV
+        # transfer was pending is finalized "expired" — the snapshot is
+        # abandoned (source stash released), never shipped
+        sp = SamplingParams(max_new_tokens=8, deadline_ms=30_000)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "E")
+        router.add_request("exp0", _prompts(tiny_model, 1)[0],
+                           sampling=sp)
+        for _ in range(200):
+            router.step()
+            fr = router._requests["exp0"]
+            if fr.ship_src is not None or fr.finished:
+                break
+        assert fr.ship_src is not None, "request never parked"
+        fr.deadline_abs = time.monotonic() - 1.0   # budget exhausted
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert final["exp0"].finish_reason == "expired"
+        assert router.num_ship_skipped_expired == 1
+        assert router.num_tickets_issued == 0      # never even ticketed
+        assert lb_p.inner._parked == {}            # stash released
+        snap = router.snapshot()
+        assert snap["fleet_ship_skipped_expired"] == 1
+
+    def test_peer_disabled_pins_fleet_to_relay(self, tiny_model):
+        # the bench-comparison knob: peer_data_plane=False never issues
+        # tickets and all payloads relay through the router as before
+        sp = _sp(False)
+        n = 3
+        prompts = _prompts(tiny_model, n)
+        ids = [f"nd{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "N",
+                                        peer_data_plane=False)
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        outs = _drain_router(router)
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert router.num_tickets_issued == 0
+        assert router.num_peer_ship_requests == 0
+        assert router.num_kv_ship_requests == n
+        assert router.num_relay_bytes > 0
+        assert router.num_recompute_fallbacks == 0
+
+
+# ---------------------------------------------------------------------------
+# endpoint discovery through the registry
+# ---------------------------------------------------------------------------
+class _StubReplica(ReplicaHandle):
+    def __init__(self):
+        self.replica_id = "stub"
+        self.alive = True
+        self.retiring = False
+        self.self_heartbeat = True
+        self.role = None
+
+    def admission_verdict(self, prompt_tokens):
+        return None
+
+    def estimated_ttft_ms(self, prompt_tokens):
+        return 1.0
+
+    def load(self):
+        return ReplicaLoad()
+
+    @property
+    def is_draining(self):
+        return False
+
+    @property
+    def drained(self):
+        return False
+
+    def has_unfinished(self):
+        return False
+
+    def add_request(self, request_id, prompt_ids, sampling, *,
+                    rng_state=None):
+        pass
+
+    def abort_request(self, request_id):
+        return False
+
+    def release_request(self, request_id):
+        pass
+
+    def rng_state(self, request_id):
+        return None
+
+    def step(self):
+        return []
+
+    def start_drain(self, reason="manual"):
+        return []
+
+
+class TestEndpointDiscovery:
+    def test_peer_endpoint_learned_from_heartbeat_meta(self):
+        # restart story: a rebuilt router attaches handles without
+        # endpoints; the worker's self-heartbeat meta carries "peer"
+        # and the next health sweep re-learns where to ticket pushes
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0)
+        h = _StubReplica()
+        h.replica_id = "w0-g2"
+        router = FleetRouter([h], registry=reg)
+        reg.heartbeat("w0-g2", meta={"role": "decode",
+                                     "peer": "127.0.0.1:45999"})
+        router.step()
+        assert h.peer_endpoint == "127.0.0.1:45999"
+        assert h.role == "decode"
+        # sticky: later beats without meta must not erase it
+        reg.heartbeat("w0-g2", meta={"pid": 1})
+        router.step()
+        assert h.peer_endpoint == "127.0.0.1:45999"
+
+
+# ---------------------------------------------------------------------------
+# satellite: import partial-failure cleanup (serving.kv_scatter)
+# ---------------------------------------------------------------------------
+class TestImportPartialFailure:
+    def _warm_source(self, model):
+        eng = InProcessReplica(model, _ecfg(), replica_id="ws").engine
+        prompt = _prompts(model, 1)[0] * 3     # multi-block prompt
+        eng.add_request("src", prompt, sampling=SamplingParams(
+            max_new_tokens=4))
+        eng.step()
+        return eng, prompt
+
+    def test_import_kv_scatter_fault_frees_blocks(self, tiny_model):
+        eng_a, prompt = self._warm_source(tiny_model)
+        eng_b = InProcessReplica(tiny_model, _ecfg(),
+                                 replica_id="wb").engine
+        meta, payload = eng_a.export_kv("src")
+        sp = SamplingParams(max_new_tokens=4)
+        toks = list(eng_a.get_request("src").tokens)
+        with faults.injected("serving.kv_scatter:raise*1"):
+            with pytest.raises(ValueError, match="blocks freed"):
+                eng_b.import_kv("dst", toks, sampling=sp, meta=meta,
+                                payload=payload)
+        bm = eng_b.block_manager
+        assert bm.num_free_blocks == bm.num_blocks   # nothing leaked
+        bm.check_invariants()
+        assert "dst" not in eng_b._requests          # nothing admitted
+        # the same import succeeds once the fault is gone — the failed
+        # attempt left no residue behind
+        eng_b.import_kv("dst", toks, sampling=sp, meta=meta,
+                        payload=payload)
+        assert eng_b.get_request("dst").num_cached > 0
+
+    def test_import_prefix_scatter_fault_frees_blocks(self, tiny_model):
+        eng_a, _ = self._warm_source(tiny_model)
+        eng_b = InProcessReplica(tiny_model, _ecfg(),
+                                 replica_id="pb").engine
+        digest = eng_a.prefix_digest()
+        assert digest["h"], "source trie never committed a prefix"
+        ch = next(iter(digest["h"]))
+        meta, payload = eng_a.export_prefix(ch)
+        with faults.injected("serving.kv_scatter:raise*1"):
+            with pytest.raises(ValueError, match="blocks freed"):
+                eng_b.import_prefix(meta=meta, payload=payload)
+        bm = eng_b.block_manager
+        assert bm.num_free_blocks == bm.num_blocks
+        bm.check_invariants()
+        # clean retry commits the prefix
+        assert eng_b.import_prefix(meta=meta, payload=payload) > 0
+
+    def test_router_degrades_when_dst_import_always_fails(self,
+                                                          tiny_model):
+        # end to end: every import (peer commit AND relay) fails at
+        # scatter — the ladder bottoms out at recompute, bit-identical,
+        # and the destination pool ends exactly full
+        sp = _sp(True)
+        n = 3
+        prompts = _prompts(tiny_model, n)
+        ids = [f"sc{i}" for i in range(n)]
+        ref = _reference(tiny_model, prompts, sp, ids)
+        lb_p, lb_d, router = _peer_pair(tiny_model, "C")
+        for rid, p in zip(ids, prompts):
+            router.add_request(rid, p, sampling=sp)
+        faults.install("serving.kv_scatter:raise")
+        outs = _drain_router(router)
+        faults.clear()
+        final = {o.request_id: o for o in outs if o.finished}
+        assert {r: list(final[r].generated) for r in ids} == ref
+        assert router.num_recompute_fallbacks == n
+        assert router.ticket_outcomes["recompute"] == n
+        _assert_ticket_accounting(router)
+        bm = lb_d.inner.engine.block_manager
+        assert bm.num_free_blocks == bm.num_blocks
+        bm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# satellite: decorrelated retry jitter
+# ---------------------------------------------------------------------------
+class TestRetryJitter:
+    def _backoffs(self, seed):
+        a, _b = socket.socketpair()
+        cl = RpcClient(a, retries=5, backoff_base_s=0.001,
+                       backoff_max_s=0.004, jitter_seed=seed)
+        # every attempt times out instantly at the injected drop, so
+        # the full retry schedule runs deterministically and fast
+        with faults.injected("fleet.rpc_drop:flag"):
+            with pytest.raises(RpcTimeout):
+                cl.call("ping", {}, deadline_s=1.0)
+        out = list(cl.stats["backoffs"])
+        cl.close()
+        _b.close()
+        return out
+
+    def test_seeded_schedule_is_deterministic(self):
+        assert self._backoffs(42) == self._backoffs(42)
+
+    def test_schedules_decorrelate_across_seeds(self):
+        a, b = self._backoffs(1), self._backoffs(2)
+        assert len(a) == len(b) == 5
+        # first sleep is exactly the base for every client (thundering
+        # herd protection starts at retry 2); later sleeps diverge
+        assert a[0] == b[0] == 0.001
+        assert a[1:] != b[1:]
+
+    def test_jitter_respects_bounds(self):
+        for d in self._backoffs(7):
+            assert 0.001 <= d <= 0.004
+
+
+# ---------------------------------------------------------------------------
+# satellite: heartbeat meta size guard
+# ---------------------------------------------------------------------------
+class TestMetaSizeGuard:
+    def test_digest_dropped_first_essentials_never(self):
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0, meta_cap_bytes=120)
+        big = {f"h{i}": 16 for i in range(50)}
+        reg.heartbeat("r0", meta={"role": "decode",
+                                  "peer": "127.0.0.1:40001", "pid": 7,
+                                  "prefix": {"bs": 4, "n": 50, "h": big},
+                                  "zz_extra": "x" * 200})
+        meta = reg.record("r0")["meta"]
+        assert meta["role"] == "decode"
+        assert meta["peer"] == "127.0.0.1:40001"
+        assert meta["pid"] == 7
+        assert "prefix" not in meta        # first against the wall
+        assert "zz_extra" not in meta
+        assert reg.num_meta_keys_dropped == 2
+
+    def test_under_cap_meta_untouched(self):
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0)
+        meta = {"role": "prefill", "peer": "127.0.0.1:1", "pid": 1,
+                "prefix": {"bs": 4, "n": 1, "h": {"ab": 4}}}
+        reg.heartbeat("r1", meta=dict(meta))
+        assert reg.record("r1")["meta"] == meta
+        assert reg.num_meta_keys_dropped == 0
+
+    def test_drop_stops_once_under_cap(self):
+        # "prefix" alone brings the record under the cap: the other
+        # non-essential key survives
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0, meta_cap_bytes=120)
+        reg.heartbeat("r2", meta={"role": "decode",
+                                  "prefix": {"h": {f"h{i}": 8
+                                                   for i in range(40)}},
+                                  "note": "small"})
+        meta = reg.record("r2")["meta"]
+        assert "prefix" not in meta
+        assert meta["note"] == "small"
+        assert reg.num_meta_keys_dropped == 1
+
+    def test_all_essential_oversize_sent_as_is(self):
+        reg = ReplicaRegistry(MemStore(), ttl_s=30.0, meta_cap_bytes=16)
+        meta = {"role": "decode", "peer": "127.0.0.1:40001", "pid": 99}
+        reg.heartbeat("r3", meta=dict(meta))
+        # better a fat beat than a fleet that forgets its topology
+        assert reg.record("r3")["meta"] == meta
+        assert reg.num_meta_keys_dropped == 0
