@@ -1068,6 +1068,206 @@ def bench_peer(tiny=False, replicas=4, n_requests=16,
     }
 
 
+def bench_routers(tiny=False, routers=2, n_requests=24,
+                  max_new_tokens=8, max_num_seqs=8, seed=0):
+    """Replicated control plane (``--serving --routers N``), two parts:
+
+    1. **real engines** — ``routers`` FleetRouters over 4 shared
+       in-process replicas, tenant-partitioned requests with every
+       in-flight request holding a store lease. Three step rounds in,
+       the router owning the most leased work is killed through the
+       ``fleet.router_kill`` fault; the survivors adopt its leases and
+       finish everything. Reports dispatches/s per router and the
+       client-observed TTFT distribution (the p99 carries the
+       router-TTL adoption stall — the cost of a control-plane death),
+       against a single-router no-kill baseline of the same workload.
+    2. **simulator** — a 100-replica, 3-router :class:`FleetSim` under
+       a spike trace with a ``LoadThresholdPolicy`` autoscaler
+       (``low=0.0``: scale-down is forbidden, draining shared sim
+       handles would strand peer routers' work). Reports sim
+       dispatches per wall second and the ``scale_to`` decisions the
+       spike provoked; :meth:`FleetSim.check` enforces the exactness
+       invariants before anything is reported."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.replica_registry import (
+        MemStore, ReplicaRegistry,
+    )
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, SamplingParams
+    from paddle_tpu.serving.fleet import (
+        Arrival, FleetConfig, FleetRouter, FleetSim, InProcessReplica,
+        LeaseStore, LoadThresholdPolicy, spike_trace, tenant_home,
+    )
+    from paddle_tpu.testing import faults
+
+    paddle.seed(seed)
+    paddle.set_default_dtype("float32")
+    cfg = _fleet_model_cfg(tiny)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def ecfg(**kw):
+        kw.setdefault("max_num_seqs", max_num_seqs)
+        kw.setdefault("max_model_len",
+                      min(cfg.max_position_embeddings, 1024))
+        return EngineConfig(**kw)
+
+    rng = np.random.RandomState(seed)
+    prompts = [list(rng.randint(0, cfg.vocab_size,
+                                size=5 + 3 * (i % 5) + 1))
+               for i in range(n_requests)]
+    tenants = [f"t{i % 8}" for i in range(n_requests)]
+
+    def sp(tenant):
+        return SamplingParams(max_new_tokens=max_new_tokens,
+                              tenant_id=tenant)
+
+    handles = [InProcessReplica(model, ecfg(), replica_id=f"r{i}")
+               for i in range(4)]
+    # warmup through a throwaway classic router: compile every bucketed
+    # shape per engine before anything is timed
+    warm = FleetRouter(handles)
+    for p in prompts[:4 * max_num_seqs + 2]:
+        warm.add_request(p, sampling=SamplingParams(
+            max_new_tokens=max_new_tokens))
+    while warm.has_unfinished():
+        warm.step()
+
+    # single-router no-kill baseline: the denominator for vs_baseline
+    base_router = FleetRouter(handles)
+    t0 = time.perf_counter()
+    base_rids = [base_router.add_request(p, sampling=sp(t))
+                 for p, t in zip(prompts, tenants)]
+    while base_router.has_unfinished():
+        base_router.step()
+    base_dt = time.perf_counter() - t0
+    assert all(base_router.get_request(r).finish_reason == "length"
+               for r in base_rids)
+    base_rate = base_router.num_dispatched / base_dt
+
+    # replicated pass: N routers, shared store, a mid-run router kill
+    store = MemStore()
+    fcfg = FleetConfig(heartbeat_interval_s=0.0, router_ttl_s=0.3,
+                       lease_ttl_s=0.6, prefix_affinity=False,
+                       peer_data_plane=False)
+    names = [f"R{i}" for i in range(routers)]
+    rts = [FleetRouter(
+        handles, fcfg,
+        registry=ReplicaRegistry(store, ttl_s=fcfg.registry_ttl_s),
+        lease_store=LeaseStore(store, ttl_s=fcfg.lease_ttl_s),
+        router_id=name) for name in names]
+    for r in rts:
+        r.step()  # discover the peer view before any dispatch
+
+    t_add, first_tok, terminals = {}, {}, {}
+    t0 = time.perf_counter()
+    for i, (p, ten) in enumerate(zip(prompts, tenants)):
+        rid = f"b{i}"
+        home = next(r for r in rts
+                    if r.router_id == tenant_home(ten, sorted(names)))
+        home.add_request(rid, p, sampling=sp(ten))
+        t_add[rid] = time.perf_counter()
+    rounds, victim = 0, None
+    try:
+        while True:
+            now = time.perf_counter()
+            assert now - t0 < 300, "replicated pass failed to converge"
+            for r in rts:
+                for o in r.step():
+                    if o.request_id not in first_tok and o.generated:
+                        first_tok[o.request_id] = time.perf_counter()
+                    if o.finished:
+                        assert o.request_id not in terminals, \
+                            "duplicate terminal"
+                        terminals[o.request_id] = o
+            rounds += 1
+            if rounds == 3:
+                victim = max(rts, key=lambda r: sum(
+                    1 for fr in r._open.values()
+                    if fr.lease_gen is not None and not fr.finished))
+                faults.install(
+                    f"fleet.router_kill:flag:{victim.router_id}*1")
+            if (len(terminals) == n_requests
+                    and rts[0].lease_store.active() == 0):
+                break
+    finally:
+        faults.clear()
+    dt = time.perf_counter() - t0
+
+    assert victim is not None and victim.router_dead
+    assert sum(r.num_router_failovers for r in rts) == 1
+    assert all(o.finish_reason == "length" for o in terminals.values())
+    assert all(len(o.generated) == max_new_tokens
+               for o in terminals.values())
+    ttft_ms = sorted((first_tok[rid] - t_add[rid]) * 1e3
+                     for rid in t_add)
+    per_router = {r.router_id: {
+        "dispatches_per_sec": round(r.num_dispatched / dt, 1),
+        "dispatched": r.num_dispatched,
+        "adopted": r.lease_store.num_adopted,
+        "failovers": r.num_router_failovers,
+        "dead": r.router_dead} for r in rts}
+    total_rate = sum(r.num_dispatched for r in rts) / dt
+
+    # part 2: the 100-replica spike-trace simulation with autoscale
+    sim = FleetSim(n_replicas=100, n_routers=3, max_seqs=4, seed=seed,
+                   autoscale=LoadThresholdPolicy(
+                       high=0.8, low=0.0, min_replicas=1,
+                       max_replicas=110))
+    # background trickle plus all-tenant thundering herds: a single
+    # tenant's burst only saturates its home router's third of the
+    # fleet (fleet-mean load ~0.35, under the 0.8 threshold), so the
+    # herd spans every tenant to push the WHOLE fleet past it
+    sim_tenants = [f"t{i}" for i in range(8)]
+    trace = spike_trace(duration_s=24.0, tenants=sim_tenants,
+                        base_rps=10.0, max_new=8, seed=seed)
+    for at in (6.0, 14.0):
+        for ten in sim_tenants:
+            trace.extend(Arrival(t=at, tenant=ten, prompt_len=24,
+                                 max_new=8) for _ in range(60))
+    trace.sort(key=lambda a: a.t)
+    # thundering herds drain in well under a virtual second on the
+    # measured latency model, so the autoscaler must tick finer than
+    # the default 1.0 s or it never observes the spike load at all
+    w0 = time.perf_counter()
+    sim.run(trace, autoscale_every_s=0.05)
+    sim_wall = time.perf_counter() - w0
+    sim_summary = sim.check()
+    sim_dispatched = sum(r.num_dispatched for r in sim.routers)
+
+    return {
+        "metric": "replicated_router_dispatches_per_sec",
+        "value": round(total_rate, 1),
+        "unit": "dispatches/sec",
+        "vs_baseline": round(total_rate / base_rate, 3),
+        "extra": {
+            "config": ("tiny" if tiny else "gpt-small-serving")
+                      + f" routers={routers} replicas=4"
+                      f" n_req={n_requests} max_new={max_new_tokens}"
+                      f" max_num_seqs={max_num_seqs} router_kill@3",
+            "single_router_dispatches_per_sec": round(base_rate, 1),
+            "routers": per_router,
+            "victim": victim.router_id,
+            "ttft_ms_p50_under_router_kill": round(
+                ttft_ms[len(ttft_ms) // 2], 1),
+            "ttft_ms_p99_under_router_kill": round(
+                ttft_ms[min(len(ttft_ms) - 1,
+                            int(len(ttft_ms) * 0.99))], 1),
+            "ttft_ms_max_under_router_kill": round(ttft_ms[-1], 1),
+            "sim": {
+                **sim_summary,
+                "n_replicas_start": 100,
+                "wall_s": round(sim_wall, 2),
+                "dispatches_per_wall_s": round(
+                    sim_dispatched / sim_wall, 1),
+                "scale_to_decisions": sim.scale_events[:20],
+            },
+        },
+    }
+
+
 def _pp_schedules_worker():
     """Measure per-schedule pipeline step time on the 8-device virtual
     CPU mesh (VERDICT r4 #3/#10: measured numbers, not hardcoded
@@ -1303,6 +1503,12 @@ if __name__ == "__main__":
             # scenario (ship bytes + tokens/s per variant in extra)
             print("BENCH_serving_peer " + json.dumps(
                 bench_peer(tiny="--tiny" in sys.argv)))
+        elif "--routers" in sys.argv:
+            # replicated control plane: N leased routers + a mid-run
+            # router kill, plus the 100-replica autoscaled simulation
+            n = int(sys.argv[sys.argv.index("--routers") + 1])
+            print("BENCH_serving_routers " + json.dumps(
+                bench_routers(tiny="--tiny" in sys.argv, routers=n)))
         elif "--replicas" in sys.argv:
             n = int(sys.argv[sys.argv.index("--replicas") + 1])
             print("BENCH_serving_fleet " + json.dumps(

@@ -81,7 +81,7 @@ class ReplicaRegistry:
     # keys the fleet cannot function without are NEVER dropped by the
     # size guard, everything else (the prefix digest first — it is the
     # only unbounded-ish tenant) goes before a record exceeds the cap
-    ESSENTIAL_META_KEYS = ("role", "peer", "pid")
+    ESSENTIAL_META_KEYS = ("role", "peer", "pid", "rpc")
 
     def __init__(self, store=None, prefix: str = "serving_fleet",
                  ttl_s: float = 5.0, meta_cap_bytes: int = 4096):

@@ -24,6 +24,9 @@ Quick start::
 from paddle_tpu.serving.fleet.controller import (  # noqa: F401
     AutoscalePolicy, FleetController, LoadThresholdPolicy,
 )
+from paddle_tpu.serving.fleet.lease import (  # noqa: F401
+    LeaseStore, rendezvous_owner,
+)
 from paddle_tpu.serving.fleet.metrics import FleetMetrics  # noqa: F401
 from paddle_tpu.serving.fleet.replica import (  # noqa: F401
     InProcessReplica, ReplicaHandle, ReplicaLoad,
@@ -31,22 +34,32 @@ from paddle_tpu.serving.fleet.replica import (  # noqa: F401
 from paddle_tpu.serving.fleet.router import (  # noqa: F401
     FleetConfig, FleetRouter, HANDOFF_REASONS,
 )
+from paddle_tpu.serving.fleet.sim import (  # noqa: F401
+    Arrival, ChaosEvent, FleetSim, LatencyModel, SimReplica,
+    VirtualClock, diurnal_trace, sim_token, spike_trace,
+)
 from paddle_tpu.serving.fleet.supervisor import (  # noqa: F401
     ReplicaSupervisor, SupervisorConfig, WorkerSpec,
 )
-from paddle_tpu.serving.fleet.tenant import TenantQueue  # noqa: F401
+from paddle_tpu.serving.fleet.tenant import (  # noqa: F401
+    TenantQueue, tenant_home,
+)
 from paddle_tpu.serving.fleet.transport import (  # noqa: F401
     PeerListener, ReplicaGone, ReplicaServicer, RpcClient, RpcError,
-    RpcRemoteError, RpcTimeout, SubprocessReplica, peer_push,
-    peer_secret, sign_ticket,
+    RpcRemoteError, RpcTimeout, SubprocessReplica, connect_replica,
+    peer_push, peer_secret, sign_ticket,
 )
 
 __all__ = ["AutoscalePolicy", "FleetController", "LoadThresholdPolicy",
            "FleetMetrics", "InProcessReplica", "ReplicaHandle",
            "ReplicaLoad", "FleetConfig", "FleetRouter",
-           "HANDOFF_REASONS", "TenantQueue",
+           "HANDOFF_REASONS", "LeaseStore", "rendezvous_owner",
+           "TenantQueue", "tenant_home",
            "ReplicaSupervisor", "SupervisorConfig", "WorkerSpec",
            "PeerListener", "ReplicaGone", "ReplicaServicer",
            "RpcClient", "RpcError", "RpcRemoteError", "RpcTimeout",
-           "SubprocessReplica", "peer_push", "peer_secret",
-           "sign_ticket"]
+           "SubprocessReplica", "connect_replica", "peer_push",
+           "peer_secret", "sign_ticket",
+           "Arrival", "ChaosEvent", "FleetSim", "LatencyModel",
+           "SimReplica", "VirtualClock", "diurnal_trace", "sim_token",
+           "spike_trace"]

@@ -39,7 +39,10 @@ class FleetMetrics:
               "kv_snapshot_skipped", "tickets_issued",
               "peer_ship_requests", "peer_ship_blocks",
               "peer_ship_bytes", "relay_fallbacks", "relay_bytes",
-              "ship_skipped_expired")
+              "ship_skipped_expired", "router_failovers",
+              "requests_fenced", "requests_handed_over",
+              "leases_acquired", "leases_completed",
+              "leases_adopted", "leases_expired", "leases_active")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
@@ -86,6 +89,23 @@ class FleetMetrics:
         "kv_snapshot_skipped": lambda r: sum(
             getattr(h, "num_kv_snapshot_skipped", 0)
             for h in r.replicas),
+        # replicated control plane: this router's view. The lease_*
+        # gauges count THIS router's LeaseStore incarnation buckets
+        # (summed fleet-wide: acquired == completed + adopted +
+        # expired + active); all zero in single-router mode
+        "router_failovers": lambda r: r.num_router_failovers,
+        "requests_fenced": lambda r: r.num_requests_fenced,
+        "requests_handed_over": lambda r: r.num_requests_handed_over,
+        "leases_acquired": lambda r: (
+            r.lease_store.num_acquired if r.lease_store else 0),
+        "leases_completed": lambda r: (
+            r.lease_store.num_completed if r.lease_store else 0),
+        "leases_adopted": lambda r: (
+            r.lease_store.num_adopted if r.lease_store else 0),
+        "leases_expired": lambda r: (
+            r.lease_store.num_expired if r.lease_store else 0),
+        "leases_active": lambda r: (
+            r.lease_store.active() if r.lease_store else 0),
     }
 
     def __init__(self, router):

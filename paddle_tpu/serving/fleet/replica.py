@@ -116,6 +116,26 @@ class ReplicaHandle:
         unavailable (request unknown, or the replica is unreachable)."""
         raise NotImplementedError
 
+    def fence_request(self, request_id: str, gen: int) -> bool:
+        """Replicated control plane: record that ``request_id`` is now
+        driven at lease generation ``gen``. Returns False when the
+        replica has already seen a HIGHER generation for this request —
+        the caller is a stale owner and must drop the request locally
+        without emitting (the same refusal a restarted worker's fencing
+        gives a stale router's ``peer_commit``). Re-asserting the
+        current generation returns True, so the call is idempotent and
+        safe to retry. Replica-side state is a bounded recent-request
+        table, not a durable ledger; the durable fence is the lease
+        store's generation."""
+        fences = self.__dict__.setdefault("_request_fences", {})
+        cur = fences.get(request_id)
+        if cur is not None and cur > int(gen):
+            return False
+        fences[request_id] = int(gen)
+        while len(fences) > 256:  # bounded: oldest-inserted falls out
+            fences.pop(next(iter(fences)))
+        return True
+
     # -- fleet KV-ship (optional capability; default: unsupported) --------
     def export_kv(self, request_id: str):
         """(meta dict, payload bytes) packaging the request's committed

@@ -72,17 +72,50 @@ kept) → recompute — with exactly one counted outcome per ticket
 remaining deadline budget. The transport adds four peer fault points
 (``fleet.peer_{connect_fail,send_drop,frame_corrupt,stall}``) that
 fire inside the source's push, driving the ladder down a rung.
+
+Replicated control plane (ISSUE 16): pass ``lease_store`` (and a
+``router_id``) to run N routers over ONE shared registry store. Three
+invariants carry the whole design:
+
+* **partitioning** — replicas are partitioned across the live routers
+  by rendezvous hashing over their ids (``_steps_replica``), so every
+  engine is stepped/heartbeaten/dispatched-to by exactly one router;
+  tenants are partitioned the same way client-side
+  (:func:`~paddle_tpu.serving.fleet.tenant.tenant_home`). Both views
+  derive from the router registry (prefix ``fleet_routers``, TTL
+  ``router_ttl_s`` — much shorter than the replica TTL, so an adopter
+  starts beating inherited replicas before their records expire);
+* **renew-before-emit** — the owner renews each request's lease (with
+  the new progress and RNG state) BEFORE emitting those tokens; a
+  failed renew means fenced, and the only reaction is to self-fence
+  (abort the engine copy, emit nothing). The committed progress is
+  therefore always >= what the client saw, so an adopter resuming
+  from it can never duplicate a token position;
+* **generation fencing** — adoption bumps the lease generation, and
+  replicas remember the highest generation per request
+  (``fence_request``), so a stale router's late dispatch is refused
+  the same way a restarted worker refuses a stale ``peer_commit``.
+
+Replicated fault points (KEYED — see ``faults.check(key=...)``):
+``fleet.router_kill:flag:<router_id>`` (this router goes silent in
+place at its next step — in-process SIGKILL),
+``fleet.lease_expire:flag:<rid>`` (one renewal write dropped AND
+failed, forcing a self-fence and a peer's expired-lease recompute),
+``fleet.lease_steal:flag[:<rid>]`` (the adoption sweep force-adopts a
+live foreign lease — the expiry race without the TTL wait).
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from paddle_tpu.distributed.replica_registry import ReplicaRegistry
 from paddle_tpu.serving.block_manager import prefix_chain_hashes
+from paddle_tpu.serving.fleet.lease import LeaseStore, rendezvous_owner
 from paddle_tpu.serving.fleet.metrics import FleetMetrics
 from paddle_tpu.serving.fleet.replica import ReplicaHandle
 from paddle_tpu.serving.fleet.tenant import TenantQueue
@@ -142,10 +175,21 @@ class FleetConfig:
     # for the relay and recompute rungs below)
     peer_data_plane: bool = True
     peer_deadline_s: float = 30.0
+    # replicated control plane: liveness TTL for ROUTER records (prefix
+    # "fleet_routers" in the shared store) and for request leases. The
+    # router TTL must be well under registry_ttl_s: replica ownership
+    # flips when a router's record goes stale, and the adopter must
+    # start beating the inherited replicas before THEIR records expire
+    router_ttl_s: float = 2.0
+    lease_ttl_s: float = 3.0
 
     def __post_init__(self):
         if self.heartbeat_interval_s < 0:
             raise ValueError("heartbeat_interval_s must be >= 0")
+        if self.router_ttl_s <= 0:
+            raise ValueError("router_ttl_s must be > 0")
+        if self.lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be > 0")
         if self.peer_deadline_s <= 0:
             raise ValueError("peer_deadline_s must be > 0")
         if self.max_handoffs < 0:
@@ -207,6 +251,10 @@ class _FleetRequest:
     rejects: int = 0
     finished: bool = False
     finish_reason: Optional[str] = None
+    # replicated control plane: the fencing generation of this
+    # request's store lease (None until first dispatch, and always
+    # None in single-router mode)
+    lease_gen: Optional[int] = None
 
     @property
     def generated(self) -> List[int]:
@@ -216,10 +264,31 @@ class _FleetRequest:
 class FleetRouter:
     def __init__(self, replicas: Sequence[ReplicaHandle],
                  config: Optional[FleetConfig] = None,
-                 registry: Optional[ReplicaRegistry] = None):
+                 registry: Optional[ReplicaRegistry] = None, *,
+                 lease_store: Optional[LeaseStore] = None,
+                 router_id: Optional[str] = None):
         self.cfg = config or FleetConfig()
         self.registry = registry if registry is not None else \
             ReplicaRegistry(ttl_s=self.cfg.registry_ttl_s)
+        # replicated control plane (module docstring): None = classic
+        # single-router mode, byte-identical behavior to before
+        self.lease_store = lease_store
+        self.router_id = router_id or \
+            f"router-{os.getpid():x}-{id(self) & 0xFFFF:x}"
+        self.router_dead = False    # fleet.router_kill fired: silent
+        self.partitioned = False    # chaos knob: frozen, no store I/O
+        self.router_registry: Optional[ReplicaRegistry] = None
+        self._routers_view: List[str] = [self.router_id]
+        self._failed_routers: Set[str] = set()
+        self._sync_step = 0
+        self.num_router_failovers = 0
+        self.num_requests_fenced = 0
+        self.num_requests_handed_over = 0
+        if lease_store is not None:
+            self.router_registry = ReplicaRegistry(
+                self.registry.store, prefix="fleet_routers",
+                ttl_s=self.cfg.router_ttl_s)
+            self.router_registry.heartbeat(self.router_id)
         self.replicas: List[ReplicaHandle] = []
         self._assigned: Dict[str, Set[str]] = {}
         self._queue = TenantQueue(
@@ -330,9 +399,24 @@ class FleetRouter:
         # re-enqueue at the FRONT preserving arrival order (reversed:
         # each push_front lands ahead of the previous)
         for fr in reversed(frs):
-            state = handle.rng_state(fr.request_id)
-            if state is not None:
-                fr.rng_state = state
+            if self.lease_store is None or self._steps_replica(handle):
+                # a replica we still own can only have been stepped by
+                # us, so its (cached) rng state matches our emissions;
+                # a DISOWNED one may have been stepped past them by its
+                # new owner — keep the emit-committed fr.rng_state
+                state = handle.rng_state(fr.request_id)
+                if state is not None:
+                    fr.rng_state = state
+            if (self.lease_store is not None
+                    and fr.lease_gen is not None
+                    and not self.lease_store.renew(
+                        fr.request_id, self.router_id, fr.lease_gen,
+                        progress=list(fr.progress),
+                        base=list(fr.progress), rng=fr.rng_state)):
+                # fenced while committing the recovery point: a peer
+                # owns the request — drop it without re-enqueueing
+                self._fence_local(fr)
+                continue
             if (self.cfg.handoff and fr.handoffs < self.cfg.max_handoffs
                     and self._has_peer(handle)):
                 self._requeue(fr)
@@ -388,7 +472,12 @@ class FleetRouter:
             cost=len(prompt) + sampling.max_new_tokens)
         self._requests[request_id] = fr
         self._open[request_id] = fr
-        live = self.dispatchable()
+        live = self._own_dispatchable()
+        if self.lease_store is not None and not live:
+            # a router that currently owns no replica still admits for
+            # the FLEET: the dispatch pass hands the request over to a
+            # peer through an orphan lease (see _hand_over)
+            live = self.dispatchable()
         verdicts = [h.admission_verdict(len(prompt)) for h in live]
         if not live or all(v is not None for v in verdicts):
             self.num_rejected_fleetwide += 1
@@ -432,6 +521,19 @@ class FleetRouter:
         """Pump faults, heartbeats, health, dispatch, then one engine
         iteration per live replica. Returns this step's client-visible
         outputs (hand-offs emit nothing — the request continues)."""
+        if self.lease_store is not None:
+            if not self.router_dead and faults.check(
+                    "fleet.router_kill", key=self.router_id):
+                # in-process SIGKILL: this router goes silent NOW — no
+                # farewell beat, no lease release, nothing emitted again
+                self.router_dead = True
+            if self.router_dead or self.partitioned:
+                # dead: silent forever. partitioned: FROZEN — no beats,
+                # no renewals, no dispatch; pending terminals wait for
+                # the heal (their positions are <= the lease's committed
+                # progress, so a late emission cannot duplicate)
+                return []
+            self._router_sync()
         outputs, self._pending_outputs = self._pending_outputs, []
         self._fire_fault_points(outputs)
         self._heartbeat()
@@ -441,6 +543,8 @@ class FleetRouter:
         for h in list(self.replicas):
             if not h.alive:
                 continue
+            if not self._steps_replica(h):
+                continue  # a peer router owns this engine
             to_ship: List[str] = []
             for out in h.step():
                 self._handle_output(h, out, outputs, to_ship)
@@ -468,7 +572,7 @@ class FleetRouter:
     def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
         outs: List[RequestOutput] = []
         steps = 0
-        while self.has_unfinished():
+        while self.has_unfinished() and not self.router_dead:
             outs.extend(self.step())
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -527,6 +631,8 @@ class FleetRouter:
         self._last_hb = now
         for h in self.replicas:
             if h.alive and not getattr(h, "self_heartbeat", False):
+                if not self._steps_replica(h):
+                    continue  # its owner router beats it
                 # in-process replicas advertise through the router's
                 # own beat (a worker process publishes the same meta
                 # shape itself — see fleet/worker.py)
@@ -569,6 +675,339 @@ class FleetRouter:
                 # recovery as a mid-step death
                 self.kill_replica(h.replica_id, "found dead", outputs)
 
+    # -- replicated control plane (leases, adoption, fencing) --------------
+    def _steps_replica(self, h: ReplicaHandle) -> bool:
+        """Replica partitioning: in replicated mode each live replica
+        is stepped/heartbeaten/dispatched-to by exactly ONE router —
+        the rendezvous owner of its id over the live router view — so
+        two routers can never double-step one engine. A single router
+        owns everything (unchanged classic behavior)."""
+        if self.lease_store is None:
+            return True
+        return rendezvous_owner(h.replica_id,
+                                self._routers_view) == self.router_id
+
+    def _own_dispatchable(self) -> List[ReplicaHandle]:
+        return [h for h in self.dispatchable()
+                if self._steps_replica(h)]
+
+    def _router_sync(self) -> None:
+        """Per-step replicated bookkeeping: beat our router record,
+        refresh the live-router view (the partitioning input), adopt
+        leases whose owner died or went stale, and migrate requests
+        off replicas that rendezvous no longer assigns to us."""
+        self.router_registry.heartbeat(self.router_id)
+        view = set(self.router_registry.alive())
+        view.add(self.router_id)
+        view = sorted(view)
+        changed = view != self._routers_view
+        self._routers_view = view
+        # the adoption sweep parses every lease record; amortize it
+        # over steps (a membership change always sweeps immediately —
+        # that is when adoptions and migrations actually happen)
+        self._sync_step += 1
+        if changed or self._sync_step % 4 == 1:
+            self._adopt_sweep()
+            self._migrate_disowned()
+            self._reconcile_open()
+
+    def _reconcile_open(self) -> None:
+        """Fence open requests whose lease silently changed hands. The
+        renew-before-emit fence only fires on an emission — if a peer
+        adopted our request (we looked dead during a partition),
+        attached to our engine copy, and drove it to a terminal, that
+        copy never emits to US again and the renewal path never runs.
+        Sweep our leased open requests against the store: a missing
+        record (the adopter released at a terminal) or a foreign
+        owner/generation means we were superseded — drop our copy
+        without emitting."""
+        for fr in list(self._open.values()):
+            if fr.finished or fr.lease_gen is None:
+                continue
+            if not self.lease_store.check(
+                    fr.request_id, self.router_id, fr.lease_gen):
+                self._fence_local(fr)
+
+    def _adopt_sweep(self) -> None:
+        """Take over foreign leases that lost their owner. Three
+        triggers: the owner's router record left the live view
+        (SIGKILL — outcome ``adopted``), the lease itself went stale
+        on our clock (the owner stopped renewing — ``expired``), or
+        the ``fleet.lease_steal`` fault forced the race. Exactly one
+        peer steps up per lease: the rendezvous winner over the live
+        routers minus the old owner."""
+        ls = self.lease_store
+        live = set(self._routers_view)
+        for rec in ls.sweep():
+            rid, owner = rec.get("rid"), rec.get("owner")
+            if rid is None:
+                continue
+            mine = self._requests.get(rid)
+            if owner == self.router_id:
+                gen = int(rec.get("gen", 0))
+                if (rec.get("orphan") and self._own_dispatchable()
+                        and (mine is None or mine.finished)):
+                    # reclaim our own orphan: we handed it over with no
+                    # replicas to our name, and rendezvous has since
+                    # given us some back before any peer took it
+                    if ls.renew(rid, self.router_id, gen, orphan=False):
+                        self._adopt_request(rid, gen, rec,
+                                            owner_dead=False)
+                elif rec["stale"] and (mine is None or mine.finished):
+                    # our own lease went stale with no live local copy:
+                    # we self-fenced on a dropped renew (fenced and
+                    # store-refused are indistinguishable by design)
+                    # and no peer stepped up — with one router left
+                    # there IS no peer. Same owner, same generation, so
+                    # this is the same incarnation resuming, not an
+                    # adoption: re-freshen the record and recompute
+                    # from its committed progress
+                    if ls.renew(rid, self.router_id, gen):
+                        self._adopt_request(rid, gen, rec,
+                                            owner_dead=False)
+                continue
+            if mine is not None and not mine.finished:
+                continue  # we already hold an open copy
+            owner_dead = owner not in live
+            orphan = bool(rec.get("orphan"))
+            steal = (not owner_dead and not rec["stale"]
+                     and bool(faults.check("fleet.lease_steal",
+                                           key=rid)))
+            if not (owner_dead or orphan or rec["stale"] or steal):
+                continue
+            cands = sorted(live - {owner}) or sorted(live)
+            if rendezvous_owner(f"adopt:{rid}", cands) != self.router_id:
+                continue
+            res = ls.adopt(
+                rid, self.router_id,
+                outcome="adopted" if owner_dead or orphan else "expired")
+            if res is None:
+                continue
+            gen, old = res
+            self._adopt_request(rid, gen, old, owner_dead)
+            if owner_dead and owner not in self._failed_routers:
+                self._failed_routers.add(owner)
+                self.num_router_failovers += 1
+
+    def _adopt_request(self, rid: str, gen: int, rec: Dict,
+                       owner_dead: bool) -> None:
+        """Rebuild a ``_FleetRequest`` from an adopted lease record.
+        When the old owner is DEAD and the engine copy still runs on a
+        replica we own, attach in place — fence the replica at the new
+        generation and fold its cumulative outputs from the
+        dispatch-time base (the engine is the source of truth, so no
+        token is lost or doubled however stale the lease). Otherwise
+        recompute: resume from the lease's committed progress (>= all
+        delivered positions, by renew-before-emit) on our own
+        replicas, RNG riding the lease."""
+        now = time.monotonic()
+        sampling = SamplingParams(**(rec.get("sampling") or {}))
+        deadline_abs = None
+        if rec.get("deadline_ms") is not None:
+            deadline_abs = now + float(rec["deadline_ms"]) / 1e3
+        prompt = [int(t) for t in rec.get("prompt") or []]
+        progress = [int(t) for t in rec.get("progress") or []]
+        fr = _FleetRequest(
+            request_id=rid, prompt_ids=prompt, sampling=sampling,
+            callback=None, arrival=now, deadline_abs=deadline_abs,
+            tenant=rec.get("tenant") or sampling.tenant_id,
+            cost=len(prompt) + sampling.max_new_tokens,
+            base_generated=list(progress), progress=list(progress),
+            rng_state=rec.get("rng"),
+            handoffs=int(rec.get("handoffs") or 0),
+            dispatches=int(rec.get("dispatches") or 0),
+            lease_gen=gen)
+        self._requests[rid] = fr
+        self._open[rid] = fr
+        h = self._by_id(rec.get("replica_id") or "")
+        if (owner_dead and h is not None and h.alive and not h.retiring
+                and self._steps_replica(h)
+                and h.fence_request(rid, gen)
+                and h.rng_state(rid) is not None):
+            fr.base_generated = [int(t) for t in rec.get("base") or []]
+            fr.replica_id = h.replica_id
+            fr.dispatch_t = now
+            self._assigned.setdefault(h.replica_id, set()).add(rid)
+            return
+        self._queue.push(fr.tenant, rid, 0, front=True)
+
+    def _migrate_disowned(self) -> None:
+        """Router membership changed under us: replicas we no longer
+        own may still run OUR requests (we hold their leases). Pull
+        each one back — commit the recovery point to the lease, abort
+        the engine copy, re-dispatch on replicas we do own."""
+        for h in list(self.replicas):
+            if self._steps_replica(h):
+                continue
+            rids = self._assigned.get(h.replica_id)
+            if not rids:
+                continue
+            for rid in sorted(rids):
+                rids.discard(rid)
+                fr = self._open.get(rid)
+                if fr is None or fr.finished:
+                    continue
+                # lease first, engine second: only the current owner
+                # may touch the engine copy — if a peer adopted while
+                # we were partitioned it may be ATTACHED to this very
+                # copy, and aborting it would kill the client-visible
+                # stream (_fence_local knows the difference)
+                if (fr.lease_gen is not None
+                        and not self.lease_store.check(
+                            rid, self.router_id, fr.lease_gen)):
+                    self._fence_local(fr, h)
+                    continue
+                if h.alive:
+                    # do NOT read rng_state from a disowned replica:
+                    # its new owner may already have stepped the engine
+                    # past our last emission (dropping our outputs on
+                    # its floor), so the live state can run AHEAD of
+                    # fr.progress and resuming from it would skip the
+                    # unemitted positions. fr.rng_state holds the
+                    # emit-committed pair — recover from that.
+                    h.abort_request(rid)
+                    h.release_request(rid)
+                if (fr.lease_gen is not None
+                        and not self.lease_store.renew(
+                            rid, self.router_id, fr.lease_gen,
+                            progress=list(fr.progress),
+                            base=list(fr.progress),
+                            rng=fr.rng_state)):
+                    self._fence_local(fr)
+                    continue
+                self._requeue(fr, count_handoff=False)
+
+    def _hand_over(self, fr: _FleetRequest) -> None:
+        """We own no replica that could run this request, but a peer
+        does: publish (or refresh) its lease marked ORPHAN — orphan
+        leases are adopted immediately, no TTL wait — and drop our
+        copy without emitting. The adopter's stream becomes the
+        client-visible one, exactly as after a failover."""
+        rid, ls = fr.request_id, self.lease_store
+        if fr.lease_gen is None:
+            rec = self._lease_record(fr)
+            rec["orphan"] = True
+            ls.acquire(rid, self.router_id, rec)
+        else:
+            ls.renew(rid, self.router_id, fr.lease_gen, orphan=True,
+                     progress=list(fr.progress), rng=fr.rng_state)
+        self.num_requests_handed_over += 1
+        fr.lease_gen = None
+        fr.finished = True
+        fr.finish_reason = "fenced"
+        self._open.pop(rid, None)
+
+    def _lease_for_dispatch(self, fr: _FleetRequest,
+                            handle: ReplicaHandle) -> bool:
+        """Own the lease and fence the destination before any engine
+        work. False = the request was dropped locally (foreign owner,
+        fenced renew, or replica-side fence refusal) and the caller
+        must not dispatch."""
+        rid, ls = fr.request_id, self.lease_store
+        if fr.lease_gen is None:
+            gen = ls.acquire(rid, self.router_id,
+                             self._lease_record(fr, handle))
+            if gen is None:
+                # a FRESH foreign lease exists: someone else runs this
+                # request — drop our copy, touch nothing of theirs
+                self._fence_local(fr)
+                return False
+            fr.lease_gen = gen
+        elif not ls.renew(rid, self.router_id, fr.lease_gen,
+                          replica_id=handle.replica_id,
+                          base=list(fr.base_generated),
+                          progress=list(fr.progress),
+                          rng=fr.rng_state):
+            self._fence_local(fr)
+            return False
+        if not handle.fence_request(rid, fr.lease_gen):
+            # the replica has seen a higher generation for this rid:
+            # we are the stale side of an adoption race
+            self._fence_local(fr)
+            return False
+        return True
+
+    def _lease_record(self, fr: _FleetRequest,
+                      handle: Optional[ReplicaHandle] = None) -> Dict:
+        rec = {"tenant": fr.tenant,
+               "prompt": list(fr.prompt_ids),
+               "sampling": dataclasses.asdict(fr.sampling),
+               "base": list(fr.base_generated),
+               "progress": list(fr.progress),
+               "rng": fr.rng_state,
+               "replica_id": (handle.replica_id if handle is not None
+                              else fr.replica_id),
+               "handoffs": fr.handoffs,
+               "dispatches": fr.dispatches}
+        if fr.deadline_abs is not None:
+            rec["deadline_ms"] = max(
+                0.0, (fr.deadline_abs - time.monotonic()) * 1e3)
+        return rec
+
+    def _renew_before_emit(self, fr: _FleetRequest,
+                           handle: ReplicaHandle, out: RequestOutput,
+                           new_progress: List[int]) -> bool:
+        """THE replicated-mode invariant: commit progress (and the RNG
+        state that continues it) to the lease BEFORE those tokens reach
+        the client. The committed progress is then always >= every
+        delivered position, so an adopter resuming from it can never
+        emit a position twice. A False renewal — fenced or write
+        dropped, indistinguishable by design — self-fences."""
+        updates: Dict[str, object] = {
+            "progress": list(new_progress),
+            "replica_id": handle.replica_id}
+        if not out.finished:
+            updates["rng"] = handle.rng_state(fr.request_id)
+        if fr.deadline_abs is not None:
+            updates["deadline_ms"] = max(
+                0.0, (fr.deadline_abs - time.monotonic()) * 1e3)
+        if self.lease_store.renew(fr.request_id, self.router_id,
+                                  fr.lease_gen, **updates):
+            if "rng" in updates:
+                # keep the emit-committed (progress, rng) pair on the
+                # request: recovery paths that cannot trust a live
+                # engine read (a disowned replica may have been stepped
+                # past our emissions by its new owner) resume from this
+                fr.rng_state = updates["rng"]
+            return True
+        self._fence_local(fr, handle)
+        return False
+
+    def _fence_local(self, fr: _FleetRequest,
+                     handle: Optional[ReplicaHandle] = None) -> None:
+        """We lost this request's lease (or never had it): drop our
+        copy WITHOUT emitting — the new owner's stream is the only
+        client-visible one — and abort any engine-side copy so it
+        stops burning steps. Not a client terminal: no finish_counts
+        entry, no output record.
+
+        Engine-abort guard: when the CURRENT lease shows the new owner
+        on the SAME replica, it attached in place to the very copy we
+        dispatched (we looked dead during a partition; we weren't) —
+        that copy is now the client-visible stream and only its owner
+        may abort it. Any other engine copy of ours is a private
+        zombie nobody else references: abort it freely."""
+        rid = fr.request_id
+        self.num_requests_fenced += 1
+        if handle is None and fr.replica_id is not None:
+            handle = self._by_id(fr.replica_id)
+        if handle is not None and handle.alive:
+            rec = self.lease_store._load(rid) \
+                if self.lease_store is not None else None
+            adopter_attached = (
+                rec is not None
+                and rec.get("owner") != self.router_id
+                and rec.get("replica_id") == handle.replica_id)
+            if not adopter_attached:
+                handle.abort_request(rid)
+                handle.release_request(rid)
+        if fr.replica_id is not None:
+            self._assigned.get(fr.replica_id, set()).discard(rid)
+        fr.lease_gen = None
+        fr.finished = True
+        fr.finish_reason = "fenced"
+        self._open.pop(rid, None)
+
     def _dispatch_queue(self, outputs: List[RequestOutput]) -> None:
         while True:
             popped = self._queue.pop()
@@ -588,9 +1027,17 @@ class FleetRouter:
                 self._finalize(fr, "expired", None, outputs)
                 continue
             prompt = fr.prompt_ids + fr.base_generated
-            cands = [h for h in self.dispatchable()
+            cands = [h for h in self._own_dispatchable()
                      if h.admission_verdict(len(prompt)) is None]
             if not cands:
+                if (self.lease_store is not None
+                        and not self._own_dispatchable()
+                        and len(self._routers_view) > 1):
+                    # we own NO replica at all (rendezvous gave them
+                    # all to peers): hand the request over instead of
+                    # blocking a queue nobody will ever drain
+                    self._hand_over(fr)
+                    continue
                 # head-of-line blocks (DRR order is the fairness
                 # contract — skipping ahead would let cheap requests
                 # overtake a starved tenant)
@@ -598,6 +1045,11 @@ class FleetRouter:
                 return
             handle = self._pick(self._role_candidates(cands, fr),
                                 prompt)
+            if (self.lease_store is not None
+                    and not self._lease_for_dispatch(fr, handle)):
+                # fenced or foreign-owned: the local copy was dropped
+                # (nothing emitted) — move on to the next queued item
+                continue
             shipped = False
             if fr.kv is not None:
                 meta, payload = fr.kv
@@ -624,9 +1076,22 @@ class FleetRouter:
             elif fr.ship_src is not None:
                 shipped = self._ticket_ladder(fr, handle, prompt, now)
             if not shipped:
-                handle.add_request(rid, prompt,
-                                   self._effective_sampling(fr, now),
-                                   rng_state=fr.rng_state)
+                try:
+                    handle.add_request(rid, prompt,
+                                       self._effective_sampling(fr, now),
+                                       rng_state=fr.rng_state)
+                except ValueError:
+                    if self.lease_store is None:
+                        raise
+                    # duplicate rid on this engine: a transiently split
+                    # ownership view let another router's copy land
+                    # there first — drop OURS without aborting theirs
+                    self.num_requests_fenced += 1
+                    fr.lease_gen = None
+                    fr.finished = True
+                    fr.finish_reason = "fenced"
+                    self._open.pop(rid, None)
+                    continue
                 if fr.dispatches > 0:
                     # a continuation without KV re-prefills its whole
                     # context (the single computed position excepted)
@@ -776,7 +1241,7 @@ class FleetRouter:
         now = time.monotonic()
         self._shipped = {k: t for k, t in self._shipped.items()
                          if now - t < cfg.prefix_decay_s}
-        live = self.dispatchable()
+        live = self._own_dispatchable()
         if len(live) < 2:
             return
         budget = cfg.max_prefix_ships_per_step
@@ -1103,7 +1568,12 @@ class FleetRouter:
         fr = self._open.get(out.request_id)
         if fr is None:
             return  # not router-owned (or already finalized)
-        fr.progress = fr.base_generated + list(out.generated)
+        new_progress = fr.base_generated + list(out.generated)
+        if (self.lease_store is not None and fr.lease_gen is not None
+                and not self._renew_before_emit(fr, handle, out,
+                                                new_progress)):
+            return  # fenced: dropped locally, nothing emitted
+        fr.progress = new_progress
         if out.token is not None:
             self.num_tokens_emitted += 1
         if not out.finished:
@@ -1162,6 +1632,16 @@ class FleetRouter:
     def _finalize(self, fr: _FleetRequest, reason: Optional[str],
                   token: Optional[int],
                   outputs: List[RequestOutput]) -> None:
+        if self.lease_store is not None and fr.lease_gen is not None:
+            gen, fr.lease_gen = fr.lease_gen, None
+            if not self.lease_store.release(fr.request_id,
+                                            self.router_id, gen):
+                # fenced at the finish line: a peer adopted the lease
+                # between our last renew and this terminal — the
+                # adopter's stream is the client-visible one, so our
+                # terminal must not emit
+                self._fence_local(fr)
+                return
         self._drop_pending_ship(fr)  # no KV snapshot outlives its request
         fr.finished = True
         fr.finish_reason = reason

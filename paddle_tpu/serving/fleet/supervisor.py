@@ -71,6 +71,11 @@ class WorkerSpec:
     # its endpoint (heartbeat meta + ping reply). False pins the fleet
     # to the router-relay path — the bench comparison knob.
     peer: bool = True
+    # replicated control plane: open a TCP control listener in each
+    # worker and advertise it in the heartbeat meta as "rpc", so router
+    # processes other than the spawning supervisor can drive the worker
+    # (and a replacement router can reconnect after a failover).
+    tcp: bool = False
 
 
 @dataclass
@@ -100,6 +105,13 @@ class _Slot:
         self.backoff_s: Optional[float] = None
         self.next_restart_at: Optional[float] = None
         self.failed = False          # out of restart budget
+        # generation ids (w0-g2, ...) whose death was already answered
+        # with a restart: the restart key is (worker id, GENERATION),
+        # not the worker id alone — two routers sharing a supervisor
+        # view after an adoption can re-observe the same corpse, and a
+        # corpse must never buy a second restart of a slot whose
+        # replacement is already alive
+        self.handled_gens: set = set()
 
 
 class ReplicaSupervisor:
@@ -218,6 +230,10 @@ class ReplicaSupervisor:
                 continue
             if h.retiring:
                 continue  # scale-down drain finishing; not a crash
+            gen_id = h.replica_id
+            if gen_id in slot.handled_gens:
+                continue  # this generation's death already bought its
+                # restart; a re-observed corpse is not a new failure
             self._reap(slot)
             if slot.next_restart_at is None:
                 if slot.restarts >= self.cfg.max_restarts:
@@ -241,6 +257,7 @@ class ReplicaSupervisor:
                 handle = self._launch(slot)
             except RuntimeError:
                 continue  # boot failed; next poll reschedules
+            slot.handled_gens.add(gen_id)
             self.num_restarts += 1
             if self.router is not None:
                 self.router.attach_replica(handle)

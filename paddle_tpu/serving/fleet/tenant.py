@@ -26,9 +26,22 @@ pins it, for workloads that want a fixed granularity.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["TenantQueue"]
+from .lease import rendezvous_owner
+
+__all__ = ["TenantQueue", "tenant_home"]
+
+
+def tenant_home(tenant: str, routers: Sequence[str]) -> Optional[str]:
+    """Which router's queue a tenant's requests belong to, under the
+    replicated control plane: rendezvous hashing over the live router
+    ids, so each tenant queue lives at exactly one router at a time and
+    a router join/leave only moves the tenants that router owned. The
+    submitting client and every router compute the same answer from the
+    same router-registry view — there is no assignment table to keep
+    consistent."""
+    return rendezvous_owner(f"tenant:{tenant}", routers)
 
 
 class TenantQueue:

@@ -83,6 +83,7 @@ from paddle_tpu.testing import faults
 __all__ = [
     "RpcError", "RpcTimeout", "ReplicaGone", "RpcRemoteError",
     "RpcClient", "ReplicaServicer", "SubprocessReplica",
+    "connect_replica",
     "send_frame", "recv_frame", "send_frame_with_blob",
     "IDEMPOTENT_METHODS", "DEFAULT_DEADLINES",
     "PeerListener", "peer_push", "peer_secret", "sign_ticket",
@@ -415,6 +416,8 @@ IDEMPOTENT_METHODS = frozenset({
     "ping", "admission_verdict", "estimated_ttft_ms", "load",
     "is_draining", "drained", "has_unfinished", "rng_state", "snapshot",
     "export_kv", "prefix_digest", "export_prefix",
+    # re-asserting a lease generation is a no-op (max-register update)
+    "fence_request",
 })
 
 # per-method deadline overrides: step/start_drain cover the engine's
@@ -665,27 +668,110 @@ class ReplicaServicer:
                 return
             if msg is None:
                 return
-            reply = self.handle(msg)
-            blob = None
-            res = reply.get("result")
-            if isinstance(res, dict) and "_blob" in res:
-                blob = res.pop("_blob")  # rides as a raw frame instead
-            stopping = should_stop is not None and should_stop()
-            if (stopping and reply.get("ok")
-                    and isinstance(reply.get("result"), dict)
-                    and "outputs" in reply["result"]):
-                # last breath: tell the client this exit is a finished
-                # drain, not a crash — the handle marks itself retiring
-                # and the router reaps instead of counting a death
-                reply["result"]["drained_out"] = True
-            if blob is None:
-                send_frame(sock, reply)
-            else:
-                send_frame_with_blob(sock, reply, blob)
-            if msg.get("method") == "shutdown" or stopping:
+            if self._serve_one(sock, msg, should_stop):
                 return
             if self.on_tick is not None:
                 self.on_tick()
+
+    def _serve_one(self, sock: socket.socket, msg: dict,
+                   should_stop) -> bool:
+        """Execute one request and reply on ``sock``. Returns True when
+        the loop should exit (shutdown verb, or ``should_stop()``)."""
+        reply = self.handle(msg)
+        blob = None
+        res = reply.get("result")
+        if isinstance(res, dict) and "_blob" in res:
+            blob = res.pop("_blob")  # rides as a raw frame instead
+        stopping = should_stop is not None and should_stop()
+        if (stopping and reply.get("ok")
+                and isinstance(reply.get("result"), dict)
+                and "outputs" in reply["result"]):
+            # last breath: tell the client this exit is a finished
+            # drain, not a crash — the handle marks itself retiring
+            # and the router reaps instead of counting a death
+            reply["result"]["drained_out"] = True
+        if blob is None:
+            send_frame(sock, reply)
+        else:
+            send_frame_with_blob(sock, reply, blob)
+        return msg.get("method") == "shutdown" or stopping
+
+    def serve_multi(self, primary: socket.socket,
+                    listener: Optional[socket.socket] = None,
+                    should_stop=None) -> None:
+        """Service loop for a worker that is reachable by MORE than its
+        spawning driver: the supervisor's socketpair (``primary``) plus
+        a TCP ``listener`` whose endpoint the worker advertises through
+        its heartbeat meta (the ``rpc`` key). Router processes connect
+        and reconnect at will; a SIGKILLed router only costs its own
+        connection — EOF on an accepted socket drops THAT socket and
+        the loop returns to select, which is what lets workers outlive
+        the router that is being failed over. EOF on ``primary`` (the
+        supervisor died) still ends the worker, same contract as
+        :meth:`serve`.
+
+        Still strictly single-threaded, one request serviced at a time:
+        readiness is multiplexed with ``selectors`` but each frame is
+        read and answered to its originating socket before the next is
+        picked up — the engine is not thread-safe and does not become
+        so here."""
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(primary, selectors.EVENT_READ, "primary")
+        if listener is not None:
+            sel.register(listener, selectors.EVENT_READ, "listener")
+        accepted: List[socket.socket] = []
+        try:
+            while True:
+                for key, _ in sel.select():
+                    sock = key.fileobj
+                    if key.data == "listener":
+                        try:
+                            conn, _addr = sock.accept()
+                        except OSError:
+                            continue
+                        sel.register(conn, selectors.EVENT_READ, "conn")
+                        accepted.append(conn)
+                        continue
+                    try:
+                        msg = recv_frame(sock)
+                    except OSError:
+                        msg = None
+                    if msg is None:  # this caller is gone
+                        if key.data == "primary":
+                            return
+                        sel.unregister(sock)
+                        accepted.remove(sock)
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        if self._serve_one(sock, msg, should_stop):
+                            return
+                    except OSError:
+                        # reply delivery failed mid-service: the caller
+                        # died between sending and reading. Its state
+                        # change (if any) stands; drop the connection.
+                        if key.data == "primary":
+                            return
+                        sel.unregister(sock)
+                        accepted.remove(sock)
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        continue
+                    if self.on_tick is not None:
+                        self.on_tick()
+        finally:
+            sel.close()
+            for conn in accepted:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _rng_for(self, outputs: List[RequestOutput]) -> Dict[str, dict]:
         """Post-step RNG states for every request that emitted this
@@ -776,6 +862,8 @@ class ReplicaServicer:
         if method == "release_request":
             r.release_request(p["request_id"])
             return True
+        if method == "fence_request":
+            return bool(r.fence_request(p["request_id"], int(p["gen"])))
         if method == "step":
             outs = r.step()
             return self._step_reply(outs)
@@ -958,6 +1046,15 @@ class SubprocessReplica(ReplicaHandle):
 
     def snapshot(self) -> dict:
         return self._query("snapshot", default={}) or {}
+
+    def fence_request(self, request_id: str, gen: int) -> bool:
+        # default True on unreachability: only an explicit replica-side
+        # refusal is a fence verdict — a dead/unreachable worker cannot
+        # emit for ANY owner, and the dispatch that follows fails on
+        # its own (health sweep requeues with the lease intact)
+        return bool(self._query(
+            "fence_request", {"request_id": request_id, "gen": int(gen)},
+            default=True))
 
     def rng_state(self, request_id: str):
         # cache-first, deliberately: the cache advances only with step
@@ -1189,3 +1286,34 @@ class SubprocessReplica(ReplicaHandle):
     def close(self) -> None:
         self._client.close()
         self._dead = True
+
+
+def connect_replica(replica_id: str, endpoint: str, *,
+                    deadlines: Optional[Dict[str, float]] = None,
+                    role: Optional[str] = None,
+                    deadline_s: float = 30.0) -> SubprocessReplica:
+    """Attach to an already-running worker by its control endpoint.
+
+    The replicated-control-plane join path: workers spawned with
+    ``WorkerSpec(tcp=True)`` advertise a ``host:port`` control listener
+    in their heartbeat meta under ``"rpc"`` (serviced by
+    :meth:`ReplicaServicer.serve_multi`), so any router process — not
+    just the spawning supervisor — can drive them, and a replacement
+    router can re-adopt a fleet whose previous router was SIGKILLed.
+    Pings once before returning, so a stale endpoint fails fast here
+    rather than on the first dispatch."""
+    host, _, port = endpoint.rpartition(":")
+    sock = socket.create_connection((host, int(port)),
+                                    timeout=deadline_s)
+    client = RpcClient(sock, name=replica_id)
+    handle = SubprocessReplica(replica_id, client, deadlines=deadlines,
+                               role=role)
+    try:
+        pong = client.call("ping", deadline_s=deadline_s)
+    except (RpcError, OSError) as e:
+        client.close()
+        raise ReplicaGone(
+            f"worker {replica_id} at {endpoint} unreachable: {e}")
+    if isinstance(pong, dict) and pong.get("peer"):
+        handle.peer_endpoint = pong["peer"]
+    return handle

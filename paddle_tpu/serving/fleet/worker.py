@@ -15,11 +15,20 @@ Env protocol (set by :class:`ReplicaSupervisor`):
         {"model": "tiny_llama" | "pkg.module:factory",
          "seed": 0, "engine": {...EngineConfig kwargs...},
          "role": "prefill" | "decode" | null,
-         "peer": true | false}
+         "peer": true | false,
+         "tcp": true | false}
 
     ``peer`` (default true) opens the worker's :class:`PeerListener`
     — the direct worker↔worker KV data plane — and advertises its
     endpoint in the heartbeat meta next to the role.
+
+    ``tcp`` (default false) additionally opens a TCP control listener
+    and advertises it in the heartbeat meta as ``rpc`` — the
+    replicated-control-plane mode: router processes OTHER than the
+    spawning supervisor discover the endpoint from the registry and
+    drive this worker over their own connections
+    (:meth:`ReplicaServicer.serve_multi`), so a SIGKILLed router only
+    drops its connection and the worker keeps serving everyone else.
 
     ``tiny_llama`` builds the deterministic tiny-Llama every fleet
     test uses (``paddle.seed(seed)`` then ``LlamaConfig.tiny()`` — the
@@ -172,6 +181,23 @@ def main() -> int:
         except OSError:
             pass  # no listener — the router relays, as before
 
+    # replicated control plane: a TCP listener beside the supervisor
+    # socketpair, advertised through the heartbeat so ANY router can
+    # connect (and a replacement router can reconnect after failover)
+    rpc_listener = None
+    if spec.get("tcp", False):
+        try:
+            rpc_listener = socket.socket(socket.AF_INET,
+                                         socket.SOCK_STREAM)
+            rpc_listener.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEADDR, 1)
+            rpc_listener.bind(("127.0.0.1", 0))
+            rpc_listener.listen(16)
+            host, port = rpc_listener.getsockname()
+            hb_meta.update(rpc=f"{host}:{port}")
+        except OSError:
+            rpc_listener = None  # supervisor socketpair only
+
     hb_stop = None
     if store_dir:
         hb_stop = _start_heartbeat(replica_id, store_dir, hb_interval,
@@ -193,15 +219,22 @@ def main() -> int:
                 and not replica.has_unfinished())
 
     try:
-        ReplicaServicer(replica, on_tick=on_tick).serve(
-            sock, should_stop=drained_out)
+        servicer = ReplicaServicer(replica, on_tick=on_tick)
+        if rpc_listener is not None:
+            servicer.serve_multi(sock, listener=rpc_listener,
+                                 should_stop=drained_out)
+        else:
+            servicer.serve(sock, should_stop=drained_out)
     finally:
         if hb_stop is not None:
             hb_stop.set()
-        try:
-            sock.close()
-        except OSError:
-            pass
+        for s in (sock, rpc_listener):
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
     return 0
 
 

@@ -75,8 +75,11 @@ class RequestStatus(Enum):
 #   "aborted:drain"     engine drained (SIGTERM/preemption) before it ran
 #   "aborted:nonfinite" its logits went NaN/Inf (batch peers continue)
 #   "aborted:error"     engine step failed past the retry budget
+#   "fenced"            lease lost to another router; the local copy is
+#                       dropped without emitting (the adopter finishes it)
 FINISH_REASONS = ("stop", "length", "expired", "rejected", "aborted:user",
-                  "aborted:drain", "aborted:nonfinite", "aborted:error")
+                  "aborted:drain", "aborted:nonfinite", "aborted:error",
+                  "fenced")
 
 
 @dataclass

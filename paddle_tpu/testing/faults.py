@@ -28,7 +28,17 @@ plane's ``fleet.peer_connect_fail`` / ``fleet.peer_send_drop`` /
 ``fleet.peer_frame_corrupt`` / ``fleet.peer_stall`` fire once per
 ``peer_push`` attempt; ``serving.kv_scatter`` fires inside the engine's
 KV/prefix import between block allocation and scatter — ``raise`` there
-exercises the partial-failure cleanup path).
+exercises the partial-failure cleanup path. The replicated control
+plane adds three KEYED flag points — the consumer passes ``key=`` to
+:func:`check` so a targeted fault is only consumed by the consumer it
+names: ``fleet.router_kill:flag:<router_id>`` is queried once per
+router step and makes that router go silent in place, the in-process
+equivalent of SIGKILLing it; ``fleet.lease_expire:flag:<rid>`` is
+queried at every lease renewal and drops that request's renewal write
+while returning failure, forcing the owner to self-fence; and
+``fleet.lease_steal:flag[:<rid>]`` is queried by the adoption sweep and
+force-adopts a live foreign lease, exercising the expiry-race path
+without waiting out a TTL).
 Actions: ``crash`` (``os._exit(FAULT_EXIT)`` — no cleanup, no atexit,
 the in-process equivalent of SIGKILL), ``raise`` (``OSError``),
 ``sleep:<seconds>``, ``touch:<path>`` (progress marker so a parent test
@@ -142,12 +152,23 @@ class FaultInjector:
         for f in self._by_point.get(point, ()):
             f.fire()
 
-    def check(self, point: str) -> List[Optional[str]]:
+    def check(self, point: str,
+              key: Optional[str] = None) -> List[Optional[str]]:
         """Fire the point and return the ``arg`` of every ``flag`` fault
         that performed this hit (empty when none did). Non-flag faults
-        installed at the same point fire their actions as usual."""
+        installed at the same point fire their actions as usual.
+
+        ``key`` scopes targeted flags in multi-consumer points: a flag
+        fault whose ``arg`` names a specific target only HITS (and so
+        only burns ``@skip``/``*times`` budget) when ``key`` matches it
+        — an argless flag matches every key. Without this, N routers
+        polling the same point would race to consume a ``*1`` fault
+        aimed at just one of them."""
         out: List[Optional[str]] = []
         for f in self._by_point.get(point, ()):
+            if (key is not None and f.action == "flag"
+                    and f.arg not in (None, "", key)):
+                continue  # targeted at someone else: not a hit
             if f.fire() and f.action == "flag":
                 out.append(f.arg)
         return out
@@ -166,15 +187,16 @@ def fire(point: str):
         _active.fire(point)
 
 
-def check(point: str) -> List[Optional[str]]:
+def check(point: str, key: Optional[str] = None) -> List[Optional[str]]:
     """Production-side hook for data-corruption faults: fire ``point``
     and return the args of the ``flag`` faults that performed, so the
     caller can deterministically poison its own state (e.g. the serving
-    engine's NaN-logits row, BlockManager's forced OOM). Free when no
-    faults are installed."""
+    engine's NaN-logits row, BlockManager's forced OOM). ``key`` scopes
+    targeted flags to one consumer (see :meth:`FaultInjector.check`).
+    Free when no faults are installed."""
     if not _active._by_point:
         return []
-    return _active.check(point)
+    return _active.check(point, key)
 
 
 def install(spec: str) -> FaultInjector:

@@ -1,0 +1,256 @@
+"""Replicated control plane smoke: 2 router PROCESSES, real SIGKILL.
+
+The ``scripts/ci.sh --routers`` stage. Topology:
+
+* 4 worker processes spawned by a :class:`ReplicaSupervisor` with
+  ``WorkerSpec(tcp=True)`` — each advertises a TCP control endpoint in
+  its heartbeat meta, so routers other than the spawning supervisor
+  can drive it (:func:`connect_replica`);
+* router **B** lives in this driver process (supervisor socketpair
+  handles); router **A** is a CHILD PROCESS that attaches to the same
+  workers over TCP and shares the FileStore-backed registries and
+  :class:`LeaseStore`.
+
+Requests are tenant-partitioned across A and B. Once A reports (via a
+marker file) that every one of its requests holds a store lease with
+tokens already decoded, the driver sends the A process a real
+``SIGKILL`` mid-flight. B must detect the stale router record, adopt
+A's leased requests at a bumped fencing generation, and finish them
+with token streams bit-identical to an uninterrupted single-engine
+reference — and the ``fleet/router_failovers`` gauge must read
+exactly 1.
+
+Exit 0 on success; any broken invariant raises.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from paddle_tpu.distributed.replica_registry import ReplicaRegistry
+from paddle_tpu.distributed.store import FileStore
+from paddle_tpu.serving import SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, LeaseStore, ReplicaSupervisor,
+    SupervisorConfig, WorkerSpec, connect_replica, rendezvous_owner,
+    tenant_home,
+)
+
+_ENGINE = dict(block_size=4, max_num_seqs=8, max_model_len=64,
+               drain_grace_s=0.0)
+MAX_NEW = 10
+ROUTER_TTL_S = 3.0
+LEASE_TTL_S = 6.0
+
+
+def _fleet_config() -> FleetConfig:
+    return FleetConfig(heartbeat_interval_s=0.0,
+                       router_ttl_s=ROUTER_TTL_S,
+                       lease_ttl_s=LEASE_TTL_S,
+                       prefix_affinity=False, peer_data_plane=False)
+
+
+def _sampling(tenant: str) -> SamplingParams:
+    return SamplingParams(max_new_tokens=MAX_NEW, temperature=0.8,
+                          top_p=0.9, tenant_id=tenant)
+
+
+# -- child: router A in its own process ----------------------------------
+
+def child(cfg_path: str) -> None:
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    handles = [connect_replica(rid, ep)
+               for rid, ep in sorted(cfg["workers"].items())]
+    store_dir = cfg["store_dir"]
+    router = FleetRouter(
+        handles, _fleet_config(),
+        registry=ReplicaRegistry(FileStore(store_dir)),
+        lease_store=LeaseStore(FileStore(store_dir),
+                               ttl_s=LEASE_TTL_S),
+        router_id=cfg["router_id"])
+    for r in cfg["requests"]:
+        router.add_request(r["rid"], r["prompt"],
+                           sampling=_sampling(r["tenant"]))
+    ready = False
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline:
+        router.step()
+        if not ready:
+            mine = [router.get_request(r["rid"])
+                    for r in cfg["requests"]]
+            if all(fr.lease_gen is not None and not fr.finished
+                   and len(fr.generated) >= 2 for fr in mine):
+                tmp = cfg["ready_path"] + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write("ready")
+                os.replace(tmp, cfg["ready_path"])
+                ready = True
+                # hold still so the SIGKILL provably lands while every
+                # request is mid-decode (nothing can finish asleep);
+                # short of the router TTL, so A never LOOKS dead before
+                # it actually is
+                time.sleep(min(2.0, ROUTER_TTL_S - 1.0))
+        time.sleep(0.005)
+    sys.exit(3)  # the driver never killed us: smoke failure
+
+
+# -- driver: reference, workers, router B, the kill ----------------------
+
+def _requests(model):
+    """6 requests over tenants t0..t5, partitioned by tenant_home over
+    routers {A, B} exactly as the client side would."""
+    rng = np.random.default_rng(11)
+    reqs = []
+    for i in range(6):
+        tenant = f"t{i}"
+        reqs.append({
+            "rid": f"q{i}", "tenant": tenant,
+            "home": tenant_home(tenant, ["A", "B"]),
+            "prompt": list(map(int, rng.integers(
+                0, model.config.vocab_size, size=3 + i % 4)))})
+    return reqs
+
+
+def main() -> None:
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, LLMEngine
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    reqs = _requests(model)
+    a_reqs = [r for r in reqs if r["home"] == "A"]
+    b_reqs = [r for r in reqs if r["home"] == "B"]
+    assert a_reqs and b_reqs, "tenant partition must cover both routers"
+
+    # uninterrupted single-engine reference (worker twins: seed 0)
+    eng = LLMEngine(model, EngineConfig(**_ENGINE))
+    for r in reqs:
+        eng.add_request(r["rid"], r["prompt"],
+                        sampling=_sampling(r["tenant"]))
+    while eng.has_unfinished():
+        eng.step()
+    ref = {r["rid"]: list(eng.get_request(r["rid"]).generated)
+           for r in reqs}
+
+    tmp = tempfile.mkdtemp(prefix="router_smoke_")
+    store_dir = os.path.join(tmp, "store")
+    sup = ReplicaSupervisor(
+        WorkerSpec(model="tiny_llama", seed=0, engine=dict(_ENGINE),
+                   peer=False, tcp=True),
+        SupervisorConfig(store_dir=store_dir))
+    proc_a = None
+    try:
+        handles = [sup.spawn() for _ in range(4)]
+        # both routers must own at least one worker or the victim's
+        # requests would just be orphan-handed over (no failover path)
+        owners = {h.replica_id: rendezvous_owner(h.replica_id,
+                                                 ["A", "B"])
+                  for h in handles}
+        assert len(set(owners.values())) == 2, owners
+
+        # the workers' advertised TCP control endpoints, for A
+        endpoints = {}
+        deadline = time.monotonic() + 60
+        while len(endpoints) < len(handles):
+            assert time.monotonic() < deadline, "no rpc endpoints"
+            for h in handles:
+                rec = sup.registry.record(h.replica_id) or {}
+                ep = (rec.get("meta") or {}).get("rpc")
+                if ep:
+                    endpoints[h.replica_id] = ep
+            time.sleep(0.05)
+
+        router_b = FleetRouter(
+            handles, _fleet_config(), registry=sup.registry,
+            lease_store=LeaseStore(FileStore(store_dir),
+                                   ttl_s=LEASE_TTL_S),
+            router_id="B")
+
+        ready_path = os.path.join(tmp, "A.ready")
+        cfg_path = os.path.join(tmp, "A.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"router_id": "A", "store_dir": store_dir,
+                       "workers": endpoints, "requests": a_reqs,
+                       "ready_path": ready_path}, f)
+        proc_a = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--child", cfg_path],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+        # wait for A to join the router registry before admitting, so
+        # B's replica-ownership view is stable from the first dispatch
+        router_reg = ReplicaRegistry(FileStore(store_dir),
+                                     prefix="fleet_routers",
+                                     ttl_s=ROUTER_TTL_S)
+        deadline = time.monotonic() + 120
+        while router_reg.record("A") is None:
+            assert proc_a.poll() is None, "router A died during boot"
+            assert time.monotonic() < deadline, "router A never joined"
+            time.sleep(0.05)
+
+        for r in b_reqs:
+            router_b.add_request(r["rid"], r["prompt"],
+                                 sampling=_sampling(r["tenant"]))
+
+        killed = False
+        t_kill = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            router_b.step()
+            if not killed and os.path.exists(ready_path):
+                os.kill(proc_a.pid, signal.SIGKILL)
+                killed, t_kill = True, time.monotonic()
+                print("ROUTER_SMOKE_KILLED pid=%d" % proc_a.pid,
+                      flush=True)
+            done = [router_b._requests.get(r["rid"]) for r in reqs]
+            if (killed and all(fr is not None and fr.finished
+                               for fr in done)
+                    and router_b.lease_store.active() == 0):
+                break
+            time.sleep(0.005)
+        else:
+            raise AssertionError("router B failed to converge")
+
+        assert killed and t_kill is not None
+        assert proc_a.wait(timeout=10) == -signal.SIGKILL
+
+        got = {r["rid"]: list(router_b.get_request(r["rid"]).generated)
+               for r in reqs}
+        assert got == ref, "post-SIGKILL token streams diverged"
+        for r in reqs:
+            fr = router_b.get_request(r["rid"])
+            assert fr.finish_reason == "length", (
+                r["rid"], fr.finish_reason)
+        snap = router_b.snapshot()
+        assert snap["fleet_router_failovers"] == 1, snap
+        assert router_b.lease_store.num_adopted == len(a_reqs), (
+            router_b.lease_store.num_adopted, len(a_reqs))
+        assert router_b.lease_store.active() == 0
+        print("ROUTER_SMOKE_OK adopted=%d failovers=%d took=%.1fs"
+              % (router_b.lease_store.num_adopted,
+                 snap["fleet_router_failovers"],
+                 time.monotonic() - t_kill), flush=True)
+    finally:
+        if proc_a is not None and proc_a.poll() is None:
+            proc_a.kill()
+            proc_a.wait(timeout=10)
+        sup.shutdown()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+    else:
+        main()

@@ -1265,6 +1265,51 @@ class TestBlockingUnderLock:
         """)
         assert fs == []
 
+    def test_lease_renew_store_write_under_lock(self):
+        # the trap the replicated control plane's LeaseStore avoids by
+        # being lock-free: a store write (an RPC on FileStore/TCPStore
+        # backends) inside the lease mutex would serialize every
+        # renew-before-emit on the slowest store round-trip
+        fs = run("""
+            import threading
+
+            class LockedLeaseStore:
+                def __init__(self, store):
+                    self._lock = threading.Lock()
+                    self._store = store
+                    self._seq = {}
+
+                def renew(self, rid, rec):
+                    with self._lock:
+                        self._seq[rid] = self._seq.get(rid, 0) + 1
+                        rec["seq"] = self._seq[rid]
+                        self._store.set(rid, rec)
+        """)
+        assert rules_of(fs) == ["blocking-under-lock"]
+        assert "self._store.set()" in fs[0].message
+        assert "LockedLeaseStore._lock" in fs[0].message
+
+    def test_near_miss_lease_seq_under_lock_write_after_clean(self):
+        # the correct shape: bump the sequence under the lock, release,
+        # THEN do the store round-trip with the captured value
+        fs = run("""
+            import threading
+
+            class LeaseStore:
+                def __init__(self, store):
+                    self._lock = threading.Lock()
+                    self._store = store
+                    self._seq = {}
+
+                def renew(self, rid, rec):
+                    with self._lock:
+                        self._seq[rid] = self._seq.get(rid, 0) + 1
+                        seq = self._seq[rid]
+                    rec["seq"] = seq
+                    self._store.set(rid, rec)
+        """)
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # signal-handler-unsafe

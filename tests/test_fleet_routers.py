@@ -1,0 +1,651 @@
+"""Replicated control plane acceptance pins (ISSUE 16).
+
+Four layers:
+
+* unit — rendezvous hashing (stability under membership change),
+  ``LeaseStore`` lifecycle + exact incarnation accounting,
+  reader-monotonic TTL (wall skew cannot steal a live lease),
+  generation fencing (``fence_request``), keyed fault flags;
+* model-free — loopback router twins over :class:`SimReplica`:
+  orphan hand-over when rendezvous gives a router zero replicas,
+  supervisor restart keyed by (worker id, generation);
+* tiny-Llama e2e — the headline guarantee: a 2-router fleet whose
+  request-owning router is SIGKILLed mid-decode produces BIT-IDENTICAL
+  streams (greedy AND sampled) to an uninterrupted single-router
+  reference, through both adoption paths (attach-in-place when the
+  engine copy survives, recompute-from-lease when the replica died
+  with its router);
+* simulation — the discrete-event fleet sim at tier-1 scale, plus the
+  100-replica acceptance run (slow-marked) with the <60 s wall bound.
+"""
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.replica_registry import MemStore, ReplicaRegistry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, SamplingParams
+from paddle_tpu.serving.fleet import (
+    ChaosEvent, FleetConfig, FleetRouter, FleetSim, InProcessReplica,
+    LeaseStore, ReplicaHandle, SimReplica, diurnal_trace,
+    rendezvous_owner, sim_token, spike_trace,
+)
+from paddle_tpu.serving.fleet.supervisor import (
+    ReplicaSupervisor, SupervisorConfig, _Slot,
+)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous hashing
+# ---------------------------------------------------------------------------
+class TestRendezvous:
+    def test_deterministic_and_total(self):
+        owners = ["R0", "R1", "R2"]
+        for key in ("tenant-a", "sr042", "adopt:req-7"):
+            assert rendezvous_owner(key, owners) == \
+                rendezvous_owner(key, list(reversed(owners)))
+            assert rendezvous_owner(key, owners) in owners
+        assert rendezvous_owner("x", []) is None
+
+    def test_member_removal_only_moves_its_keys(self):
+        owners = [f"R{i}" for i in range(4)]
+        keys = [f"k{i}" for i in range(200)]
+        before = {k: rendezvous_owner(k, owners) for k in keys}
+        after = {k: rendezvous_owner(k, owners[:-1]) for k in keys}
+        for k in keys:
+            if before[k] != "R3":
+                assert after[k] == before[k]  # others never reshuffle
+        moved = [k for k in keys if before[k] == "R3"]
+        assert moved and all(after[k] != "R3" for k in moved)
+
+    def test_spreads_load(self):
+        owners = ["R0", "R1", "R2"]
+        hist = {o: 0 for o in owners}
+        for i in range(300):
+            hist[rendezvous_owner(f"key{i}", owners)] += 1
+        assert all(v > 50 for v in hist.values()), hist
+
+
+# ---------------------------------------------------------------------------
+# LeaseStore
+# ---------------------------------------------------------------------------
+class TestLeaseStore:
+    def test_lifecycle_and_accounting(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        gen = ls.acquire("r1", "A", {"progress": []})
+        assert gen == 0 and ls.active() == 1
+        assert ls.renew("r1", "A", gen, progress=[1, 2])
+        assert ls._load("r1")["progress"] == [1, 2]
+        assert ls.release("r1", "A", gen)
+        assert ls.active() == 0
+        assert (ls.num_acquired, ls.num_completed) == (1, 1)
+
+    def test_fresh_foreign_lease_not_acquirable(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        assert ls.acquire("r1", "A", {}) == 0
+        assert ls.acquire("r1", "B", {}) is None
+        assert ls.acquire("r1", "A", {}) == 0  # own retry keeps gen
+
+    def test_stale_foreign_supersede_buckets_expired(self):
+        store = MemStore()
+        ls = LeaseStore(store, ttl_s=0.5)
+        t = [0.0]
+        ls._mono = lambda: t[0]
+        assert ls.acquire("r1", "A", {}) == 0
+        assert ls.fresh("r1")  # first sighting counts as a change
+        t[0] = 10.0  # TTL lapses with no seq change
+        assert ls.fresh("r1") is False
+        gen = ls.acquire("r1", "B", {})
+        assert gen == 1  # superseded with a bumped generation
+        assert ls.num_expired == 1 and ls.num_acquired == 2
+
+    def test_renew_and_release_fence_on_owner_and_gen(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        gen = ls.acquire("r1", "A", {})
+        assert not ls.renew("r1", "B", gen)       # wrong owner
+        assert not ls.renew("r1", "A", gen + 1)   # wrong generation
+        assert not ls.release("r1", "B", gen)
+        assert ls.num_fence_refusals == 3
+        assert ls.active() == 1  # fenced calls never mutate
+
+    def test_adopt_bumps_gen_and_fences_old_owner(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        gen = ls.acquire("r1", "A", {"progress": [1]})
+        res = ls.adopt("r1", "B", outcome="adopted")
+        assert res is not None
+        new_gen, old = res
+        assert new_gen == gen + 1 and old["owner"] == "A"
+        assert not ls.renew("r1", "A", gen)  # stale owner fenced
+        assert ls.renew("r1", "B", new_gen)
+        assert ls.adopt("r1", "B", outcome="adopted") is None  # own
+        assert (ls.num_acquired, ls.num_adopted) == (2, 1)
+        assert ls.release("r1", "B", new_gen)
+        # fleet-total invariant: every incarnation in exactly one bucket
+        assert ls.num_acquired == \
+            ls.num_completed + ls.num_adopted + ls.num_expired
+
+    def test_adoption_clears_orphan_flag(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        ls.acquire("r1", "A", {"orphan": True})
+        ls.adopt("r1", "B", outcome="adopted")
+        assert "orphan" not in ls._load("r1")
+
+    def test_wall_clock_skew_cannot_steal(self):
+        """Freshness runs on the READER's monotonic clock: a writer
+        whose wall clock is hours behind still holds its lease as long
+        as its seq keeps changing."""
+        store = MemStore()
+        writer = LeaseStore(store, ttl_s=0.5)
+        reader = LeaseStore(store, ttl_s=0.5)
+        rt = [0.0]
+        reader._mono = lambda: rt[0]
+        gen = writer.acquire("r1", "A", {})
+        for _ in range(5):
+            rt[0] += 0.4  # under TTL between renew sightings
+            assert writer.renew("r1", "A", gen)
+            assert reader.fresh("r1")
+        rt[0] += 10.0  # renewals stop: NOW it goes stale
+        assert not reader.fresh("r1")
+
+    def test_expire_fault_drops_write_and_returns_false(self):
+        ls = LeaseStore(MemStore(), ttl_s=5.0)
+        gen = ls.acquire("r1", "A", {"progress": []})
+        faults.install("fleet.lease_expire:flag:r1*1")
+        assert not ls.renew("r1", "A", gen, progress=[1])
+        assert ls.num_renew_dropped == 1
+        assert ls._load("r1")["progress"] == []  # write really dropped
+        assert ls.renew("r1", "A", gen, progress=[1])  # budget spent
+
+    def test_rid_validation(self):
+        ls = LeaseStore(MemStore())
+        with pytest.raises(ValueError):
+            ls.acquire("a/b", "A", {})
+        with pytest.raises(ValueError):
+            ls.acquire("a__b", "A", {})
+
+
+# ---------------------------------------------------------------------------
+# keyed fault flags + replica-side generation fence
+# ---------------------------------------------------------------------------
+class TestFencing:
+    def test_keyed_flag_only_hits_matching_key(self):
+        inj = faults.install("p:flag:target*1")
+        assert faults.check("p", key="other") == []
+        assert inj.faults("p")[0].hits == 0  # budget NOT burned
+        assert faults.check("p", key="target") == ["target"]
+        assert faults.check("p", key="target") == []  # *1 spent
+
+    def test_argless_flag_matches_every_key(self):
+        faults.install("p:flag")
+        assert faults.check("p", key="anything") == [None]
+        assert faults.check("p") == [None]
+
+    def test_fence_request_refuses_stale_generation(self):
+        h = SimReplica("sr0")
+        assert h.fence_request("r1", 0)
+        assert h.fence_request("r1", 0)      # idempotent re-assert
+        assert h.fence_request("r1", 2)
+        assert not h.fence_request("r1", 1)  # stale owner refused
+        assert h.fence_request("r1", 2)
+
+    def test_fence_table_bounded(self):
+        h = SimReplica("sr0")
+        for i in range(400):
+            h.fence_request(f"r{i}", 1)
+        assert len(h._request_fences) <= 256
+
+
+# ---------------------------------------------------------------------------
+# SimReplica: deterministic streams + adoption surface
+# ---------------------------------------------------------------------------
+class TestSimReplica:
+    def test_stream_is_position_keyed_and_exact(self):
+        h = SimReplica("sr0")
+        h.add_request("r1", [1, 2, 3], SamplingParams(max_new_tokens=4))
+        gens = []
+        while h.has_unfinished():
+            gens += h.step()
+        assert gens[-1].finished and gens[-1].finish_reason == "length"
+        assert gens[-1].generated == [sim_token("r1", i)
+                                      for i in range(4)]
+
+    def test_rng_state_rides_position_through_adoption(self):
+        a = SimReplica("sra")
+        a.add_request("r1", [1], SamplingParams(max_new_tokens=6))
+        a.step(); a.step()
+        state = a.rng_state("r1")
+        assert state == {"pos": 2}
+        b = SimReplica("srb")
+        b.add_request("r1", [1], SamplingParams(max_new_tokens=4),
+                      rng_state=state)
+        outs = []
+        while b.has_unfinished():
+            outs += b.step()
+        # resumed copy continues the ABSOLUTE position stream
+        assert outs[-1].generated == [sim_token("r1", 2 + i)
+                                      for i in range(4)]
+
+    def test_duplicate_rid_raises(self):
+        h = SimReplica("sr0")
+        h.add_request("r1", [1], SamplingParams())
+        with pytest.raises(ValueError):
+            h.add_request("r1", [1], SamplingParams())
+
+    def test_zombie_rng_survives_abort_until_release(self):
+        h = SimReplica("sr0")
+        h.add_request("r1", [1], SamplingParams(max_new_tokens=8))
+        h.step()
+        assert h.abort_request("r1")
+        assert h.rng_state("r1") == {"pos": 1}  # adoption window
+        h.release_request("r1")
+        assert h.rng_state("r1") is None
+
+    def test_traces_are_deterministic_per_seed(self):
+        kw = dict(duration_s=5.0, tenants=["a", "b"], seed=3)
+        assert diurnal_trace(**kw) == diurnal_trace(**kw)
+        t = spike_trace(duration_s=5.0, tenants=["a"], spike_at=[2.0],
+                        spike_n=7, seed=3)
+        assert sum(1 for a in t if a.t == 2.0) == 7
+
+
+# ---------------------------------------------------------------------------
+# loopback twins over SimReplica (model-free routed behavior)
+# ---------------------------------------------------------------------------
+def _twin_routers(replicas, **cfg_kw):
+    store = MemStore()
+    cfg = FleetConfig(heartbeat_interval_s=0.0, router_ttl_s=0.5,
+                      lease_ttl_s=1.0, prefix_affinity=False,
+                      peer_data_plane=False, **cfg_kw)
+    routers = []
+    for name in ("A", "B"):
+        reg = ReplicaRegistry(store, ttl_s=30.0)
+        routers.append(FleetRouter(
+            replicas, cfg, reg,
+            lease_store=LeaseStore(store, ttl_s=cfg.lease_ttl_s),
+            router_id=name))
+    for r in routers:
+        r.step()  # discover each other
+    return routers
+
+
+class TestTwinRouters:
+    def test_replica_ownership_partitions(self):
+        replicas = [SimReplica(f"sr{i}") for i in range(8)]
+        ra, rb = _twin_routers(replicas)
+        own_a = {h.replica_id for h in ra._own_dispatchable()}
+        own_b = {h.replica_id for h in rb._own_dispatchable()}
+        assert own_a and own_b
+        assert own_a.isdisjoint(own_b)
+        assert own_a | own_b == {h.replica_id for h in replicas}
+
+    def test_orphan_handover_when_owning_no_replica(self):
+        # one replica: rendezvous gives it to exactly one router; the
+        # OTHER router admits for the fleet and hands the request over
+        # through an orphan lease (adopted immediately, no TTL wait)
+        h = SimReplica("sr0")
+        ra, rb = _twin_routers([h])
+        loser = ra if not ra._own_dispatchable() else rb
+        winner = rb if loser is ra else ra
+        assert winner._own_dispatchable()
+        loser.add_request("req-0", [1, 2],
+                          SamplingParams(max_new_tokens=3))
+        got = {}
+        for _ in range(30):
+            for r in (loser, winner):
+                for out in r.step():
+                    if out.finished:
+                        got[out.request_id] = out
+            if "req-0" in got:
+                break
+        out = got["req-0"]
+        assert out.generated == [sim_token("req-0", i)
+                                 for i in range(3)]
+        assert loser.num_requests_handed_over == 1
+        ls = loser.lease_store
+        assert ls.active() == 0
+        total_acq = sum(r.lease_store.num_acquired for r in (ra, rb))
+        total_done = sum(r.lease_store.num_completed +
+                         r.lease_store.num_adopted +
+                         r.lease_store.num_expired for r in (ra, rb))
+        assert total_acq == total_done
+
+    def test_late_commit_from_stale_router_is_refused(self):
+        """The double-execution guard: after a steal, the old owner's
+        next renew-before-emit returns False and it drops its copy
+        without emitting — the client never sees two streams."""
+        replicas = [SimReplica(f"sr{i}") for i in range(2)]
+        ra, rb = _twin_routers(replicas)
+        ra.add_request("req-0", [1], SamplingParams(max_new_tokens=6))
+        # step until some router holds the dispatched lease (an orphan
+        # hand-over may have moved it off the admitting router)
+        owner = None
+        for _ in range(20):
+            ra.step(); rb.step()
+            for r in (ra, rb):
+                fr = r._open.get("req-0")
+                if fr is not None and fr.lease_gen is not None \
+                        and fr.replica_id is not None:
+                    owner = r
+            if owner is not None:
+                break
+        assert owner is not None
+        other = rb if owner is ra else ra
+        # a peer force-adopts the LIVE lease out from under the owner
+        faults.install("fleet.lease_steal:flag:req-0*1")
+        finished = {}
+        for _ in range(40):
+            for r in (owner, other):
+                for out in r.step():
+                    if out.finished:
+                        finished.setdefault(out.request_id, []).append(
+                            (r.router_id, out.generated))
+            if "req-0" in finished:
+                break
+        # exactly one terminal, exact stream — wherever the request
+        # ends up (the stealing adopter may own no replica and hand it
+        # straight back through an orphan lease; still exactly-once)
+        assert len(finished["req-0"]) == 1
+        _, gen = finished["req-0"][0]
+        assert gen == [sim_token("req-0", i) for i in range(6)]
+        assert owner.num_requests_fenced >= 1  # the late renew refused
+
+    def test_heal_migration_resumes_from_emitted_progress(self):
+        """A partition-heal hazard: while B was out, A dispatched onto
+        a replica that rendezvous gives BACK to B at the heal. B's
+        first step advances the engine copy and drops the foreign
+        output on the floor — so when A migrates the request off the
+        disowned replica, the live engine state runs AHEAD of A's
+        emissions. The recovery point must be the emit-committed
+        (progress, rng) pair; resuming from the live read would skip
+        the unemitted position forever."""
+        rep_id = next(f"mr{i}" for i in range(64)
+                      if rendezvous_owner(f"mr{i}", ["A", "B"]) == "B")
+        h = SimReplica(rep_id)
+        ra, rb = _twin_routers([h])
+        rb.partitioned = True
+        ra.step()        # A observes B's last heartbeat...
+        time.sleep(0.6)  # ...which then ages past router_ttl_s
+        ra.step()        # A's view shrinks to {A}: it owns h now
+        assert ra._routers_view == ["A"]
+        ra.add_request("req-0", [1, 2],
+                       SamplingParams(max_new_tokens=6))
+        for _ in range(3):
+            ra.step()
+        fr = ra._open["req-0"]
+        assert fr.replica_id == rep_id and 2 <= len(fr.progress) < 6
+        # heal: B re-joins and steps h (its replica again) before A
+        # notices — the engine produces a token nobody emits
+        rb.partitioned = False
+        rb.step()
+        finished = {}
+        for _ in range(60):
+            for r in (ra, rb):
+                for out in r.step():
+                    if out.finished:
+                        finished.setdefault(
+                            out.request_id, []).append(
+                                (r.router_id, list(out.generated)))
+            if "req-0" in finished:
+                break
+        assert len(finished["req-0"]) == 1
+        _, gen = finished["req-0"][0]
+        assert gen == [sim_token("req-0", i) for i in range(6)]
+        total_acq = sum(r.lease_store.num_acquired for r in (ra, rb))
+        total_done = sum(r.lease_store.num_completed +
+                         r.lease_store.num_adopted +
+                         r.lease_store.num_expired for r in (ra, rb))
+        assert total_acq == total_done
+        assert ra.lease_store.active() == 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor: restarts are keyed by (worker id, generation)
+# ---------------------------------------------------------------------------
+class _Corpse:
+    """A dead SubprocessReplica stand-in."""
+
+    def __init__(self, replica_id):
+        self.replica_id = replica_id
+        self.alive = False
+        self.retiring = False
+        self.created_at = time.monotonic()
+
+    def close(self):
+        pass
+
+
+class TestSupervisorRestartKey:
+    def _sup(self, tmp_path):
+        return ReplicaSupervisor(config=SupervisorConfig(
+            store_dir=str(tmp_path / "store"),
+            restart_backoff_s=0.0, max_restarts=3))
+
+    def test_reobserved_corpse_buys_no_second_restart(self, tmp_path,
+                                                      monkeypatch):
+        sup = self._sup(tmp_path)
+        slot = _Slot("w0")
+        corpse = _Corpse("w0-g0")
+        slot.handle = corpse
+        slot.proc = None
+        sup._slots["w0"] = slot
+        launched = []
+
+        def fake_launch(s):
+            h = _Corpse(f"{s.name}-g{s.generation}")
+            h.alive = True
+            s.generation += 1
+            s.handle = h
+            launched.append(h.replica_id)
+            return h
+
+        monkeypatch.setattr(sup, "_launch", fake_launch)
+        sup.poll()              # schedules the (zero-backoff) restart
+        events = sup.poll()     # executes it
+        assert [e["event"] for e in events] == ["restarted"]
+        assert launched == ["w0-g0"] and sup.num_restarts == 1
+        # adoption re-observes the SAME corpse: the (id, generation)
+        # key says its death already bought a restart — no second one
+        slot.handle = corpse
+        assert sup.poll() == []
+        assert sup.num_restarts == 1 and launched == ["w0-g0"]
+        slot.handle = _Corpse("w0-g5")  # a NEW generation's death does
+        sup.poll()
+        events = sup.poll()
+        assert [e["event"] for e in events] == ["restarted"]
+        assert sup.num_restarts == 2
+
+    def test_failed_boot_does_not_mark_generation_handled(
+            self, tmp_path, monkeypatch):
+        sup = self._sup(tmp_path)
+        slot = _Slot("w0")
+        slot.handle = _Corpse("w0-g0")
+        slot.proc = None
+        sup._slots["w0"] = slot
+        calls = [0]
+
+        def flaky_launch(s):
+            calls[0] += 1
+            if calls[0] == 1:
+                raise RuntimeError("boot failed")
+            h = _Corpse(f"{s.name}-g{s.generation}")
+            h.alive = True
+            s.generation += 1
+            s.handle = h
+            return h
+
+        monkeypatch.setattr(sup, "_launch", flaky_launch)
+        sup.poll()                      # backoff
+        assert sup.poll() == []         # boot fails; gen NOT handled
+        assert "w0-g0" not in slot.handled_gens
+        sup.poll()                      # reschedule
+        events = sup.poll()             # retry succeeds
+        assert [e["event"] for e in events] == ["restarted"]
+        assert "w0-g0" in slot.handled_gens
+
+
+# ---------------------------------------------------------------------------
+# tiny-Llama e2e: SIGKILL failover is bit-identical
+# ---------------------------------------------------------------------------
+PROMPTS = [[1, 5, 7, 9], [2, 4, 6], [3, 8, 2, 1, 9]]
+
+
+def _build_replicas(n):
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    return [InProcessReplica(model, EngineConfig(), replica_id=f"r{i}")
+            for i in range(n)]
+
+
+def _reference_streams(sampling):
+    router = FleetRouter(_build_replicas(2),
+                         FleetConfig(heartbeat_interval_s=0.0))
+    rids = [router.add_request(f"req-{i}", p, sampling)
+            for i, p in enumerate(PROMPTS)]
+    router.run()
+    return {rid: router.release_request(rid).generated for rid in rids}
+
+
+def _failover_run(sampling, kill_replicas=False, n_replicas=2):
+    """Two replicated routers; SIGKILL the one owning req traffic
+    mid-decode (optionally its replicas too, forcing the
+    recompute-from-lease adoption path); return terminal streams.
+    Streams are per-request deterministic (greedy, or per-request
+    seeded sampling), so replica count never changes the tokens."""
+    store = MemStore()
+    cfg = FleetConfig(heartbeat_interval_s=0.0, router_ttl_s=0.3,
+                      lease_ttl_s=0.6)
+    replicas = _build_replicas(n_replicas)
+    routers = []
+    for name in ("A", "B"):
+        reg = ReplicaRegistry(store, ttl_s=30.0)
+        routers.append(FleetRouter(
+            replicas, cfg, reg,
+            lease_store=LeaseStore(store, ttl_s=cfg.lease_ttl_s),
+            router_id=name))
+    ra, rb = routers
+    ra.step(); rb.step()
+    got = {}
+
+    def collect(router):
+        for out in router.step():
+            if out.finished:
+                got[out.request_id] = (router.router_id, out)
+
+    for i, p in enumerate(PROMPTS):
+        (ra if i % 2 == 0 else rb).add_request(f"req-{i}", p, sampling)
+    for _ in range(3):
+        collect(ra); collect(rb)
+    victim = ra if any(
+        fr.lease_gen is not None and not fr.finished
+        for fr in ra._open.values()) else rb
+    survivor = rb if victim is ra else ra
+    faults.install(f"fleet.router_kill:flag:{victim.router_id}*1")
+    collect(victim)  # dies at its own step prologue
+    assert victim.router_dead
+    if kill_replicas:
+        # the host died, taking router AND replicas: the survivor must
+        # keep at least one replica or there is nothing to recompute on
+        doomed = victim_owned(victim)
+        assert len(doomed) < len(victim.replicas)
+        for h in doomed:
+            h.alive = False
+    deadline = time.monotonic() + 60
+    while len(got) < len(PROMPTS) and time.monotonic() < deadline:
+        collect(ra); collect(rb)
+        time.sleep(0.01)
+    assert len(got) == len(PROMPTS), sorted(got)
+    assert survivor.num_router_failovers == 1
+    total_acq = sum(r.lease_store.num_acquired for r in routers)
+    total_closed = sum(r.lease_store.num_completed +
+                       r.lease_store.num_adopted +
+                       r.lease_store.num_expired for r in routers)
+    assert total_acq == total_closed
+    assert routers[0].lease_store.active() == 0
+    return {rid: out.generated for rid, (_, out) in got.items()}
+
+
+def victim_owned(victim):
+    return [h for h in victim.replicas if victim._steps_replica(h)]
+
+
+@pytest.mark.parametrize("sampling", [
+    SamplingParams(max_new_tokens=12),
+    SamplingParams(max_new_tokens=12, temperature=0.8, seed=7),
+], ids=["greedy", "sampled"])
+def test_router_sigkill_failover_bit_identical(sampling):
+    ref = _reference_streams(sampling)
+    got = _failover_run(sampling)
+    assert got == ref
+
+
+def test_router_and_replica_sigkill_recompute_bit_identical():
+    """The harder path: the router dies WITH its replicas, so the
+    survivor cannot attach in place — it recomputes from the lease's
+    committed progress and RNG, and the sampled stream still matches
+    the uninterrupted reference bit for bit."""
+    sampling = SamplingParams(max_new_tokens=12, temperature=0.8,
+                              seed=7)
+    ref = _reference_streams(sampling)
+    got = _failover_run(sampling, kill_replicas=True, n_replicas=3)
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# fleet simulation
+# ---------------------------------------------------------------------------
+class TestFleetSim:
+    def test_small_fleet_full_chaos_exact(self):
+        sim = FleetSim(n_replicas=12, n_routers=2, seed=1)
+        trace = diurnal_trace(duration_s=6.0, tenants=["a", "b", "c"],
+                              base_rps=3, peak_rps=12, period_s=4,
+                              seed=1)
+        chaos = [ChaosEvent(t=1.0, kind="router_kill", arg="R0"),
+                 ChaosEvent(t=2.0, kind="lease_expire"),
+                 ChaosEvent(t=3.0, kind="lease_steal"),
+                 ChaosEvent(t=4.0, kind="replica_kill")]
+        sim.run(trace, chaos=chaos, max_virtual_s=120.0)
+        summary = sim.check()
+        assert summary["requests"] > 20
+        assert summary["router_failovers"] >= 1
+
+    def test_partition_heals_without_duplication(self):
+        sim = FleetSim(n_replicas=12, n_routers=3, seed=2)
+        trace = diurnal_trace(duration_s=6.0, tenants=["a", "b"],
+                              base_rps=4, peak_rps=8, period_s=4,
+                              seed=2)
+        chaos = [ChaosEvent(t=1.0, kind="partition", arg="R1",
+                            duration_s=1.5)]
+        sim.run(trace, chaos=chaos, max_virtual_s=120.0)
+        sim.check()
+
+    @pytest.mark.slow
+    def test_hundred_replica_acceptance(self):
+        """ISSUE 16 acceptance: >=100 replicas under a bursty trace
+        with the full chaos menu, exact accounting, <60 s wall."""
+        sim = FleetSim(n_replicas=100, n_routers=3, seed=2)
+        trace = diurnal_trace(
+            duration_s=20.0, tenants=[f"t{i}" for i in range(8)],
+            base_rps=10, peak_rps=60, period_s=10, seed=2)
+        chaos = [ChaosEvent(t=2.0, kind="router_kill", arg="R1"),
+                 ChaosEvent(t=4.0, kind="lease_expire"),
+                 ChaosEvent(t=6.0, kind="lease_steal"),
+                 ChaosEvent(t=8.0, kind="partition", arg="R2",
+                            duration_s=2.0),
+                 ChaosEvent(t=10.0, kind="replica_kill"),
+                 ChaosEvent(t=12.0, kind="lease_expire")]
+        t0 = time.perf_counter()
+        sim.run(trace, chaos=chaos)
+        wall = time.perf_counter() - t0
+        summary = sim.check()
+        assert summary["requests"] > 400
+        assert summary["router_failovers"] >= 1
+        assert wall < 60.0, f"sim took {wall:.1f}s"

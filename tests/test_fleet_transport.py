@@ -999,3 +999,132 @@ class TestRegistryMonotonic:
         final = {o.request_id: o.finish_reason for o in outs
                  if o.finished}
         assert final == {rid: "length"}
+
+
+# ---------------------------------------------------------------------------
+# replicated control plane under fire (ISSUE 16): two routers, the
+# lease fault points joined to the transport storm
+# ---------------------------------------------------------------------------
+class TestReplicatedStorm:
+    def test_replicated_chaos_storm_exact_accounting(self, tiny_model):
+        """Two loopback routers over one shared store, the full fault
+        menu at once: the owning router SIGKILLed mid-storm, a lease
+        renewal dropped, a live lease stolen, a worker killed, RPC
+        drops and delays. Invariants, not outcomes: every request in
+        exactly one terminal bucket, every token delivered exactly
+        once, no orphaned lease, every issued ticket in exactly one
+        outcome, peer listeners empty, surviving pools full."""
+        from paddle_tpu.serving.fleet import LeaseStore
+
+        for seed in (0, 1):
+            sched = np.random.default_rng(100 + seed)
+            n = 8
+            prompts = _prompts(tiny_model, n, seed=40 + seed)
+            ids = [f"rs{seed}-{i}" for i in range(n)]
+            lbs = [Loopback(InProcessReplica(
+                       tiny_model, _ecfg(), replica_id=f"RS{seed}{j}"))
+                   for j in range(3)]
+            for lb in lbs:
+                lb.handle.peer_endpoint = lb.inner.start_peer()
+            store = MemStore()
+            cfg = FleetConfig(heartbeat_interval_s=0.0,
+                              router_ttl_s=0.3, lease_ttl_s=0.6)
+            routers = []
+            for name in ("A", "B"):
+                reg = ReplicaRegistry(store, ttl_s=30.0)
+                routers.append(FleetRouter(
+                    [lb.handle for lb in lbs], cfg, reg,
+                    lease_store=LeaseStore(store, ttl_s=0.6),
+                    router_id=f"{name}{seed}"))
+            ra, rb = routers
+            ra.step(); rb.step()  # discover each other
+            for i, (rid, p) in enumerate(zip(ids, prompts)):
+                (ra if i % 2 == 0 else rb).add_request(
+                    rid, p, sampling=_sp(i % 2 == 1))
+            outs = []
+
+            def joint(steps):
+                for _ in range(steps):
+                    for r in routers:
+                        outs.extend(r.step())
+
+            joint(3)  # every request dispatched AND leased
+            spec = ";".join([
+                # the router holding half the traffic dies mid-decode
+                f"fleet.router_kill:flag:A{seed}"
+                f"@{sched.integers(1, 3)}*1",
+                # one renewal write dropped: owner must self-fence and
+                # the request recovers through the expired bucket
+                f"fleet.lease_expire:flag:{ids[2]}*1",
+                # a live lease force-adopted out from under its owner
+                f"fleet.lease_steal:flag:{ids[5]}*1",
+                # plus the PR-12/14 transport storm underneath
+                f"fleet.worker_kill:flag:RS{seed}0"
+                f"@{sched.integers(2, 6)}*1",
+                f"fleet.rpc_drop:flag@{sched.integers(3, 30)}"
+                f"*{sched.integers(1, 3)}",
+                f"fleet.rpc_delay:sleep:0.01@{sched.integers(1, 20)}"
+                f"*{sched.integers(1, 4)}",
+            ])
+            faults.install(spec)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                joint(1)
+                live = [r for r in routers if not r.router_dead]
+                # quiesce = live routers idle AND no lease open: the
+                # dead router's requests stay leased until a peer's
+                # sweep adopts and finishes them
+                if (not any(r.has_unfinished() for r in live)
+                        and routers[0].lease_store.active() == 0):
+                    break
+                time.sleep(0.005)
+            faults.clear()
+            live = [r for r in routers if not r.router_dead]
+            assert live and not any(r.has_unfinished() for r in live)
+
+            # every request reached EXACTLY ONE terminal, fleet-wide
+            final = {}
+            for o in outs:
+                if o.finished:
+                    assert o.request_id not in final, \
+                        f"{o.request_id} got two terminals"
+                    final[o.request_id] = o
+            assert set(final) == set(ids)  # no strands
+            assert all(final[r].finish_reason in FINISH_REASONS
+                       for r in ids)
+            # every token delivered exactly once (failover replays
+            # nothing, fencing loses nothing)
+            counts = {}
+            for o in outs:
+                if o.token is not None:
+                    counts[o.request_id] = counts.get(o.request_id,
+                                                      0) + 1
+            for r in ids:
+                assert counts.get(r, 0) == len(final[r].generated), r
+            # the failover actually happened and was counted once
+            assert ra.router_dead
+            assert sum(r.num_router_failovers for r in routers) == 1
+            # lease accounting is exact: every incarnation in exactly
+            # one bucket, nothing orphaned at quiesce
+            acq = sum(r.lease_store.num_acquired for r in routers)
+            closed = sum(r.lease_store.num_completed
+                         + r.lease_store.num_adopted
+                         + r.lease_store.num_expired for r in routers)
+            assert acq == closed
+            assert routers[0].lease_store.active() == 0
+            # the injected lease faults really fired
+            assert sum(r.lease_store.num_renew_dropped
+                       for r in routers) >= 1
+            # per-router ticket accounting partitions
+            for r in routers:
+                assert r.num_tickets_issued == \
+                    sum(r.ticket_outcomes.values())
+            # surviving engines: pools back to full, listeners empty
+            for lb in lbs:
+                if lb.handle.alive:
+                    bm = lb.inner.engine.block_manager
+                    assert bm.num_free_blocks == bm.num_blocks
+                    lis = lb.inner.peer_listener
+                    if lis is not None:
+                        lis.gc()
+                        assert lis.pending_count == 0
