@@ -1268,6 +1268,147 @@ def bench_routers(tiny=False, routers=2, n_requests=24,
     }
 
 
+def bench_tp(tiny=False, tp=2, n_requests=12, max_new_tokens=16,
+             max_num_seqs=4, seed=0):
+    """TP-sharded serving (``--serving --tp N``): the same unequal-
+    length ragged workload through a TP=1 engine and a TP=``tp``
+    engine over the forced host-device CPU mesh (the dispatcher
+    exports ``xla_force_host_platform_device_count`` before jax
+    loads). On CPU the TP number prices GSPMD partition overhead, not
+    a speedup — all "devices" share one core pool — so the figure to
+    trend is the ratio and the invariants: token parity (greedy AND
+    sampled), padded_token_frac == 0 at both degrees, and the
+    redistribute counters of a trailing TP=1 → TP=``tp`` KV ship
+    (``extra["cross_degree_ship"]``: one reshard, exactly one prompt
+    token recomputed — the mandatory uncovered position)."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.redistribute import get_stats, reset_stats
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    if len(jax.devices()) < tp:
+        raise RuntimeError(
+            f"--tp {tp} needs {tp} devices, {len(jax.devices())} "
+            f"visible — the dispatcher must set XLA_FLAGS before jax "
+            f"imports")
+    paddle.seed(seed)
+    paddle.set_default_dtype("float32")
+    if tiny:
+        cfg = LlamaConfig.tiny()
+        n_requests, max_new_tokens = min(n_requests, 10), min(
+            max_new_tokens, 8)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=1024)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    prompts = [list(rng.randint(0, cfg.vocab_size,
+                                size=6 + 3 * (i % 4)))
+               for i in range(n_requests)]
+    samplings = [SamplingParams(max_new_tokens=max_new_tokens)
+                 if i % 3 else
+                 SamplingParams(max_new_tokens=max_new_tokens,
+                                temperature=0.8, seed=100 + i)
+                 for i in range(n_requests)]
+
+    def serve_degree(degree):
+        eng = LLMEngine(model, EngineConfig(
+            tp_degree=degree, max_num_seqs=max_num_seqs,
+            max_model_len=64))
+        # warmup: replay the scenario once so the one ragged step (and
+        # its shrinking drain shapes) compiles outside the window
+        for i, (p, sp) in enumerate(zip(prompts, samplings)):
+            eng.add_request(f"w{i}", list(p), sampling=sp)
+        while eng.has_unfinished():
+            eng.step()
+        warm = {f"w{i}": list(eng.get_request(f"w{i}").generated)
+                for i in range(n_requests)}
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        for i, (p, sp) in enumerate(zip(prompts, samplings)):
+            eng.add_request(f"m{i}", list(p), sampling=sp)
+        while eng.has_unfinished():
+            eng.step()
+        dt = time.perf_counter() - t0
+        snap = eng.metrics.snapshot()
+        toks = snap["num_generated_tokens"]
+        return eng, warm, {
+            "tokens_per_sec": round(toks / dt, 2),
+            "tpot_ms_avg": snap["tpot_ms_avg"],
+            "ttft_ms_avg": snap["ttft_ms_avg"],
+            "padded_token_frac": snap["padded_token_frac"],
+        }
+
+    e1, toks1, stats1 = serve_degree(1)
+    eN, toksN, statsN = serve_degree(tp)
+    assert toks1 == toksN, "TP=%d diverged from TP=1" % tp
+    assert stats1["padded_token_frac"] == 0.0, stats1
+    assert statsN["padded_token_frac"] == 0.0, statsN
+
+    # cross-degree KV ship: 2 decode steps on TP=1, ship into TP=tp
+    ship_rng = np.random.RandomState(seed + 9)
+    prompt = list(ship_rng.randint(0, cfg.vocab_size, size=24))
+    src = LLMEngine(model, EngineConfig(tp_degree=1,
+                                        max_num_seqs=max_num_seqs,
+                                        max_model_len=64))
+    src.add_request("ship", prompt,
+                    sampling=SamplingParams(max_new_tokens=6))
+    for _ in range(2):
+        src.step()
+    done = list(src.get_request("ship").generated)
+    meta, payload = src.export_kv("ship")
+    dst = LLMEngine(model, EngineConfig(tp_degree=tp,
+                                        max_num_seqs=max_num_seqs,
+                                        max_model_len=64))
+    reset_stats()
+    dst.import_kv("ship", prompt + done,
+                  sampling=SamplingParams(max_new_tokens=6 - len(done)),
+                  meta=meta, payload=payload)
+    while dst.has_unfinished():
+        dst.step()
+    rstats = get_stats()
+    recomputed = dst.metrics.snapshot()["num_prompt_tokens"]
+    assert dst.num_kv_reshards == 1 and recomputed == 1, \
+        (dst.num_kv_reshards, recomputed)
+
+    return {
+        "metric": "serving_tp_tokens_per_sec",
+        "value": statsN["tokens_per_sec"],
+        "unit": "tokens/sec",
+        # CPU hosts one core pool: the honest baseline is TP=1 on the
+        # same mesh, and the ratio prices the partitioning overhead
+        "vs_baseline": round(statsN["tokens_per_sec"]
+                             / stats1["tokens_per_sec"], 3),
+        "extra": {
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "tp_degree": tp,
+            "config": ("tiny" if tiny else "gpt-small-serving")
+                      + f" tp={tp} n_req={n_requests}"
+                      f" max_new={max_new_tokens}"
+                      f" max_num_seqs={max_num_seqs}",
+            "tp1": stats1,
+            f"tp{tp}": statsN,
+            "token_parity": True,
+            "cross_degree_ship": {
+                "payload_bytes": len(payload),
+                "tokens_covered": meta["tokens_covered"],
+                "prompt_tokens_recomputed": recomputed,
+                "kv_reshards": dst.num_kv_reshards,
+                **{k: rstats[k] for k in
+                   ("num_redistributes", "bytes_moved", "bytes_total")},
+            },
+        },
+    }
+
+
 def _pp_schedules_worker():
     """Measure per-schedule pipeline step time on the 8-device virtual
     CPU mesh (VERDICT r4 #3/#10: measured numbers, not hardcoded
@@ -1509,6 +1650,18 @@ if __name__ == "__main__":
             n = int(sys.argv[sys.argv.index("--routers") + 1])
             print("BENCH_serving_routers " + json.dumps(
                 bench_routers(tiny="--tiny" in sys.argv, routers=n)))
+        elif "--tp" in sys.argv:
+            # TP-sharded serving: the mesh must exist before jax
+            # initialises, so the flag is exported HERE (bench
+            # functions import jax lazily)
+            n = int(sys.argv[sys.argv.index("--tp") + 1])
+            _flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in _flags:
+                os.environ["XLA_FLAGS"] = (
+                    _flags + " --xla_force_host_platform_device_count"
+                    "=%d" % max(4, n)).strip()
+            print("BENCH_serving_tp " + json.dumps(
+                bench_tp(tiny="--tiny" in sys.argv, tp=n)))
         elif "--replicas" in sys.argv:
             n = int(sys.argv[sys.argv.index("--replicas") + 1])
             print("BENCH_serving_fleet " + json.dumps(

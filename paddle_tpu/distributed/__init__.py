@@ -23,6 +23,9 @@ from paddle_tpu.distributed.communication import (  # noqa: F401
     reduce_scatter, scatter, send, shift, stream,
 )
 from paddle_tpu.distributed.store import FileStore, Store  # noqa: F401
+from paddle_tpu.distributed.redistribute import (  # noqa: F401
+    Layout, redistribute, redistribute_host,
+)
 from paddle_tpu.distributed.topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, ParallelMode,
 )

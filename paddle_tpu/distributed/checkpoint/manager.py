@@ -92,8 +92,17 @@ class CheckpointManager:
 
     def __init__(self, root: str, keep_last_n: int = 5,
                  async_save: bool = True, save_interval_steps: int = 1,
-                 max_retries: int = 3, backoff_base: float = 0.5):
+                 max_retries: int = 3, backoff_base: float = 0.5,
+                 dedupe_chunks: bool = False):
         self._root = str(root)
+        # content-addressed chunk store: every tensor chunk is written
+        # once under root/chunk_cas/<content-hash>.npz and hard-linked
+        # into each step directory that references it, so keep_last_n
+        # retention of a mostly-frozen model costs one copy of the cold
+        # layers, not keep_last_n copies. Single-process only (the CAS
+        # link dance is rank-0 filesystem surgery; a gang's per-rank
+        # data files keep the classic one-npz-per-process format).
+        self._dedupe = bool(dedupe_chunks)
         # at least the newest committed step is always kept — a manager
         # that retains nothing cannot resume anything
         self._keep = max(1, int(keep_last_n))
@@ -112,6 +121,12 @@ class CheckpointManager:
         self._backoff_base = float(backoff_base)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # last_cas_hits is written by whichever root runs the save
+        # (caller for block=True, the writer thread otherwise), so every
+        # access goes through this lock
+        self._cas_lock = threading.Lock()
+        with self._cas_lock:
+            self.last_cas_hits = 0
         self._preempt = None
         os.makedirs(self._root, exist_ok=True)
         self._recover_parked()
@@ -286,8 +301,11 @@ class CheckpointManager:
             from paddle_tpu.distributed.checkpoint import _default_barrier
 
             _default_barrier(f"ckpt_{step}_stage:a{attempt}")
-        _write_data(tmp, arrays, tensors_meta, data_file, barrier=tagged,
-                    objects=objects)
+        if self._dedupe and jax.process_count() == 1:
+            self._write_data_cas(tmp, arrays, tensors_meta, objects)
+        else:
+            _write_data(tmp, arrays, tensors_meta, data_file,
+                        barrier=tagged, objects=objects)
         if pidx == 0:
             _faults.fire("ckpt.before_commit")
             aside = final + ".old"
@@ -327,6 +345,87 @@ class CheckpointManager:
 
             _default_barrier(f"ckpt_{step}_done:a{attempt}")
         self._gc(keep_step=step)
+
+    def _write_data_cas(self, path, arrays, tensors_meta, objects):
+        """Single-process content-addressed write: each chunk lands in
+        ``root/chunk_cas/chunk_<hash>.npz`` once and is HARD-LINKED into
+        the step directory, so identical chunks across retained steps —
+        frozen embeddings, a cold adapter base — cost disk once no
+        matter what ``keep_last_n`` says. The manifest references the
+        per-step link (never the store), so restore stays entirely
+        inside the committed directory and pruning a CAS entry can
+        never tear a checkpoint. Composes with resharded restore: the
+        chunk format is unchanged, only file naming and linkage differ.
+        On a filesystem without hard links the write degrades to plain
+        per-step copies (dedupe off, correctness identical)."""
+        import hashlib
+
+        import numpy as np
+
+        from paddle_tpu.distributed.checkpoint import (
+            _META_FILE, _OBJECTS_FILE, _fsync_path,
+        )
+        from paddle_tpu.distributed.checkpoint.metadata import (
+            LocalTensorMetadata, Metadata, TensorMetadata,
+        )
+
+        cas = os.path.join(self._root, "chunk_cas")
+        os.makedirs(cas, exist_ok=True)
+        key_to_file = {}
+        cas_hits = 0  # chunks satisfied without a fresh write
+        for key, arr in arrays.items():
+            hh = hashlib.blake2b(digest_size=16)
+            hh.update(str(arr.dtype).encode())
+            hh.update(repr(tuple(arr.shape)).encode())
+            hh.update(np.ascontiguousarray(arr).tobytes())
+            fname = f"chunk_{hh.hexdigest()}.npz"
+            key_to_file[key] = fname
+            dst = os.path.join(path, fname)
+            if os.path.exists(dst):
+                # identical content twice within this step (e.g. tied
+                # weights saved under two names)
+                cas_hits += 1
+                continue
+            src = os.path.join(cas, fname)
+            linked = False
+            if os.path.exists(src):
+                try:
+                    os.link(src, dst)
+                    linked = True
+                    cas_hits += 1
+                except OSError:
+                    pass  # unusable store entry; write fresh below
+            if not linked:
+                tmpf = dst + ".tmp"
+                with open(tmpf, "wb") as f:
+                    np.savez(f, data=arr)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmpf, dst)
+                try:
+                    os.link(dst, src)
+                except FileExistsError:
+                    pass  # raced a parallel save; content is identical
+                except OSError:
+                    pass  # no hard links here: dedupe quietly degrades
+        with self._cas_lock:
+            self.last_cas_hits = cas_hits
+        _faults.fire("ckpt.data_written")
+        meta = {
+            name: TensorMetadata(tm.global_shape, tm.dtype, [
+                LocalTensorMetadata(c.global_offset, c.local_shape,
+                                    key_to_file[c.key], "data")
+                for c in tm.chunks])
+            for name, tm in tensors_meta.items()
+        }
+        Metadata(meta).save(os.path.join(path, _META_FILE))
+        _fsync_path(os.path.join(path, _META_FILE))
+        if objects:
+            obj_file = os.path.join(path, _OBJECTS_FILE)
+            with open(obj_file, "w") as f:
+                json.dump(objects, f)
+                f.flush()
+                os.fsync(f.fileno())
 
     def _recover_parked(self):
         """A crash between a same-step rewrite and its marker leaves the
@@ -376,13 +475,56 @@ class CheckpointManager:
                 step in committed[:-self._keep]
             if (torn or stale) and step != keep_step:
                 shutil.rmtree(full, ignore_errors=True)
+        # CAS retention: a chunk whose only remaining link is the store
+        # itself (st_nlink == 1) is referenced by no surviving step
+        cas = os.path.join(self._root, "chunk_cas")
+        if os.path.isdir(cas):
+            for name in os.listdir(cas):
+                full = os.path.join(cas, name)
+                try:
+                    if os.stat(full).st_nlink == 1:
+                        os.unlink(full)
+                except OSError:
+                    pass  # raced another unlink / transient FS error
 
     # -- restore ---------------------------------------------------------
-    def restore(self, state_dict: Dict, step: Optional[int] = None) -> int:
+    def _apply_target_layout(self, state_dict: Dict, target_layout: Dict,
+                             devices=None):
+        """Commit each named tensor to its requested Layout BEFORE the
+        load: ``load_state_dict`` assembles exactly the slice each
+        destination device needs under the tensor's CURRENT sharding,
+        so re-placing first turns the restore itself into the reshard —
+        a DP-trained checkpoint lands directly on a TP serving mesh
+        with bit-identical values and no full-tensor device copy."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.checkpoint import _flatten
+
+        flat = _flatten(state_dict)
+        unknown = [n for n in target_layout if n not in flat]
+        if unknown:
+            raise KeyError(
+                f"target_layout names absent from the state dict: "
+                f"{unknown[:8]}" + ("..." if len(unknown) > 8 else ""))
+        for name, lay in target_layout.items():
+            v = flat[name]
+            if not isinstance(v, Tensor):
+                raise TypeError(
+                    f"target_layout entry {name!r} is not a Tensor "
+                    f"leaf (got {type(v).__name__})")
+            lay.validate_shape(tuple(int(s) for s in v._data.shape))
+            v._data = jax.device_put(v._data,
+                                     lay.named_sharding(devices))
+
+    def restore(self, state_dict: Dict, step: Optional[int] = None,
+                target_layout: Optional[Dict] = None,
+                devices=None) -> int:
         """Fill ``state_dict`` in place from checkpoint ``step`` (default:
         newest committed). The target tensors' CURRENT shardings decide
         placement, so a checkpoint written under a different mesh or
-        process count reshards on the way in."""
+        process count reshards on the way in. ``target_layout`` maps
+        flat state-dict names ('/'-joined paths) to
+        :class:`~paddle_tpu.distributed.redistribute.Layout` placements
+        applied before the load — the TP-serving restore path."""
         from paddle_tpu.distributed.checkpoint import load_state_dict
 
         if step is None:
@@ -395,18 +537,25 @@ class CheckpointManager:
             raise ValueError(
                 f"checkpoint step {step} at {path!r} has no COMMITTED "
                 f"marker — refusing to restore from a torn save")
+        if target_layout:
+            self._apply_target_layout(state_dict, target_layout, devices)
         load_state_dict(state_dict, path)
         return int(step)
 
-    def restore_or_initialize(self, state_dict: Dict) -> Optional[int]:
+    def restore_or_initialize(self, state_dict: Dict,
+                              target_layout: Optional[Dict] = None,
+                              devices=None) -> Optional[int]:
         """Auto-resume: restore the newest committed checkpoint and
         return its step, or return None (leaving ``state_dict``
         untouched) when none exists. Torn/uncommitted directories —
-        e.g. from a SIGKILL mid-save — are skipped, never read."""
+        e.g. from a SIGKILL mid-save — are skipped, never read.
+        ``target_layout``/``devices`` reshard the restore exactly as in
+        :meth:`restore` (no-op when nothing is restored)."""
         step = self._agreed_latest_step()
         if step is None:
             return None
-        return self.restore(state_dict, step)
+        return self.restore(state_dict, step,
+                            target_layout=target_layout, devices=devices)
 
     # -- preemption ------------------------------------------------------
     def install_preemption_handler(self, signals=None):

@@ -169,7 +169,8 @@ def block_multihead_attention(
         seq_lens_this_time, block_tables, max_seq_len=None,
         block_size=None, pre_key_cache=None, pre_value_cache=None,
         rope_emb=None, mask=None, causal=True, num_heads=None,
-        kv_num_heads=None, head_dim=None) -> Tuple[Tensor, Tensor, Tensor]:
+        kv_num_heads=None, head_dim=None,
+        tp_degree=1) -> Tuple[Tensor, Tensor, Tensor]:
     """Unified prefill/decode attention over a paged KV cache
     (reference block_multihead_attention.py; the vLLM-style serving
     attention). Two modes per sequence, chosen by the length tensors:
@@ -205,9 +206,18 @@ def block_multihead_attention(
     bs = kc.shape[1] if block_size is None else block_size
     q = qkvd[:, :, 0]
     # qkv carries H heads per slot (the caller unpacks (H+2*KH)-wide
-    # fused projections); GQA keeps the first kh K/V heads
-    k_new = qkvd[:, :, 1, :kh]
-    v_new = qkvd[:, :, 2, :kh]
+    # fused projections); GQA keeps the first kh K/V heads. Under
+    # tensor parallelism the caller packs per TP head group — each
+    # group's KH/tp kv heads lead its H/tp q-head slots — so this
+    # unpack never crosses a head-dim shard boundary.
+    tp = max(1, int(tp_degree))
+    if tp > 1:
+        grp = qkvd.reshape(b, s, three, tp, h // tp, d)
+        k_new = grp[:, :, 1, :, :kh // tp].reshape(b, s, kh, d)
+        v_new = grp[:, :, 2, :, :kh // tp].reshape(b, s, kh, d)
+    else:
+        k_new = qkvd[:, :, 1, :kh]
+        v_new = qkvd[:, :, 2, :kh]
 
     # write new K/V into the cache at [start, start+now) where start is
     # the already-cached prefix (decode) or 0 (prefill)

@@ -57,6 +57,11 @@ class LlamaConfig:
     recompute: bool = False
     tie_word_embeddings: bool = False
     dtype: str = "float32"
+    # serving tensor parallelism (LLMEngine tp_degree): the GQA
+    # head-packing in forward_paged groups heads per TP shard so the
+    # packed qkv stack stays shard-local under a tp-sharded head dim.
+    # Exact at any value — tp_degree=1 is the flat legacy packing.
+    tp_degree: int = 1
 
     @staticmethod
     def llama3_8b(**kw):
@@ -210,19 +215,26 @@ class LlamaAttention(nn.Layer):
         v = ops.reshape(self.v_proj(x),
                         [b, s, self.n_kv, self.head_dim])._data
         q, k = _rope_apply_at(q, k, cos, sin)
+        tp = max(1, int(getattr(self.config, "tp_degree", 1)))
         if self.n_kv != self.n_heads:
-            # pack K/V into the first n_kv of the H-wide qkv slots (the
-            # fused-projection layout block_multihead_attention unpacks)
-            pad = [(0, 0), (0, 0), (0, self.n_heads - self.n_kv), (0, 0)]
-            k = jnp.pad(k, pad)
-            v = jnp.pad(v, pad)
+            # pack K/V into the leading n_kv/tp slots of EACH TP head
+            # group's H/tp-wide stripe (the fused-projection layout
+            # block_multihead_attention unpacks with the same
+            # tp_degree) — per-group so the (B,S,3,H,D) stack never
+            # mixes head-dim shards; tp=1 is the flat legacy packing
+            hg, kg = self.n_heads // tp, self.n_kv // tp
+            pad = [(0, 0), (0, 0), (0, 0), (0, hg - kg), (0, 0)]
+            k = jnp.pad(k.reshape(b, s, tp, kg, self.head_dim), pad)
+            k = k.reshape(b, s, self.n_heads, self.head_dim)
+            v = jnp.pad(v.reshape(b, s, tp, kg, self.head_dim), pad)
+            v = v.reshape(b, s, self.n_heads, self.head_dim)
         qkv = jnp.stack([q, k, v], axis=2)  # (B, S, 3, H, D)
         out, kc, vc = F.block_multihead_attention(
             qkv, key_cache, value_cache,
             seq_lens_encoder=seq_lens_encoder,
             seq_lens_decoder=seq_lens_decoder,
             seq_lens_this_time=seq_lens_this_time,
-            block_tables=block_tables)
+            block_tables=block_tables, tp_degree=tp)
         out = ops.reshape(out, [b, s, self.n_heads * self.head_dim])
         return self.o_proj(out), kc, vc
 

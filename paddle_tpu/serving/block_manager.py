@@ -85,7 +85,8 @@ def prefix_chain_hashes(tokens: Sequence[int],
 class BlockManager:
     def __init__(self, num_blocks: int, block_size: int,
                  num_host_blocks: int = 0,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 kv_layout=None):
         if num_blocks < 1 or block_size < 1:
             raise ValueError("num_blocks and block_size must be >= 1")
         if num_host_blocks < 0:
@@ -93,6 +94,13 @@ class BlockManager:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_cache = enable_prefix_cache
+        # the Layout of the paged caches these block ids index (TP
+        # serving shards the kv-head dim; None = unsharded). Allocation
+        # is layout-agnostic — a block id covers block_size tokens
+        # regardless of how its bytes are framed — but the KV-ship
+        # import gate below uses it to reject wire payloads whose
+        # layout cannot possibly reshard onto this cache.
+        self.kv_layout = kv_layout
         # free list: pop() takes the HOT (right) end — recently freed,
         # never-cached blocks; cached-free blocks park at the COLD (left)
         # end so registered prefixes are evicted last, oldest first
@@ -445,7 +453,8 @@ class BlockManager:
                 f"block(s), {need} needed for {num_tokens} tokens")
         return list(table[:need])
 
-    def import_blocks(self, request_id: str, num_tokens: int) -> List[int]:
+    def import_blocks(self, request_id: str, num_tokens: int,
+                      src_layout=None) -> List[int]:
         """Claim fresh device blocks to receive a shipped KV payload
         covering ``num_tokens`` tokens (fleet KV-ship import side). Every
         block is private (refcount 1) and starts unregistered — shipped
@@ -453,11 +462,24 @@ class BlockManager:
         :meth:`commit_prefix` after the engine scatters the bytes, so a
         block is never shared before its K/V exists on device. Raises
         :class:`NoFreeBlocksError` when the pool cannot take the payload
-        (the router falls back to recompute)."""
+        (the router falls back to recompute).
+
+        ``src_layout`` is the wire payload's Layout (per-shard frames
+        from the exporter's TP mesh). The block COUNT is layout-
+        invariant — frames partition the kv-head dim, not tokens — but
+        a payload whose layout has the wrong rank for this cache can
+        never land, so it is refused here, before any block is claimed
+        (a ValueError the router treats as a clean ladder fall)."""
         if request_id in self._tables:
             raise ValueError(
                 f"request {request_id!r} already holds a block table — "
                 f"free() it before importing")
+        if (src_layout is not None and self.kv_layout is not None
+                and src_layout.ndim != self.kv_layout.ndim):
+            raise ValueError(
+                f"request {request_id!r}: shipped payload layout has "
+                f"rank {src_layout.ndim}, cache layout has rank "
+                f"{self.kv_layout.ndim} — cannot reshard")
         need = self.blocks_needed(num_tokens)
         if need < 1:
             raise ValueError(

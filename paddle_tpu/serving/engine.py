@@ -128,6 +128,13 @@ class EngineConfig:
     block_size: int = 16
     num_blocks: Optional[int] = None
     max_num_seqs: int = 8
+    # tensor parallelism: shard weights (attention heads, MLP hidden)
+    # and the paged KV caches (kv-head dim) over a 1-D "tp" mesh of
+    # the first tp_degree visible devices. The ONE compiled step stays
+    # one program — an SPMD program with NamedSharding in/outs (jax
+    # 0.4.37: no shard_map; GSPMD inserts the collectives). tp_degree=1
+    # is the existing single-device engine, bit for bit.
+    tp_degree: int = 1
     max_batched_tokens: int = 2048
     max_model_len: Optional[int] = None   # default: model max positions
     dtype: Optional[str] = None           # default: model param dtype
@@ -176,6 +183,8 @@ class EngineConfig:
     def __post_init__(self):
         if self.block_size < 1:
             raise ValueError("block_size must be >= 1")
+        if self.tp_degree < 1:
+            raise ValueError("tp_degree must be >= 1")
         if self.num_blocks is not None and self.num_blocks < 1:
             raise ValueError("num_blocks must be >= 1")
         if self.min_prefill_bucket < 1:
@@ -255,10 +264,39 @@ class AdmissionController:
         return None
 
 
+# column-parallel projections split their OUTPUT features over tp
+# (attention heads / MLP hidden); row-parallel ones split the INPUT
+# features and GSPMD all-reduces their partial sums — the Megatron
+# pairing, and the same placements mp_layers marks for training.
+_TP_COL_MODULES = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+_TP_ROW_MODULES = ("o_proj", "down_proj")
+
+
+def _tp_param_layout(name: str, ndim: int, tp: int):
+    """TP placement of one named parameter. The model may pin its own
+    via a ``tp_shard_dim(name)`` hook; this is the fallback for the
+    llama naming scheme the serving engine already assumes."""
+    from paddle_tpu.distributed.redistribute import Layout
+
+    parts = name.split(".")
+    module = parts[-2] if len(parts) >= 2 else ""
+    kind = parts[-1]
+    placements: List[Optional[str]] = [None] * ndim
+    if tp > 1:
+        if module in _TP_COL_MODULES and kind == "weight" and ndim == 2:
+            placements[1] = "tp"
+        elif module in _TP_COL_MODULES and kind == "bias" and ndim == 1:
+            placements[0] = "tp"
+        elif module in _TP_ROW_MODULES and kind == "weight" and ndim == 2:
+            placements[0] = "tp"
+        # row-parallel bias, embeddings, norms, lm_head: replicated
+    return Layout((("tp", tp),), placements)
+
+
 class _KVSwapper:
     """Engine-side block mover for swap-based preemption: copies the
     stacked (L, nblocks, BS, KH, D) device cache slices to/from the
-    host pool.
+    host pool, framed per TP shard (a single frame when unsharded).
 
     ``copy_out`` is ASYNC: it enqueues a device gather of the victim's
     blocks (a fresh buffer, so the freed blocks may be rewritten by the
@@ -299,9 +337,14 @@ class _KVSwapper:
             return
         eng = self._eng
         for host, k_slice, v_slice in self._pending.values():
-            eng._host_k[:, host] = np.asarray(k_slice)  # tpulint: disable=host-sync-in-traced (landing the async swap-out spill; a handful of KV blocks, off the step's critical path)
-            eng._host_v[:, host] = np.asarray(v_slice)
+            eng._host_k[:, :, host] = self._frames(np.asarray(k_slice))  # tpulint: disable=host-sync-in-traced (landing the async swap-out spill; a handful of KV blocks, off the step's critical path)
+            eng._host_v[:, :, host] = self._frames(np.asarray(v_slice))
         self._pending.clear()
+
+    def _frames(self, arr: np.ndarray) -> np.ndarray:
+        """Global (L, n, BS, KH, D) gather -> stacked per-TP-shard
+        frames (tp, L, n, BS, KH/tp, D); a single frame unsharded."""
+        return np.stack(self._eng.kv_layout.shards(arr))
 
     def copy_in(self, request: Request, host_table: List[int],
                 dev_table: List[int]):
@@ -309,8 +352,11 @@ class _KVSwapper:
         eng = self._eng
         host = np.asarray(host_table, np.int32)
         dev = np.asarray(dev_table, np.int32)
-        eng._kcs = eng._kcs.at[:, dev].set(eng._host_k[:, host])
-        eng._vcs = eng._vcs.at[:, dev].set(eng._host_v[:, host])
+        k_np = eng.kv_layout.assemble(list(eng._host_k[:, :, host]))
+        v_np = eng.kv_layout.assemble(list(eng._host_v[:, :, host]))
+        eng._kcs = eng._kcs.at[:, dev].set(k_np)
+        eng._vcs = eng._vcs.at[:, dev].set(v_np)
+        eng._pin_caches()
 
     def gather(self, dev_table: List[int]):
         """Device->host gather of arbitrary blocks — the fleet KV-ship
@@ -336,6 +382,7 @@ class _KVSwapper:
         dev = np.asarray(dev_table, np.int32)
         eng._kcs = eng._kcs.at[:, dev].set(k_np)
         eng._vcs = eng._vcs.at[:, dev].set(v_np)
+        eng._pin_caches()
 
 
 class LLMEngine:
@@ -442,10 +489,45 @@ class LLMEngine:
         # per slot in the compiled step — 1 without speculation
         self._spec_R = self.cfg.num_spec_tokens + 1
 
+        # -- tensor-parallel serving mesh -------------------------------
+        # tp_degree > 1 shards the model and its paged KV caches over
+        # the first tp devices on a 1-D "tp" mesh. One Layout object
+        # describes the cache everywhere: as the NamedSharding of the
+        # live jax buffers, as the per-shard wire framing of a KV ship,
+        # and as the src/dst of a cross-degree reshard.
+        from paddle_tpu.distributed.redistribute import Layout
+
+        tp = int(self.cfg.tp_degree)
+        self.tp_degree = tp
+        kh = mcfg.num_key_value_heads
+        if tp > 1:
+            if (mcfg.num_attention_heads % tp or kh % tp
+                    or mcfg.intermediate_size % tp):
+                raise ValueError(
+                    f"tp_degree {tp} must divide num_attention_heads "
+                    f"({mcfg.num_attention_heads}), num_key_value_heads "
+                    f"({kh}) and intermediate_size "
+                    f"({mcfg.intermediate_size})")
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tp_degree {tp} needs {tp} devices, "
+                    f"{len(devs)} visible")
+            self._tp_devices: Optional[tuple] = tuple(devs[:tp])
+            # the model's GQA head-packing must group heads per TP
+            # shard so the packed qkv stack stays shard-local
+            if getattr(mcfg, "tp_degree", 1) != tp:
+                mcfg.tp_degree = tp
+        else:
+            self._tp_devices = None
+        # cache layout: (L, NB, BS, KH, D) with the kv-head dim split
+        self.kv_layout = Layout.tp_sharded(5, 3, tp)
+
         self.block_manager = BlockManager(
             self.cfg.num_blocks, self.cfg.block_size,
             num_host_blocks=self.cfg.num_host_blocks,
-            enable_prefix_cache=self.cfg.prefix_cache)
+            enable_prefix_cache=self.cfg.prefix_cache,
+            kv_layout=self.kv_layout)
         self._swapper = _KVSwapper(self)
         self.scheduler = Scheduler(
             self.block_manager,
@@ -462,7 +544,6 @@ class LLMEngine:
         # -- device caches: (L, NB, BS, KH, D) stacked per layer --------
         import jax.numpy as jnp
 
-        kh = mcfg.num_key_value_heads
         hd = mcfg.hidden_size // mcfg.num_attention_heads
         if self.cfg.dtype is not None:
             from paddle_tpu.core.dtype import to_jax
@@ -474,11 +555,22 @@ class LLMEngine:
                  self.cfg.block_size, kh, hd)
         self._kcs = jnp.zeros(shape, cache_dtype)
         self._vcs = jnp.zeros(shape, cache_dtype)
-        # host swap pool: plain numpy, the restore-on-readmit side of
-        # swap-based preemption (the first concrete host-offload stream)
+        if tp > 1:
+            self._cache_sharding = self.kv_layout.named_sharding(
+                self._tp_devices)
+            self._kcs = jax.device_put(self._kcs, self._cache_sharding)
+            self._vcs = jax.device_put(self._vcs, self._cache_sharding)
+        else:
+            self._cache_sharding = None
+        # host swap pool: plain numpy per-shard frames, the
+        # restore-on-readmit side of swap-based preemption. Leading
+        # axis = TP shard (size 1 when unsharded), so a spilled block
+        # never interleaves bytes across shards and a future per-host
+        # pool can ship frames without re-slicing.
         if self.cfg.num_host_blocks > 0:
-            hshape = (mcfg.num_hidden_layers, self.cfg.num_host_blocks,
-                      self.cfg.block_size, kh, hd)
+            hshape = (tp, mcfg.num_hidden_layers,
+                      self.cfg.num_host_blocks, self.cfg.block_size,
+                      kh // tp, hd)
             self._host_k = np.zeros(hshape, np.dtype(cache_dtype))
             self._host_v = np.zeros(hshape, np.dtype(cache_dtype))
         else:
@@ -488,8 +580,19 @@ class LLMEngine:
         from paddle_tpu.jit.trace import functionalize
         from paddle_tpu.ops.sampling import sample_or_verify
 
-        apply, (_, self._params), (_, self._buffers) = functionalize(
+        apply, (self._pnames, self._params), (_, self._buffers) \
+            = functionalize(
             model.forward_paged)
+        if tp > 1:
+            # commit every weight to its TP placement IN PLACE on the
+            # model (the engine owns serving weights): column-parallel
+            # projections split the output dim, row-parallel the input
+            # dim, everything else replicates. GSPMD then propagates
+            # these placements through the one compiled step.
+            for name, p in zip(self._pnames, self._params):
+                lt = _tp_param_layout(name, p._data.ndim, tp)
+                p._data = jax.device_put(
+                    p._data, lt.named_sharding(self._tp_devices))
 
         def pack_sampled(lg3, sdraft, sndraft, skeys, stemp, stopk,
                          stopp):
@@ -524,8 +627,22 @@ class LLMEngine:
         if donate is None:
             donate = jax.default_backend() not in ("cpu",)
         self._donated = bool(donate)
+        if tp > 1:
+            # pin the step's outputs: sampled rows replicate (tiny),
+            # cache outputs KEEP the cache layout — without the pin,
+            # GSPMD may pick a different output sharding and the next
+            # step would silently recompile against drifted caches
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self._cache_sharding.mesh,
+                                PartitionSpec())
+            step_outs = (rep, rep, self._cache_sharding,
+                         self._cache_sharding)
+        else:
+            step_outs = None
         self._jstep = jax.jit(
-            raw_step, donate_argnums=(4, 5) if donate else ())
+            raw_step, donate_argnums=(4, 5) if donate else (),
+            out_shardings=step_outs)
 
         if self._ragged:
             spec_r = self._spec_R
@@ -555,7 +672,8 @@ class LLMEngine:
                 return packed, finite, k2, v2
 
             self._jstep_ragged = jax.jit(
-                raw_step_ragged, donate_argnums=(4, 5) if donate else ())
+                raw_step_ragged, donate_argnums=(4, 5) if donate else (),
+                out_shardings=step_outs)
         else:
             self._jstep_ragged = None
         self._key = jax.random.key(0)
@@ -573,6 +691,10 @@ class LLMEngine:
         # requests admitted mid-context with peer-computed KV (fleet
         # KV-ship import side; serving/continuation_admits gauge)
         self.num_continuation_admits = 0
+        # KV ships that arrived in a DIFFERENT layout than this
+        # engine's caches and were resharded through redistribute
+        # (cross-TP-degree transfers; serving/kv_reshards gauge)
+        self.num_kv_reshards = 0
         # proactive prefix ships (no request attached): whole cached
         # prefixes exported to / imported from peer replicas
         # (serving/prefix_{exports,imports} gauges)
@@ -712,7 +834,60 @@ class LLMEngine:
             self._count_finish("aborted:user")
         return found
 
+    # -- TP layout surface ------------------------------------------------
+    def param_layouts(self) -> Dict[str, object]:
+        """Dotted parameter name -> :class:`Layout` for every forward
+        parameter under this engine's TP degree (all-replicated at
+        tp=1). This is the ``target_layout`` a
+        ``CheckpointManager.restore_or_initialize`` needs to land a
+        train-time checkpoint directly on this serving mesh — one
+        layout vocabulary from checkpoint to compiled step."""
+        return {name: _tp_param_layout(name, p._data.ndim,
+                                       self.tp_degree)
+                for name, p in zip(self._pnames, self._params)}
+
     # -- fleet KV-ship ---------------------------------------------------
+    def _wire_src_layout(self, meta: dict, global_shape):
+        """The layout a shipped KV payload's frames are in. Absent
+        stanza = the pre-TP flat format (one replicated frame). A
+        malformed or non-fitting layout is a clean ``ValueError``
+        rejection, same as any geometry mismatch."""
+        from paddle_tpu.distributed.redistribute import Layout
+
+        lm = meta.get("layout")
+        if lm is None:
+            return Layout.tp_sharded(len(global_shape), 3, 1)
+        try:
+            src = Layout.from_meta(lm)
+            src.validate_shape(global_shape)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"shipped KV layout {lm!r} does not fit shape "
+                f"{list(global_shape)}: {e}") from e
+        return src
+
+    def _land_wire(self, payload: bytes, offset: int, src_layout,
+                   global_shape, dtype: np.dtype) -> np.ndarray:
+        """Parse per-shard wire frames and land them as one global
+        host array in THIS engine's cache orientation. A ship from a
+        replica of a different TP degree reshards through
+        ``redistribute`` — the single primitive both the SPMD step and
+        checkpoint restore use — instead of being rejected."""
+        local = src_layout.local_shape(global_shape)
+        n = int(np.prod(local))
+        frames = [np.frombuffer(payload, dtype=dtype,
+                                offset=offset + i * n * dtype.itemsize,
+                                count=n).reshape(local)
+                  for i in range(src_layout.size)]
+        if src_layout != self.kv_layout:
+            from paddle_tpu.distributed.redistribute import (
+                redistribute_host,
+            )
+
+            frames = redistribute_host(frames, src_layout,
+                                       self.kv_layout, global_shape)
+        return self.kv_layout.assemble(frames, global_shape)
+
     def export_kv(self, request_id: str):
         """Package the request's committed KV for a fleet KV-ship:
         ``(meta, payload)`` where ``payload`` is the K bytes followed by
@@ -734,8 +909,15 @@ class LLMEngine:
         if not table or covered <= 0:
             return None
         k_np, v_np = self._swapper.gather(table)
-        k_bytes = k_np.tobytes()
-        payload = k_bytes + v_np.tobytes()
+        # per-shard framing: K shard frames then V shard frames, in
+        # mesh order — byte-identical to the flat legacy format when
+        # unsharded (one frame each). The layout stanza lets an
+        # importer of a different TP degree reshard through
+        # redistribute instead of rejecting.
+        k_bytes = b"".join(s.tobytes()
+                           for s in self.kv_layout.shards(k_np))
+        payload = k_bytes + b"".join(s.tobytes()
+                                     for s in self.kv_layout.shards(v_np))
         meta = {
             "tokens_covered": int(covered),
             "blocks": len(table),
@@ -743,6 +925,7 @@ class LLMEngine:
             "shape": list(k_np.shape),
             "dtype": str(k_np.dtype),
             "k_bytes": len(k_bytes),
+            "layout": self.kv_layout.to_meta(),
             "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
         }
         return meta, payload
@@ -810,11 +993,13 @@ class LLMEngine:
             raise ValueError(
                 f"request {request_id!r}: shipped KV failed its "
                 f"checksum — payload corrupt, refusing the import")
+        src_layout = self._wire_src_layout(meta, want_shape)
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
         self._apply_rng_state(req, rng_state)
         try:
-            table = self.block_manager.import_blocks(request_id, covered)
+            table = self.block_manager.import_blocks(
+                request_id, covered, src_layout=src_layout)
         except NoFreeBlocksError as e:
             raise ValueError(str(e)) from e
         try:
@@ -822,17 +1007,18 @@ class LLMEngine:
             # is registered yet — a scatter fault must not leak them
             # (the fault point stands in for a device OOM/transfer error)
             faults.fire("serving.kv_scatter")
-            k_np = np.frombuffer(payload, dtype=dtype,
-                                 count=want_bytes // dtype.itemsize)
-            v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
-                                 count=want_bytes // dtype.itemsize)
-            self._swapper.scatter(table, k_np.reshape(want_shape),
-                                  v_np.reshape(want_shape))
+            k_np = self._land_wire(payload, 0, src_layout, want_shape,
+                                   dtype)
+            v_np = self._land_wire(payload, k_bytes, src_layout,
+                                   want_shape, dtype)
+            self._swapper.scatter(table, k_np, v_np)
         except Exception as e:
             self.block_manager.free(request_id)
             raise ValueError(
                 f"request {request_id!r}: KV scatter failed after "
                 f"block allocation ({e}); blocks freed") from e
+        if src_layout != self.kv_layout:
+            self.num_kv_reshards += 1
         req.num_cached = covered
         self._requests[request_id] = req
         self.scheduler.add_continuation(req)
@@ -869,8 +1055,10 @@ class LLMEngine:
             return None
         tokens, table = resolved
         k_np, v_np = self._swapper.gather(table)
-        k_bytes = k_np.tobytes()
-        payload = k_bytes + v_np.tobytes()
+        k_bytes = b"".join(s.tobytes()
+                           for s in self.kv_layout.shards(k_np))
+        payload = k_bytes + b"".join(s.tobytes()
+                                     for s in self.kv_layout.shards(v_np))
         meta = {
             "chain_hash": chain_hash,
             "tokens": [int(t) for t in tokens],
@@ -879,6 +1067,7 @@ class LLMEngine:
             "shape": list(k_np.shape),
             "dtype": str(k_np.dtype),
             "k_bytes": len(k_bytes),
+            "layout": self.kv_layout.to_meta(),
             "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
         }
         self.num_prefix_exports += 1
@@ -934,6 +1123,7 @@ class LLMEngine:
             raise ValueError(
                 "shipped prefix failed its checksum — payload corrupt, "
                 "refusing the import")
+        src_layout = self._wire_src_layout(meta, want_shape)
         if self.block_manager.match_prefix(tokens) >= covered:
             return 0
         if nblocks > self.block_manager.num_uncached_free_blocks:
@@ -944,19 +1134,19 @@ class LLMEngine:
                 f"uncached-free — refusing to evict resident cache")
         rid = f"__prefix_import__{next(self._prefix_import_seq)}"
         try:
-            table = self.block_manager.import_blocks(rid, covered)
+            table = self.block_manager.import_blocks(
+                rid, covered, src_layout=src_layout)
         except NoFreeBlocksError as e:
             raise ValueError(str(e)) from e
         try:
             # same partial-failure discipline as import_kv: a scatter
             # fault after allocation frees the synthetic claim whole
             faults.fire("serving.kv_scatter")
-            k_np = np.frombuffer(payload, dtype=dtype,
-                                 count=want_bytes // dtype.itemsize)
-            v_np = np.frombuffer(payload, dtype=dtype, offset=k_bytes,
-                                 count=want_bytes // dtype.itemsize)
-            self._swapper.scatter(table, k_np.reshape(want_shape),
-                                  v_np.reshape(want_shape))
+            k_np = self._land_wire(payload, 0, src_layout, want_shape,
+                                   dtype)
+            v_np = self._land_wire(payload, k_bytes, src_layout,
+                                   want_shape, dtype)
+            self._swapper.scatter(table, k_np, v_np)
             self.block_manager.commit_prefix(rid, tokens, covered)
         except Exception as e:
             self.block_manager.free(rid)
@@ -964,6 +1154,8 @@ class LLMEngine:
                 f"prefix import scatter failed after block allocation "
                 f"({e}); blocks freed") from e
         self.block_manager.free(rid)
+        if src_layout != self.kv_layout:
+            self.num_kv_reshards += 1
         self.num_prefix_imports += 1
         return covered
 
@@ -1381,6 +1573,18 @@ class LLMEngine:
         dst = np.asarray([p[1] for p in pairs], np.int32)
         self._kcs = self._kcs.at[:, dst].set(self._kcs[:, src])
         self._vcs = self._vcs.at[:, dst].set(self._vcs[:, src])
+        self._pin_caches()
+
+    def _pin_caches(self):
+        """Re-commit both caches to the TP cache sharding after an
+        eager update: eager ops may hand back a differently-sharded
+        result, and a drifted cache layout would silently recompile
+        the ONE step the engine promises. No-op unsharded."""
+        if self._cache_sharding is not None:
+            import jax
+
+            self._kcs = jax.device_put(self._kcs, self._cache_sharding)
+            self._vcs = jax.device_put(self._vcs, self._cache_sharding)
 
     # -- the guarded compiled dispatch ----------------------------------
     def _dispatch(self, reqs, kind, arrays, B, S, sampling_arrays):

@@ -28,33 +28,51 @@ class AutoscalePolicy:
     Return the desired dispatchable-replica count, or None for "no
     change"."""
 
-    def decide(self, load: float, replicas_live: int,
-               queued: int) -> Optional[int]:
+    def decide(self, load: float, replicas_live: int, queued: int,
+               tenant_load: float = 0.0) -> Optional[int]:
         raise NotImplementedError
 
 
 class LoadThresholdPolicy(AutoscalePolicy):
     """Hysteresis band: scale up one replica when fleet load exceeds
     ``high`` (or requests are queued with nothing dispatchable), down
-    one when it falls below ``low``; hold inside the band."""
+    one when it falls below ``low``; hold inside the band.
+
+    ``tenant_high`` adds a second, skew-sensitive trigger: scale up
+    when the router's :meth:`~FleetRouter.tenant_load` — scalar load
+    amplified by how concentrated recent dispatches are on one tenant
+    — exceeds it. The fleet-MEAN load can sit inside the band while a
+    single tenant's burst saturates exactly the replicas its requests
+    land on; the tenant signal sees that spike. ``None`` (default)
+    keeps the policy bit-identical to the scalar one. Scale-DOWN
+    still keys on the scalar load only — concentration of a trickle
+    is not a reason to hold capacity."""
 
     def __init__(self, high: float = 0.8, low: float = 0.2,
-                 min_replicas: int = 1, max_replicas: int = 8):
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 tenant_high: Optional[float] = None):
         if not 0.0 <= low < high <= 1.0:
             raise ValueError("need 0 <= low < high <= 1")
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
+        if tenant_high is not None and not 0.0 < tenant_high <= 1.0:
+            raise ValueError("need 0 < tenant_high <= 1")
         self.high = high
         self.low = low
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
+        self.tenant_high = tenant_high
 
-    def decide(self, load: float, replicas_live: int,
-               queued: int) -> Optional[int]:
-        if ((load > self.high or (queued > 0 and replicas_live == 0))
+    def decide(self, load: float, replicas_live: int, queued: int,
+               tenant_load: float = 0.0) -> Optional[int]:
+        hot_tenant = (self.tenant_high is not None
+                      and tenant_load > self.tenant_high)
+        if ((load > self.high or hot_tenant
+                or (queued > 0 and replicas_live == 0))
                 and replicas_live < self.max_replicas):
             return replicas_live + 1
-        if load < self.low and replicas_live > self.min_replicas:
+        if (load < self.low and not hot_tenant
+                and replicas_live > self.min_replicas):
             return replicas_live - 1
         return None
 
@@ -99,8 +117,15 @@ class FleetController:
         if self.policy is None:
             return None
         live = len(self.router.dispatchable())
-        target = self.policy.decide(self.router.load(), live,
-                                    len(self.router._queue))
+        tload = getattr(self.router, "tenant_load", None)
+        try:
+            target = self.policy.decide(
+                self.router.load(), live, len(self.router._queue),
+                tenant_load=tload() if callable(tload) else 0.0)
+        except TypeError:
+            # user-supplied policy predating the tenant_load kwarg
+            target = self.policy.decide(self.router.load(), live,
+                                        len(self.router._queue))
         self.router.num_autoscale_decisions += 1
         if target is not None and target != live:
             self.scale_to(target, reason="autoscale")

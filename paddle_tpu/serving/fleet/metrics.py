@@ -127,15 +127,23 @@ class FleetMetrics:
         out["fleet_tokens_per_sec"] = round(
             r.num_tokens_emitted / dt if dt > 0 else 0.0, 2)
         out["fleet_load"] = round(r.load(), 4)
+        # peek — consuming the window here would starve the autoscale
+        # policy's view of the same signal
+        out["fleet_tenant_load"] = round(
+            r.tenant_load(consume=False), 4)
         out["fleet_finish"] = dict(sorted(r.finish_counts.items()))
         out["fleet_ticket_outcomes"] = dict(r.ticket_outcomes)
         tenants = {}
         waiting = r._queue.waiting_by_tenant()
-        for t in sorted(set(waiting) | set(r.tenant_wait_s)):
+        for t in sorted(set(waiting) | set(r.tenant_wait_s)
+                        | set(r.tenant_dispatches)):
             waits = r.tenant_wait_s.get(t, [])
             tenants[t] = {
                 "waiting": waiting.get(t, 0),
                 "dispatched": len(waits),
+                # every dispatch, continuations and handoff retries
+                # included ("dispatched" above counts first dispatches)
+                "dispatches_total": r.tenant_dispatches.get(t, 0),
                 "wait_ms_avg": round(_mean(waits) * 1e3, 3),
                 "wait_ms_max": round(max(waits) * 1e3, 3) if waits
                 else 0.0,

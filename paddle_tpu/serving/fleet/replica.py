@@ -351,7 +351,8 @@ class InProcessReplica(ReplicaHandle):
             self._parked.pop(next(iter(self._parked)))
         return {"bytes": len(payload),
                 "blocks": int(meta.get("blocks", 0)),
-                "tokens_covered": int(meta.get("tokens_covered", 0))}
+                "tokens_covered": int(meta.get("tokens_covered", 0)),
+                "layout": meta.get("layout")}
 
     def drop_parked(self, request_id: str) -> None:
         self._parked.pop(request_id, None)
@@ -379,7 +380,8 @@ class InProcessReplica(ReplicaHandle):
         return {"bytes": len(payload),
                 "blocks": int(meta.get("blocks", 0)),
                 "tokens_covered": int(meta.get("tokens_covered", 0)),
-                "tokens": len(meta.get("tokens") or ())}
+                "tokens": len(meta.get("tokens") or ()),
+                "layout": meta.get("layout")}
 
     def peer_commit(self, ticket_id: str, *, kind: str = "kv",
                     request_id: Optional[str] = None,

@@ -353,6 +353,13 @@ class FleetRouter:
         # which double-counts handed-off attempts by design)
         self.finish_counts: Dict[str, int] = {}
         self.tenant_wait_s: Dict[str, List[float]] = {}
+        # per-tenant dispatch gauges: lifetime counts (observability)
+        # plus a since-last-poll window that tenant_load() consumes —
+        # the window makes a one-tenant burst visible to the autoscale
+        # policy even when the fleet-MEAN load it thresholds on stays
+        # flat (every dispatch is counted, continuations included)
+        self.tenant_dispatches: Dict[str, int] = {}
+        self._tenant_window: Dict[str, int] = {}
         for h in replicas:
             self.attach_replica(h)
         self.metrics = FleetMetrics(self)
@@ -1100,6 +1107,10 @@ class FleetRouter:
             fr.replica_id = handle.replica_id
             fr.dispatches += 1
             self.num_dispatched += 1
+            self.tenant_dispatches[tenant] = \
+                self.tenant_dispatches.get(tenant, 0) + 1
+            self._tenant_window[tenant] = \
+                self._tenant_window.get(tenant, 0) + 1
             if fr.dispatch_t is None:
                 fr.dispatch_t = now
                 self.tenant_wait_s.setdefault(tenant, []).append(
@@ -1682,6 +1693,28 @@ class FleetRouter:
             vals.append(max(ld.kv_utilization,
                             min(1.0, ld.occupancy / max(seqs, 1))))
         return sum(vals) / len(vals)
+
+    def tenant_load(self, consume: bool = True) -> float:
+        """Skew-amplified load in [0, 1]: the scalar :meth:`load`
+        scaled by ``max_tenant_share * active_tenants`` over the
+        dispatches since the last poll. Balanced traffic (share 1/N
+        over N tenants) and single-tenant traffic both degenerate to
+        plain ``load()``; a one-tenant burst pushes share toward 1
+        with N tenants active, amplifying the signal N-fold — which
+        is what lets :class:`LoadThresholdPolicy.tenant_high` see a
+        hot tenant the fleet mean averages away. Clock-free (counts,
+        not rates), so it works on FleetSim's virtual clock.
+        ``consume=False`` peeks without resetting the window (the
+        metrics snapshot path)."""
+        win = self._tenant_window
+        if consume:
+            self._tenant_window = {}
+        total = sum(win.values())
+        if total == 0:
+            return 0.0
+        share = max(win.values()) / total
+        active = sum(1 for v in win.values() if v)
+        return min(1.0, self.load() * share * active)
 
     def snapshot(self) -> Dict:
         return self.metrics.snapshot()

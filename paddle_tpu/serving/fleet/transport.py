@@ -281,6 +281,25 @@ class PeerListener:
             with self._lock:
                 self._stats["refused"] += 1
             return {"ok": False, "error": "payload checksum mismatch"}
+        if meta.get("layout") is not None:
+            # TP-sharded exporters frame the payload per mesh shard;
+            # refuse a malformed or payload-incompatible layout stanza
+            # AT THE DOOR so the source sees the rung die immediately
+            # instead of the commit failing minutes later (the commit
+            # path re-validates — this is fail-fast, not the gate)
+            from paddle_tpu.distributed.redistribute import Layout
+            try:
+                lt = Layout.from_meta(meta["layout"])
+                # K frames + V frames, one pair per mesh device
+                if payload and len(payload) % (2 * lt.size):
+                    raise ValueError(
+                        f"payload {len(payload)}B does not split into "
+                        f"2x{lt.size} shard frames")
+            except (ValueError, KeyError, TypeError) as e:
+                with self._lock:
+                    self._stats["refused"] += 1
+                return {"ok": False,
+                        "error": f"bad layout stanza: {e}"}
         expires = time.monotonic() + float(
             ticket.get("deadline_ms", 30e3)) / 1e3
         self.gc()  # expired entries never block a fresh admission

@@ -61,7 +61,11 @@ class ServingMetrics:
               # fleet-global prefix cache (ISSUE 14): whole cached
               # prefixes shipped to/from peer replicas, no request
               # attached
-              "prefix_exports", "prefix_imports")
+              "prefix_exports", "prefix_imports",
+              # TP-sharded serving (ISSUE 17): shipped KV payloads that
+              # landed through a cross-layout redistribute, and ship
+              # continuations the mixed scheduler resumed mid-context
+              "kv_reshards", "continuation_resumes")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -91,6 +95,9 @@ class ServingMetrics:
         "continuation_admits": lambda eng: eng.num_continuation_admits,
         "prefix_exports": lambda eng: eng.num_prefix_exports,
         "prefix_imports": lambda eng: eng.num_prefix_imports,
+        "kv_reshards": lambda eng: eng.num_kv_reshards,
+        "continuation_resumes":
+            lambda eng: eng.scheduler.num_continuation_resumes,
     }
 
     def __init__(self, engine):

@@ -127,6 +127,11 @@ class Scheduler:
         # prefill; every piece of a split prompt counts, including the
         # final one)
         self.num_prefill_chunks = 0
+        # KV-ship continuations admitted with a pre-filled table (the
+        # imported blocks may hold bytes that landed through a
+        # cross-TP-degree reshard — scheduling is layout-agnostic, so
+        # this counter is the only place the scheduler sees them)
+        self.num_continuation_resumes = 0
 
     # -- queue ops -------------------------------------------------------
     def add(self, request: Request):
@@ -475,6 +480,7 @@ class Scheduler:
                 except NoFreeBlocksError:
                     break  # blocks free up as running requests finish
                 req.status = RequestStatus.RUNNING
+                self.num_continuation_resumes += 1
                 admitted.append(req)
                 rows.append(req)
                 nsched.append(n)

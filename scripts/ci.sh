@@ -43,6 +43,15 @@
 #                            fleet hit rate on the second replica
 #                            WITHOUT it ever prefilling the shared
 #                            header, and token parity; ~1 min)
+#   scripts/ci.sh --tp       TP-sharded serving smoke only (forced
+#                            4-device host mesh; TP=2 token-identical
+#                            to TP=1 through preemption + prefix hits,
+#                            a TP=1→TP=2 KV ship landed through
+#                            redistribute with zero tokens recomputed,
+#                            fleet drain hand-off across degrees with
+#                            the fault-injected ladder fallback, and a
+#                            checkpoint restored onto the TP=2 layouts
+#                            bit-identically; ~2 min)
 #
 # tpulint runs over the linted tree (paddle_tpu/ + tests/mp_scripts —
 # the same set tests/test_lint_clean.py gates) and subtracts
@@ -152,6 +161,19 @@ run_prefix() {
 
 if [[ "${1:-}" == "--prefix" ]]; then
     run_prefix
+    exit 0
+fi
+
+run_tp() {
+    echo "== tp smoke =="
+    # tp_smoke.py forces its own 4-device host mesh via XLA_FLAGS
+    # before importing jax; 420s covers the extra SPMD compiles
+    timeout -k 10 420 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/tp_smoke.py
+}
+
+if [[ "${1:-}" == "--tp" ]]; then
+    run_tp
     exit 0
 fi
 

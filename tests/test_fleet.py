@@ -31,8 +31,9 @@ from paddle_tpu.serving import (
     EngineConfig, LLMEngine, RequestOutput, SamplingParams,
 )
 from paddle_tpu.serving.fleet import (
-    FleetConfig, FleetController, FleetRouter, InProcessReplica,
-    LoadThresholdPolicy, ReplicaHandle, ReplicaLoad, TenantQueue,
+    AutoscalePolicy, FleetConfig, FleetController, FleetRouter,
+    InProcessReplica, LoadThresholdPolicy, ReplicaHandle, ReplicaLoad,
+    TenantQueue,
 )
 from paddle_tpu.testing import faults
 
@@ -501,6 +502,60 @@ class TestScaling:
         assert p.decide(0.0, 0, 5) == 1         # queued, nothing live
         with pytest.raises(ValueError):
             LoadThresholdPolicy(high=0.2, low=0.8)
+
+    def test_policy_tenant_high_trigger(self):
+        p = LoadThresholdPolicy(high=0.9, low=0.1, max_replicas=4,
+                                tenant_high=0.5)
+        # mean in band, one hot tenant: scale up anyway
+        assert p.decide(0.4, 2, 0, tenant_load=0.8) == 3
+        # a hot tenant also vetoes the scale-down leg
+        assert p.decide(0.05, 2, 0, tenant_load=0.8) == 3
+        # no skew -> bit-identical to the scalar policy
+        assert p.decide(0.4, 2, 0, tenant_load=0.0) is None
+        assert p.decide(0.05, 2, 0, tenant_load=0.0) == 1
+        # knob off (default): tenant signal ignored entirely
+        assert LoadThresholdPolicy(high=0.9).decide(
+            0.4, 2, 0, tenant_load=0.99) is None
+        with pytest.raises(ValueError):
+            LoadThresholdPolicy(tenant_high=1.5)
+
+    def test_router_tenant_load_amplifies_skew(self):
+        router = FleetRouter([FakeReplica("ra", capacity=16)])
+        for tenant, n in (("hot", 3), ("cold", 1)):
+            for _ in range(n):
+                router.add_request([1], SamplingParams(
+                    max_new_tokens=99, tenant_id=tenant))
+        router.step()                           # dispatch all 4
+        load = router.load()                    # 4/8 occupancy = 0.5
+        # share 0.75 x 2 active tenants = 1.5x amplification
+        assert router.tenant_load() == pytest.approx(load * 1.5)
+        assert router.tenant_dispatches == {"hot": 3, "cold": 1}
+        # the poll consumed the window; nothing new dispatched since
+        assert router.tenant_load() == 0.0
+
+    def test_tick_passes_tenant_load_and_tolerates_old_policies(self):
+        busy = FakeReplica("ra", capacity=16)
+        router = FleetRouter([busy])
+        for _ in range(4):
+            router.add_request([1], SamplingParams(
+                max_new_tokens=99, tenant_id="hot"))
+        router.step()
+
+        class OldPolicy(AutoscalePolicy):
+            def decide(self, load, replicas_live, queued):
+                return None                     # pre-kwarg signature
+
+        ctl = FleetController(router, lambda i: FakeReplica(f"f{i}"),
+                              policy=OldPolicy())
+        assert ctl.tick() is None               # no TypeError escape
+        # mean load 0.5 sits in band; the tenant signal (one tenant
+        # owns every window dispatch at load 0.5) crosses 0.4
+        router.tenant_dispatches.clear()
+        router._tenant_window["hot"] = 4
+        ctl.policy = LoadThresholdPolicy(high=0.9, low=0.1,
+                                         tenant_high=0.4)
+        assert ctl.tick() == 2
+        assert router.num_scale_ups == 1
 
     def test_scale_to_up_and_down(self):
         router = FleetRouter([FakeReplica("f0")])

@@ -28,8 +28,8 @@ from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import EngineConfig, SamplingParams
 from paddle_tpu.serving.fleet import (
     ChaosEvent, FleetConfig, FleetRouter, FleetSim, InProcessReplica,
-    LeaseStore, ReplicaHandle, SimReplica, diurnal_trace,
-    rendezvous_owner, sim_token, spike_trace,
+    LeaseStore, LoadThresholdPolicy, ReplicaHandle, SimReplica,
+    diurnal_trace, rendezvous_owner, sim_token, spike_trace,
 )
 from paddle_tpu.serving.fleet.supervisor import (
     ReplicaSupervisor, SupervisorConfig, _Slot,
@@ -626,6 +626,40 @@ class TestFleetSim:
                             duration_s=1.5)]
         sim.run(trace, chaos=chaos, max_virtual_s=120.0)
         sim.check()
+
+    def test_one_tenant_spike_needs_tenant_signal(self):
+        """ISSUE 17 satellite: the fleet-MEAN load policy sleeps
+        through a single tenant's burst (capacity absorbs it, the
+        mean stays in band), while the same thresholds plus
+        ``tenant_high`` see the dispatch-skew-amplified signal and
+        scale up. Exactness invariants hold in both runs."""
+        def build(policy):
+            sim = FleetSim(n_replicas=12, n_routers=1, seed=7,
+                           autoscale=policy)
+            trace = spike_trace(
+                duration_s=8.0, tenants=["a", "b", "c", "hot"],
+                base_rps=4, spike_at=[2.0], spike_n=40,
+                spike_tenant="hot", max_new=8, seed=7)
+            # poll fast enough (virtual 50 ms) to catch the burst
+            # in flight — it drains in ~8 decode steps
+            sim.run(trace, autoscale_every_s=0.05,
+                    max_virtual_s=240.0)
+            sim.check()
+            return sim
+
+        scalar = build(LoadThresholdPolicy(
+            high=0.95, low=0.0, max_replicas=20))
+        assert scalar.scale_events == []
+        assert scalar.routers[0].num_scale_ups == 0
+
+        tenant = build(LoadThresholdPolicy(
+            high=0.95, low=0.0, max_replicas=20, tenant_high=0.6))
+        assert tenant.routers[0].num_scale_ups >= 1
+        assert any(e["scale_to"] > 12 for e in tenant.scale_events)
+        # the gauge that fed the trigger recorded the skew
+        disp = tenant.routers[0].tenant_dispatches
+        assert disp["hot"] >= 40
+        assert disp["hot"] > max(disp.get(t, 0) for t in "abc")
 
     @pytest.mark.slow
     def test_hundred_replica_acceptance(self):

@@ -1,0 +1,191 @@
+"""Layout algebra + redistribute: randomized property tests.
+
+All on the single-device CPU CI platform: the numpy oracle is the
+reference implementation, so every (mesh shape x placement) pair is
+exercised through host indexing, and the jax device path is checked
+only where one device suffices (1-device meshes are identity).
+Multi-device agreement between the oracle and the device path is the
+tp smoke's job (scripts/tp_smoke.py runs under a forced-host-device
+mesh).
+
+Properties pinned here, per ISSUE 17:
+* roundtrip: redistribute(redistribute(x, a, b), b, a) == x
+* composition: a->b->c lands the same shards as a->c directly
+* degenerate 1-device mesh is the identity (zero bytes moved)
+* numpy oracle parity: assemble(shards(x)) == x for every layout
+"""
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.redistribute import (
+    Layout, get_stats, redistribute_host, reset_stats, transfer_bytes,
+)
+
+MESH_SIZES = [1, 2, 4, 8]
+
+
+def _random_layout(rng, ndim, size):
+    """A random layout of total device count ``size``: factor the size
+    into named axes, then scatter the axes over tensor dims (or leave
+    them as pure replication axes)."""
+    axes = []
+    remaining = size
+    i = 0
+    while remaining > 1:
+        f = rng.choice([d for d in (2, 4, remaining)
+                        if d <= remaining and remaining % d == 0])
+        axes.append((f"ax{i}", f))
+        remaining //= f
+        i += 1
+    if not axes:
+        axes = [("ax0", 1)]
+    placements = [None] * ndim
+    dims = list(range(ndim))
+    rng.shuffle(dims)
+    for (name, sz), d in zip(axes, dims):
+        if sz > 1 and rng.random() < 0.8:
+            placements[d] = name
+    return Layout(axes, placements)
+
+
+def _shape_for(layouts, rng, ndim):
+    """A global shape every layout in ``layouts`` divides evenly."""
+    shape = []
+    for d in range(ndim):
+        lcm = 1
+        for lt in layouts:
+            deg = lt.sharding_degree(d)
+            lcm = lcm * deg // np.gcd(lcm, deg)
+        shape.append(lcm * rng.randint(1, 3))
+    return tuple(shape)
+
+
+def test_oracle_parity_shards_assemble_roundtrip():
+    rng = random.Random(0)
+    for size in MESH_SIZES:
+        for ndim in (1, 2, 3):
+            for _ in range(8):
+                lt = _random_layout(rng, ndim, size)
+                shape = _shape_for([lt], rng, ndim)
+                x = np.arange(np.prod(shape), dtype=np.float32
+                              ).reshape(shape)
+                shards = lt.shards(x)
+                assert len(shards) == lt.size
+                for i, sh in enumerate(shards):
+                    assert sh.shape == lt.local_shape(shape)
+                    np.testing.assert_array_equal(
+                        sh, x[lt.shard_slices(shape, i)])
+                np.testing.assert_array_equal(lt.assemble(shards), x)
+
+
+def test_redistribute_roundtrip_and_composition():
+    rng = random.Random(1)
+    for size_a, size_b in itertools.product(MESH_SIZES, MESH_SIZES):
+        for _ in range(4):
+            ndim = rng.choice([2, 3])
+            a = _random_layout(rng, ndim, size_a)
+            b = _random_layout(rng, ndim, size_b)
+            c = _random_layout(rng, ndim, rng.choice(MESH_SIZES))
+            shape = _shape_for([a, b, c], rng, ndim)
+            x = np.random.RandomState(7).randn(*shape).astype(
+                np.float32)
+            sa = a.shards(x)
+            sb = redistribute_host(sa, a, b)
+            # roundtrip
+            back = redistribute_host(sb, b, a)
+            for s0, s1 in zip(sa, back):
+                np.testing.assert_array_equal(s0, s1)
+            # composition: a->b->c == a->c
+            via = redistribute_host(sb, b, c)
+            direct = redistribute_host(sa, a, c)
+            for s0, s1 in zip(via, direct):
+                np.testing.assert_array_equal(s0, s1)
+
+
+def test_one_device_mesh_is_identity_and_free():
+    lt = Layout.replicated(3)
+    assert lt.size == 1
+    x = np.arange(24, dtype=np.int32).reshape(2, 3, 4)
+    reset_stats()
+    (out,) = redistribute_host([x], lt, lt)
+    np.testing.assert_array_equal(out, x)
+    st = get_stats()
+    assert st["num_redistributes"] == 1
+    assert st["bytes_moved"] == 0  # nothing crosses devices
+
+
+def test_transfer_bytes_pricing():
+    # replicated -> 2-way sharded on the same 2 devices: each device
+    # already holds its slice => zero bytes
+    rep2 = Layout((("tp", 2),), (None, None))
+    shard2 = Layout((("tp", 2),), ("tp", None))
+    assert transfer_bytes(rep2, shard2, (4, 6), 4) == 0
+    # sharded -> replicated: each device must fetch the other half
+    assert transfer_bytes(shard2, rep2, (4, 6), 4) == 2 * (2 * 6) * 4
+    # resharding dim0 -> dim1 on 2 devices: each needs half its new
+    # shard from the peer (2x1x... blocks)
+    shard_d1 = Layout((("tp", 2),), (None, "tp"))
+    assert transfer_bytes(shard2, shard_d1, (4, 6), 4) == 2 * (2 * 3) * 4
+    # cross-degree embed: tp=1 -> tp=2 over the common 2-device mesh;
+    # device 0 holds everything (replica), device 1 must receive its
+    # half
+    rep1 = Layout.replicated(2)
+    assert transfer_bytes(rep1, shard2, (4, 6), 4) == 0
+    # 1-device source is NOT resident on device 1? With the
+    # trailing-replication embedding the tp=1 layout replicates over
+    # both devices, so the bytes above are 0; the priced cost model is
+    # intra-mesh. A genuinely cold destination is priced by the full
+    # dst volume:
+    assert transfer_bytes(shard2, shard2, (4, 6), 4) == 0
+
+
+def test_layout_validation_errors():
+    with pytest.raises(ValueError):
+        Layout((("tp", 2), ("tp", 4)), (None,))  # dup axis name
+    with pytest.raises(ValueError):
+        Layout((("tp", 2),), ("tp", "tp"))  # axis shards two dims
+    with pytest.raises(ValueError):
+        Layout((("tp", 2),), ("dp",))  # unknown axis
+    lt = Layout((("tp", 2),), ("tp", None))
+    with pytest.raises(ValueError):
+        lt.validate_shape((3, 4))  # 3 not divisible by 2
+    with pytest.raises(ValueError):
+        lt.assemble([np.zeros((1, 4))])  # wrong shard count
+
+
+def test_wire_meta_roundtrip():
+    rng = random.Random(2)
+    for size in MESH_SIZES:
+        lt = _random_layout(rng, 3, size)
+        assert Layout.from_meta(lt.to_meta()) == lt
+        # json-safe
+        import json
+
+        assert Layout.from_meta(
+            json.loads(json.dumps(lt.to_meta()))) == lt
+
+
+def test_tp_sharded_constructor():
+    lt = Layout.tp_sharded(5, 3, 2)
+    assert lt.dim_placements == (None, None, None, "tp", None)
+    assert lt.size == 2
+    assert lt.local_shape((2, 3, 4, 8, 16)) == (2, 3, 4, 4, 16)
+    # degree=1 degenerates to replicated-on-one
+    lt1 = Layout.tp_sharded(5, 3, 1)
+    assert lt1.is_replicated and lt1.size == 1
+
+
+def test_device_path_single_device_identity():
+    """The jax path on the 1-device CI platform: 1-device layouts only,
+    but it exercises the jit + NamedSharding lowering end to end."""
+    import jax
+
+    lt = Layout.replicated(2)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    from paddle_tpu.distributed.redistribute import redistribute
+
+    y = redistribute(x, lt, lt, devices=jax.devices()[:1])
+    np.testing.assert_array_equal(np.asarray(y), x)
