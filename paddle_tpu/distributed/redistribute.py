@@ -193,6 +193,27 @@ class Layout:
             out[self.shard_slices(global_shape, i)] = sh
         return out
 
+    def shard_frames(self, x: np.ndarray) -> np.ndarray:
+        """:meth:`shards` stacked along a leading ``(size,)`` axis —
+        the spill/wire framing: one contiguous ``(size, *local_shape)``
+        array whose frame ``i`` is device ``i``'s shard. Degree 1 is a
+        plain ``x[None]``, so replicated callers pay one copy and no
+        branches. This is how KV leaves the device tier (host swap
+        pool, tiered host region, peer payloads): per-shard frames,
+        never a pre-assembled global array."""
+        return np.stack(self.shards(x))
+
+    def unshard_frames(self, frames: np.ndarray,
+                       global_shape: Optional[Sequence[int]] = None
+                       ) -> np.ndarray:
+        """Inverse of :meth:`shard_frames`: reassemble the global array
+        from a ``(size, *local_shape)`` frame stack."""
+        frames = np.asarray(frames)
+        if frames.shape[0] != self.size:
+            raise ValueError(
+                f"layout has {self.size} frames, got {frames.shape[0]}")
+        return self.assemble(list(frames), global_shape)
+
     # -- wire format ---------------------------------------------------
     def to_meta(self) -> dict:
         return {"mesh_axes": [[n, s] for n, s in self.mesh_axes],

@@ -146,6 +146,14 @@ class EngineConfig:
     # slots (default: num_blocks) and restores them on re-admission
     swap_mode: str = "recompute"
     num_host_blocks: Optional[int] = None
+    # tiered KV (ISSUE 19): True / a KVTiersConfig / a dict of its
+    # fields turns the host pool into a second cache TIER — cold
+    # prefixes and parked sessions demote there instead of evicting,
+    # admission counts reachable blocks across tiers, and
+    # park_session/resume_session serve multi-turn traffic with zero
+    # re-prefill. Rides the ragged step (forces chunked prefill +
+    # prefix caching).
+    kv_tiers: Optional[object] = None
     # -- ragged serving hot path ----------------------------------------
     # ragged=None auto-enables the unpadded single-shape step when the
     # model exposes ``forward_ragged``: every iteration dispatches ONE
@@ -344,7 +352,7 @@ class _KVSwapper:
     def _frames(self, arr: np.ndarray) -> np.ndarray:
         """Global (L, n, BS, KH, D) gather -> stacked per-TP-shard
         frames (tp, L, n, BS, KH/tp, D); a single frame unsharded."""
-        return np.stack(self._eng.kv_layout.shards(arr))
+        return self._eng.kv_layout.shard_frames(arr)
 
     def copy_in(self, request: Request, host_table: List[int],
                 dev_table: List[int]):
@@ -352,8 +360,8 @@ class _KVSwapper:
         eng = self._eng
         host = np.asarray(host_table, np.int32)
         dev = np.asarray(dev_table, np.int32)
-        k_np = eng.kv_layout.assemble(list(eng._host_k[:, :, host]))
-        v_np = eng.kv_layout.assemble(list(eng._host_v[:, :, host]))
+        k_np = eng.kv_layout.unshard_frames(eng._host_k[:, :, host])
+        v_np = eng.kv_layout.unshard_frames(eng._host_v[:, :, host])
         eng._kcs = eng._kcs.at[:, dev].set(k_np)
         eng._vcs = eng._vcs.at[:, dev].set(v_np)
         eng._pin_caches()
@@ -363,16 +371,47 @@ class _KVSwapper:
         export path. Same discipline as ``copy_out``/``fence`` (a
         functional gather into a fresh buffer, async D2H start, then
         land), except the bytes leave the process instead of landing in
-        a host-pool slot, so the land is immediate."""
+        a host-pool slot, so the land is immediate.
+
+        Tiered tables may hold VIRTUAL entries whose bytes live in the
+        host pool: any pending tier moves land first (their bytes may
+        still be device-side), then host-tier rows read straight from
+        the numpy pool — no promote, no device round-trip."""
         eng = self._eng
-        dev = np.asarray(dev_table, np.int32)
-        k_slice = eng._kcs[:, dev]   # functional gather: its own buffer
-        v_slice = eng._vcs[:, dev]
-        for buf in (k_slice, v_slice):
-            start = getattr(buf, "copy_to_host_async", None)
-            if start is not None:
-                start()             # overlap D2H across the two slices
-        return np.asarray(k_slice), np.asarray(v_slice)
+        bm = eng.block_manager
+        if eng._kvtier is not None:
+            eng._kvtier.apply_moves()
+        host_pos = [(i, bm.host_slot_of(b))
+                    for i, b in enumerate(dev_table)
+                    if bm.is_host_entry(b)]
+        if not host_pos:
+            dev = np.asarray(dev_table, np.int32)
+            k_slice = eng._kcs[:, dev]  # functional gather: own buffer
+            v_slice = eng._vcs[:, dev]
+            for buf in (k_slice, v_slice):
+                start = getattr(buf, "copy_to_host_async", None)
+                if start is not None:
+                    start()         # overlap D2H across the two slices
+            return np.asarray(k_slice), np.asarray(v_slice)
+        self.fence()
+        L, _, BS, KH, D = eng._kcs.shape
+        dt = np.dtype(eng._kcs.dtype)
+        k_out = np.empty((L, len(dev_table), BS, KH, D), dt)
+        v_out = np.empty((L, len(dev_table), BS, KH, D), dt)
+        dev_pos = [(i, b) for i, b in enumerate(dev_table)
+                   if not bm.is_host_entry(b)]
+        if dev_pos:
+            idxs = [i for i, _ in dev_pos]
+            ids = np.asarray([b for _, b in dev_pos], np.int32)
+            k_out[:, idxs] = np.asarray(eng._kcs[:, ids])  # tpulint: disable=host-sync-in-traced (mixed-tier gather: the export path's one device read, off the step's critical path)
+            v_out[:, idxs] = np.asarray(eng._vcs[:, ids])
+        idxs = [i for i, _ in host_pos]
+        slots = [s for _, s in host_pos]
+        k_out[:, idxs] = eng.kv_layout.unshard_frames(
+            eng._host_k[:, :, slots])
+        v_out[:, idxs] = eng.kv_layout.unshard_frames(
+            eng._host_v[:, :, slots])
+        return k_out, v_out
 
     def scatter(self, dev_table: List[int], k_np, v_np):
         """Write shipped KV bytes into freshly claimed device blocks
@@ -427,6 +466,20 @@ class LLMEngine:
             self.cfg.num_host_blocks = (
                 self.cfg.num_blocks if self.cfg.swap_mode == "host" else 0)
 
+        # -- tiered-KV resolution: normalize the knob, then force a
+        # host pool at least as large as the device pool (the host
+        # tier IS the host pool; swap-mode spills share it)
+        from paddle_tpu.serving.kvtier import KVTiersConfig, TieredKVStore
+
+        self._tiers_cfg = KVTiersConfig.from_any(self.cfg.kv_tiers)
+        self._tiered = self._tiers_cfg is not None
+        if self._tiered:
+            want_host = (self._tiers_cfg.num_host_blocks
+                         if self._tiers_cfg.num_host_blocks is not None
+                         else self.cfg.num_blocks)
+            self.cfg.num_host_blocks = max(self.cfg.num_host_blocks,
+                                           want_host)
+
         # -- ragged-path resolution (model-dependent, so not in
         # EngineConfig.__post_init__): ragged auto-enables on models
         # exposing forward_ragged; chunked prefill is inseparable from
@@ -439,10 +492,30 @@ class LLMEngine:
             raise ValueError(
                 "ragged=True needs a model exposing forward_ragged "
                 "(fall back to the bucketed path with ragged=False)")
+        # the bucketed forward_paged fallback is a degree-1, single-tier
+        # path; configurations that can only fail LATE (shape drift at
+        # the first sharded dispatch, a host-tier block table the padded
+        # op cannot index) are refused here instead
+        if not self.cfg.ragged:
+            if self.cfg.tp_degree > 1:
+                raise ValueError(
+                    f"tp_degree={self.cfg.tp_degree} needs the ragged "
+                    f"step — the bucketed forward_paged fallback "
+                    f"(ragged=False) is degree-1-only; use a model "
+                    f"exposing forward_ragged")
+            if self._tiered:
+                raise ValueError(
+                    "kv_tiers rides the ragged step (host-tier blocks "
+                    "are attended through the single-shape concat) — "
+                    "it cannot run with ragged=False")
         if self.cfg.chunked_prefill is None:
             self.cfg.chunked_prefill = self.cfg.ragged
         if self.cfg.prefix_cache is None:
             self.cfg.prefix_cache = self.cfg.ragged
+        if self._tiered and not self.cfg.prefix_cache:
+            raise ValueError(
+                "kv_tiers needs prefix_cache (the trie is what spans "
+                "tiers) — do not disable it with tiering on")
         if self.cfg.chunked_prefill != self.cfg.ragged:
             raise ValueError(
                 "chunked_prefill rides the ragged step: a lone "
@@ -527,8 +600,10 @@ class LLMEngine:
             self.cfg.num_blocks, self.cfg.block_size,
             num_host_blocks=self.cfg.num_host_blocks,
             enable_prefix_cache=self.cfg.prefix_cache,
-            kv_layout=self.kv_layout)
+            kv_layout=self.kv_layout, tiered=self._tiered)
         self._swapper = _KVSwapper(self)
+        self._kvtier = (TieredKVStore(self, self._tiers_cfg)
+                        if self._tiered else None)
         self.scheduler = Scheduler(
             self.block_manager,
             SchedulerConfig(max_num_seqs=self.cfg.max_num_seqs,
@@ -537,6 +612,10 @@ class LLMEngine:
                                 else self.cfg.max_batched_tokens),
                             chunked_prefill=self.cfg.chunked_prefill),
             swap_mode=self.cfg.swap_mode, kv_swapper=self._swapper)
+        if self._kvtier is not None:
+            # demote-before-preempt: every scheduler OOM path tries
+            # this before evicting a batch peer
+            self.scheduler.tier_relief = self._kvtier.relief
         self.admission = AdmissionController(
             max_queue_depth=self.cfg.max_queue_depth,
             ttft_slo_ms=self.cfg.ttft_slo_ms)
@@ -575,6 +654,24 @@ class LLMEngine:
             self._host_v = np.zeros(hshape, np.dtype(cache_dtype))
         else:
             self._host_k = self._host_v = None
+        # tiered mode keeps a DEVICE mirror of the host tier — (L, NHB,
+        # BS, KH, D), same sharding as the caches — updated
+        # incrementally at each demote, so the compiled step attends
+        # host-tier blocks through one in-graph concat without a
+        # per-step full-pool upload. The numpy pool above stays the
+        # swap/wire source of truth.
+        if self._tiered:
+            tshape = (mcfg.num_hidden_layers, self.cfg.num_host_blocks,
+                      self.cfg.block_size, kh, hd)
+            self._htk = jnp.zeros(tshape, cache_dtype)
+            self._htv = jnp.zeros(tshape, cache_dtype)
+            if tp > 1:
+                self._htk = jax.device_put(self._htk,
+                                           self._cache_sharding)
+                self._htv = jax.device_put(self._htv,
+                                           self._cache_sharding)
+        else:
+            self._htk = self._htv = None
 
         # -- compiled prefill/decode step -------------------------------
         from paddle_tpu.jit.trace import functionalize
@@ -671,8 +768,37 @@ class LLMEngine:
                     lg3, sdraft, sndraft, skeys, stemp, stopk, stopp)
                 return packed, finite, k2, v2
 
+            def raw_step_ragged_tiered(param_datas, buffer_datas, key,
+                                       ids, kcs, vcs, hk, hv, bt, cu,
+                                       ctx, nseq, skeys, stemp, stopk,
+                                       stopp, sdraft, sndraft):
+                # tiered attention: concat the host-tier mirror onto
+                # the blocks axis INSIDE the jit, so a VIRTUAL table
+                # entry (>= num_blocks) indexes straight into host-tier
+                # content. Writes all land below the demotion frontier
+                # guard, so slicing the cache outputs back to the
+                # device region is bit-exact — host-tier blocks are
+                # read-only to the step.
+                nb = kcs.shape[1]
+                kall = jnp.concatenate([kcs, hk], axis=1)
+                vall = jnp.concatenate([vcs, hv], axis=1)
+                if goff is None:
+                    (logits, k2, v2), _ = apply_r(
+                        param_datas, buffer_datas, key, ids, kall, vall,
+                        bt, cu, ctx, nseq)
+                    lg3 = logits[:, None, :]
+                else:
+                    (lg3, k2, v2), _ = apply_r(
+                        param_datas, buffer_datas, key, ids, kall, vall,
+                        bt, cu, ctx, nseq, goff)
+                packed, finite = pack_sampled(
+                    lg3, sdraft, sndraft, skeys, stemp, stopk, stopp)
+                return packed, finite, k2[:, :nb], v2[:, :nb]
+
             self._jstep_ragged = jax.jit(
-                raw_step_ragged, donate_argnums=(4, 5) if donate else (),
+                raw_step_ragged_tiered if self._tiered
+                else raw_step_ragged,
+                donate_argnums=(4, 5) if donate else (),
                 out_shardings=step_outs)
         else:
             self._jstep_ragged = None
@@ -786,12 +912,14 @@ class LLMEngine:
                 f"request {request_id!r}: prompt ({len(prompt_ids)}) + "
                 f"max_new_tokens ({sampling.max_new_tokens}) = {total} "
                 f"exceeds max_model_len {self.cfg.max_model_len}")
-        if cdiv(total, self.cfg.block_size) > self.cfg.num_blocks:
+        if cdiv(total, self.cfg.block_size) > \
+                self.block_manager.reachable_blocks:
             raise ValueError(
                 f"request {request_id!r} needs "
                 f"{cdiv(total, self.cfg.block_size)} KV blocks at full "
-                f"length but the cache only has {self.cfg.num_blocks} — "
-                f"it could never be served even alone")
+                f"length but only "
+                f"{self.block_manager.reachable_blocks} are reachable "
+                f"across tiers — it could never be served even alone")
         req = Request(request_id=request_id, prompt_ids=prompt_ids,
                       sampling=sampling, callback=callback)
         self._apply_rng_state(req, rng_state)
@@ -1159,6 +1287,102 @@ class LLMEngine:
         self.num_prefix_imports += 1
         return covered
 
+    # -- tiered sessions (park / resume) ----------------------------------
+    def _require_tiers(self):
+        if self._kvtier is None:
+            raise ValueError(
+                "kv_tiers is off — build the engine with "
+                "EngineConfig(kv_tiers=True) for session park/resume")
+        return self._kvtier
+
+    def park_session(self, session_id: str) -> Optional[dict]:
+        """Demote a finished request's captured session chain to the
+        host tier (multi-turn park: the KV leaves HBM but stays
+        trie-discoverable for the next turn). Returns the session
+        summary, or None for an unknown/expired session. Idempotent."""
+        return self._require_tiers().park(session_id)
+
+    def resume_session(self, request_id: str, session_id: str,
+                       prompt_ids: Sequence[int],
+                       sampling: Optional[SamplingParams] = None,
+                       callback: Optional[Callable] = None, *,
+                       rng_state=None) -> int:
+        """Admit a new request continuing a parked session: the new
+        prompt must extend the session's token chain, whose cached KV
+        (either tier) is re-shared — zero prompt recompute on a full
+        hit. Returns the token count actually reused; 0 means the chain
+        was evicted since parking and the request admitted cold (the
+        ladder's recompute floor — never loss, never duplication).
+        Clean rejections raise ``ValueError`` (unknown session,
+        non-extending prompt, draining, duplicate id); the session
+        record is only consumed on success."""
+        kvt = self._require_tiers()
+        if self._draining:
+            raise ValueError("engine is draining")
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id!r}")
+        sampling = sampling or SamplingParams()
+        prompt_ids = [int(t) for t in prompt_ids]
+        total = len(prompt_ids) + sampling.max_new_tokens
+        if total > self.cfg.max_model_len:
+            raise ValueError(
+                f"request {request_id!r}: prompt ({len(prompt_ids)}) + "
+                f"max_new_tokens ({sampling.max_new_tokens}) = {total} "
+                f"exceeds max_model_len {self.cfg.max_model_len}")
+        if cdiv(total, self.cfg.block_size) > \
+                self.block_manager.reachable_blocks:
+            raise ValueError(
+                f"request {request_id!r} needs "
+                f"{cdiv(total, self.cfg.block_size)} KV blocks at full "
+                f"length but only "
+                f"{self.block_manager.reachable_blocks} are reachable "
+                f"across tiers — it could never be served even alone")
+        rec, hit = kvt.claim_resume(session_id, request_id, prompt_ids)
+        req = Request(request_id=request_id, prompt_ids=prompt_ids,
+                      sampling=sampling, callback=callback)
+        self._apply_rng_state(req, rng_state)
+        self._requests[request_id] = req
+        if hit > 0:
+            req.num_cached = hit
+            self.scheduler.add_continuation(req)
+        else:
+            self.scheduler.add(req)
+        return hit
+
+    def drop_session(self, session_id: str, *,
+                     to_peer: bool = False) -> bool:
+        """Forget a captured session; ``to_peer=True`` additionally
+        evicts its local chain (offload hand-off: the peer's copy is
+        authoritative). True when the session existed."""
+        if self._kvtier is None:
+            return False
+        return self._kvtier.drop(session_id, to_peer=to_peer)
+
+    def adopt_session(self, session_id: str, tokens: Sequence[int],
+                      covered: int, *,
+                      tenant: Optional[str] = None) -> bool:
+        """Register a session whose chain a router offload just shipped
+        into this engine's cache (the prefix import landed the blocks;
+        this names them resumable). False when the shipped chain does
+        not match the local trie — the adopter stays cold, harmlessly."""
+        if self._kvtier is None:
+            return False
+        return self._kvtier.adopt(session_id, tokens, covered,
+                                  tenant=tenant)
+
+    def session_info(self, session_id: str) -> Optional[dict]:
+        if self._kvtier is None:
+            return None
+        rec = self._kvtier.sessions.get(session_id)
+        return None if rec is None else rec.summary()
+
+    def tier_stats(self) -> Optional[dict]:
+        """Host-tier occupancy/pressure + migration counters; None when
+        tiering is off (the fleet router's offload watermark input)."""
+        if self._kvtier is None:
+            return None
+        return self._kvtier.stats()
+
     def _count_finish(self, reason: Optional[str]):
         if reason is not None:
             self.finish_counts[reason] = \
@@ -1338,6 +1562,10 @@ class LLMEngine:
 
         if self._spec is not None:
             self._propose_drafts()
+        if self._kvtier is not None:
+            # pressure-driven rebalancing BEFORE scheduling, so the
+            # scheduler sees the post-demotion free list
+            self._kvtier.balance()
         t0 = time.perf_counter()
         batch = self.scheduler.schedule()
         outputs.extend(self._terminal_output(r) for r in batch.expired)
@@ -1402,8 +1630,11 @@ class LLMEngine:
             arrays = (ids, bt, enc, dec, now)
             padded = B * S - int(sum(n_run))
 
-        # pending copy-on-write block copies (prefix-cache divergence)
-        # must land before the step writes the destination blocks
+        # pending tier moves land FIRST (a COW source may be a block a
+        # promote just filled), then copy-on-write block copies — both
+        # before the step writes the destination blocks
+        if self._kvtier is not None:
+            self._kvtier.apply_moves()
         self._apply_cow()
         # per-slot sampling state for the in-graph sampler: RNG keys,
         # params, and (ragged only) the draft rows under verification
@@ -1527,6 +1758,12 @@ class LLMEngine:
             # emitted-step count (chunking- and hand-off-invariant)
             r.device_key = keys_np[i].copy()
             if finished:
+                if self._kvtier is not None:
+                    # session capture BEFORE the table frees: the full
+                    # chain commits to the trie and the partial tail's
+                    # bytes stash host-side, so a multi-turn follow-up
+                    # resumes with zero prompt recompute
+                    self._kvtier.on_finish(r)
                 self.scheduler.finish(r)
                 self.metrics.record_finish(r)
                 self._count_finish(r.finish_reason)
@@ -1626,7 +1863,14 @@ class LLMEngine:
                     eid = self._watchdog.arm(
                         tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
                 faults.fire("serving.step")  # slow/raise/sigterm point
-                if self._ragged:
+                if self._ragged and self._kvtier is not None:
+                    packed, finite, kcs, vcs = self._jstep_ragged(
+                        [p._data for p in self._params],
+                        [b._data for b in self._buffers],
+                        self._key, ids, self._kcs, self._vcs,
+                        self._htk, self._htv, bt, cu, ctx, nseq,
+                        *sampling_arrays)
+                elif self._ragged:
                     packed, finite, kcs, vcs = self._jstep_ragged(
                         [p._data for p in self._params],
                         [b._data for b in self._buffers],

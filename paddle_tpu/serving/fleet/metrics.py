@@ -39,7 +39,10 @@ class FleetMetrics:
               "kv_snapshot_skipped", "tickets_issued",
               "peer_ship_requests", "peer_ship_blocks",
               "peer_ship_bytes", "relay_fallbacks", "relay_bytes",
-              "ship_skipped_expired", "router_failovers",
+              "ship_skipped_expired", "session_parks",
+              "session_resumes", "session_resume_recomputes",
+              "session_hit_tokens", "session_offloads",
+              "sessions_tracked", "router_failovers",
               "requests_fenced", "requests_handed_over",
               "leases_acquired", "leases_completed",
               "leases_adopted", "leases_expired", "leases_active")
@@ -84,6 +87,16 @@ class FleetMetrics:
         "relay_fallbacks": lambda r: r.num_relay_fallbacks,
         "relay_bytes": lambda r: r.num_relay_bytes,
         "ship_skipped_expired": lambda r: r.num_ship_skipped_expired,
+        # tiered-KV sessions: fleet-level park/resume/offload view
+        # (the per-engine serving_kv_tier_* gauges keep the device/
+        # host-pool occupancy side)
+        "session_parks": lambda r: r.num_session_parks,
+        "session_resumes": lambda r: r.num_session_resumes,
+        "session_resume_recomputes":
+            lambda r: r.num_session_resume_recomputes,
+        "session_hit_tokens": lambda r: r.num_session_hit_tokens,
+        "session_offloads": lambda r: r.num_session_offloads,
+        "sessions_tracked": lambda r: len(r._sessions),
         # drain KV snapshots dropped at the frame cap, summed over
         # worker-backed handles (the PR 12 silent-skip, now counted)
         "kv_snapshot_skipped": lambda r: sum(

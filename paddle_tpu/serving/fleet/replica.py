@@ -179,6 +179,42 @@ class ReplicaHandle:
         refused."""
         return False
 
+    # -- tiered-KV sessions (optional capability; default: none) ----------
+    def park_session(self, session_id: str) -> Optional[dict]:
+        """Demote a finished session's cached KV chain to the host tier
+        so the device pool frees up while the session stays resumable.
+        Returns the session summary dict, or None when unsupported or
+        the session is unknown (the router then treats it as cold)."""
+        return None
+
+    def resume_session(self, request_id: str, session_id: str,
+                       prompt_ids: Sequence[int],
+                       sampling: SamplingParams, *,
+                       rng_state=None) -> Optional[int]:
+        """Resume a parked session as a continuation request; returns
+        the number of prompt tokens served from the session's cached
+        chain, or None on any clean refusal (unknown session, prompt
+        mismatch, draining) — the router falls back to a plain add."""
+        return None
+
+    def drop_session(self, session_id: str, *,
+                     to_peer: bool = False) -> bool:
+        """Forget a session record; ``to_peer=True`` also evicts its
+        cached chain locally (the bytes now live on a peer)."""
+        return False
+
+    def adopt_session(self, session_id: str, tokens: Sequence[int],
+                      covered: int, *, tenant: Optional[str] = None) -> bool:
+        """Register a session record against prefix content that
+        arrived over the peer plane; False when the content is not
+        actually cached here (the adopt is dropped, resume recomputes)."""
+        return False
+
+    def tier_stats(self) -> Optional[dict]:
+        """Tier occupancy/pressure snapshot, or None when the replica
+        has no tiered KV store."""
+        return None
+
     # -- fleet prefix cache (optional capability; default: none) ----------
     def prefix_digest(self) -> Optional[dict]:
         """Bounded advertisement of the replica's committed prefix trie
@@ -401,6 +437,55 @@ class InProcessReplica(ReplicaHandle):
         return self.import_kv(request_id, list(prompt_ids or []),
                               sampling, meta=meta, payload=payload,
                               rng_state=rng_state)
+
+    # -- tiered-KV sessions ------------------------------------------------
+    def park_session(self, session_id: str) -> Optional[dict]:
+        if not self.alive:
+            return None
+        try:
+            return self.engine.park_session(session_id)
+        except ValueError:
+            return None  # engine is not tiered
+
+    def resume_session(self, request_id: str, session_id: str,
+                       prompt_ids: Sequence[int],
+                       sampling: SamplingParams, *,
+                       rng_state=None) -> Optional[int]:
+        if not self.alive:
+            return None
+        try:
+            return self.engine.resume_session(
+                request_id, session_id, list(prompt_ids),
+                sampling=sampling, rng_state=rng_state)
+        except ValueError:
+            return None
+
+    def drop_session(self, session_id: str, *,
+                     to_peer: bool = False) -> bool:
+        if not self.alive:
+            return False
+        try:
+            return self.engine.drop_session(session_id, to_peer=to_peer)
+        except ValueError:
+            return False
+
+    def adopt_session(self, session_id: str, tokens: Sequence[int],
+                      covered: int, *, tenant: Optional[str] = None) -> bool:
+        if not self.alive:
+            return False
+        try:
+            return self.engine.adopt_session(session_id, list(tokens),
+                                             covered, tenant=tenant)
+        except ValueError:
+            return False
+
+    def tier_stats(self) -> Optional[dict]:
+        if not self.alive:
+            return None
+        try:
+            return self.engine.tier_stats()
+        except ValueError:
+            return None
 
     # -- fleet prefix cache ------------------------------------------------
     def prefix_digest(self) -> Optional[dict]:

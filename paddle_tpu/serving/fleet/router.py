@@ -175,6 +175,13 @@ class FleetConfig:
     # for the relay and recompute rungs below)
     peer_data_plane: bool = True
     peer_deadline_s: float = 30.0
+    # tiered KV: when set, a holder whose host tier is past this
+    # pressure fraction offloads one parked session per router step to
+    # the least-pressured peer over the prefix ticket ladder (peer-push
+    # → router-relay → stay-home), flipping the session record to the
+    # adopter. None = parked sessions stay on their holder (single-node
+    # tiering still works; a dead holder degrades resume to recompute)
+    tier_offload_watermark: Optional[float] = None
     # replicated control plane: liveness TTL for ROUTER records (prefix
     # "fleet_routers" in the shared store) and for request leases. The
     # router TTL must be well under registry_ttl_s: replica ownership
@@ -200,6 +207,10 @@ class FleetConfig:
             raise ValueError("max_prefix_ships_per_step must be >= 0")
         if self.prefix_decay_s <= 0:
             raise ValueError("prefix_decay_s must be > 0")
+        if self.tier_offload_watermark is not None and not (
+                0.0 < self.tier_offload_watermark <= 1.0):
+            raise ValueError(
+                "tier_offload_watermark must be in (0, 1]")
         if self.roles:
             bad = {r for r in self.roles.values()
                    if r not in ("prefill", "decode")}
@@ -244,6 +255,11 @@ class _FleetRequest:
     # the prefill side would re-ship and a permanently failing ship
     # would bounce forever
     decode_bound: bool = False
+    # tiered-KV resume: the parked session this request continues —
+    # dispatch prefers the replica holding the session's KV and admits
+    # through ``resume_session`` (zero prompt recompute); a dead holder
+    # or evicted chain degrades to a plain re-prefilling dispatch
+    session: Optional[str] = None
     replica_id: Optional[str] = None
     dispatch_t: Optional[float] = None
     dispatches: int = 0
@@ -348,6 +364,15 @@ class FleetRouter:
         self.num_prefix_ships = 0
         self.num_prefix_ship_bytes = 0
         self.num_prefix_ship_failures = 0
+        # tiered-KV sessions: router-side view of parked sessions
+        # (session_id -> holder/tokens/covered/chain_hash/tenant) —
+        # drives resume affinity and the pressure-offload sweep
+        self._sessions: Dict[str, dict] = {}
+        self.num_session_parks = 0
+        self.num_session_resumes = 0
+        self.num_session_resume_recomputes = 0
+        self.num_session_hit_tokens = 0
+        self.num_session_offloads = 0
         # client-visible terminal histogram (the fleet-level aggregate:
         # per-replica engines keep their own serving/finish/* view,
         # which double-counts handed-off attempts by design)
@@ -400,6 +425,11 @@ class FleetRouter:
             self.num_replicas_dead += 1
         handle.alive = False
         self.registry.deregister(replica_id)
+        # sessions parked on the corpse are gone with it: resumes for
+        # them degrade to recompute instead of chasing a dead holder
+        for sid in [s for s, rec in self._sessions.items()
+                    if rec.get("holder") == replica_id]:
+            self._sessions.pop(sid, None)
         frs = sorted((self._open[rid] for rid in stranded
                       if rid in self._open), key=lambda fr: fr.arrival)
         self._assigned[replica_id] = set()
@@ -523,6 +553,103 @@ class FleetRouter:
     def has_unfinished(self) -> bool:
         return bool(self._open) or bool(self._pending_outputs)
 
+    # -- tiered-KV sessions (park / resume) -------------------------------
+    def park_session(self, session_id: str) -> Optional[dict]:
+        """Park a finished request's KV chain fleet-wide: the holding
+        replica demotes it to its host tier (the engine captured the
+        session at finish, so this works after the terminal output and
+        after ``release_request``). Returns the holder's summary dict,
+        or None when no live replica knows the session. Idempotent."""
+        rec = self._sessions.get(session_id)
+        tokens = rec.get("tokens") if rec else None
+        holders: List[ReplicaHandle] = []
+        if rec is not None:
+            h = self._by_id(rec["holder"])
+            if h is not None:
+                holders.append(h)
+        fr = self._requests.get(session_id)
+        if not holders and fr is not None and fr.replica_id is not None:
+            h = self._by_id(fr.replica_id)
+            if h is not None:
+                holders.append(h)
+            tokens = list(fr.prompt_ids) + list(fr.progress)
+        if not holders:
+            holders = list(self.replicas)  # released: probe the fleet
+        for h in holders:
+            if not h.alive:
+                continue
+            info = h.park_session(session_id)
+            if info is None:
+                continue
+            if session_id not in self._sessions:
+                self.num_session_parks += 1
+            self._sessions[session_id] = {
+                "holder": h.replica_id, "tokens": tokens,
+                "covered": int(info.get("tokens_covered", 0)),
+                "chain_hash": info.get("chain_hash"),
+                "tenant": info.get("tenant")}
+            return info
+        return None
+
+    def resume_session(self, session_id: str,
+                       prompt_ids: Sequence[int],
+                       sampling: Optional[SamplingParams] = None,
+                       callback: Optional[Callable] = None,
+                       request_id: Optional[str] = None) -> str:
+        """Admit a continuation of a parked (or just-finished) session.
+        The new prompt must extend the session's token chain; dispatch
+        then prefers the replica holding the chain's KV, which resumes
+        with ZERO prompt tokens recomputed. A dead holder or an evicted
+        chain degrades to a plain re-prefilling dispatch — counted, not
+        an error. Tenant fairness (DRR queue) and request leases apply
+        exactly as for :meth:`add_request`."""
+        if request_id is None:
+            request_id = f"fleet-{next(self._auto_id)}"
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id!r}")
+        if session_id not in self._sessions:
+            # un-parked fast path: a just-finished request's session
+            # still lives device-side on the replica that ran it
+            src = self._requests.get(session_id)
+            if src is not None and src.replica_id is not None:
+                self._sessions[session_id] = {
+                    "holder": src.replica_id,
+                    "tokens": (list(src.prompt_ids)
+                               + list(src.progress)),
+                    "covered": 0, "chain_hash": None,
+                    "tenant": src.tenant}
+        sampling = sampling or SamplingParams()
+        prompt = [int(t) for t in prompt_ids]
+        now = time.monotonic()
+        fr = _FleetRequest(
+            request_id=request_id, prompt_ids=prompt, sampling=sampling,
+            callback=callback, arrival=now,
+            deadline_abs=(None if sampling.deadline_ms is None
+                          else now + sampling.deadline_ms / 1e3),
+            tenant=sampling.tenant_id,
+            cost=len(prompt) + sampling.max_new_tokens,
+            session=session_id)
+        self._requests[request_id] = fr
+        self._open[request_id] = fr
+        live = self._own_dispatchable()
+        if self.lease_store is not None and not live:
+            live = self.dispatchable()
+        verdicts = [h.admission_verdict(len(prompt)) for h in live]
+        if not live or all(v is not None for v in verdicts):
+            self.num_rejected_fleetwide += 1
+            self._finalize(fr, "rejected", None, self._pending_outputs)
+            return request_id
+        self._queue.push(fr.tenant, request_id, fr.cost)
+        return request_id
+
+    def session_info(self, session_id: str) -> Optional[dict]:
+        rec = self._sessions.get(session_id)
+        return None if rec is None else {
+            "holder": rec.get("holder"),
+            "tokens_covered": int(rec.get("covered", 0)),
+            "chain_hash": rec.get("chain_hash"),
+            "tenant": rec.get("tenant")}
+
     # -- one router iteration --------------------------------------------
     def step(self) -> List[RequestOutput]:
         """Pump faults, heartbeats, health, dispatch, then one engine
@@ -547,6 +674,7 @@ class FleetRouter:
         self._health_sweep(outputs)
         self._dispatch_queue(outputs)
         self._ship_hot_prefixes()
+        self._offload_pressured_sessions()
         for h in list(self.replicas):
             if not h.alive:
                 continue
@@ -1050,15 +1178,28 @@ class FleetRouter:
                 # overtake a starved tenant)
                 self._queue.unpop(tenant, rid, cost)
                 return
-            handle = self._pick(self._role_candidates(cands, fr),
-                                prompt)
+            handle = None
+            if fr.session is not None:
+                rec = self._sessions.get(fr.session)
+                holder = self._by_id(rec["holder"]) if rec else None
+                if holder is not None and holder in cands:
+                    # session affinity beats TTFT scoring: the holder
+                    # resumes with zero prompt recompute, which no
+                    # estimate can price
+                    handle = holder
+            if handle is None:
+                handle = self._pick(self._role_candidates(cands, fr),
+                                    prompt)
             if (self.lease_store is not None
                     and not self._lease_for_dispatch(fr, handle)):
                 # fenced or foreign-owned: the local copy was dropped
                 # (nothing emitted) — move on to the next queued item
                 continue
             shipped = False
-            if fr.kv is not None:
+            if fr.session is not None:
+                shipped = self._resume_session_on(fr, handle, prompt,
+                                                  now)
+            elif fr.kv is not None:
                 meta, payload = fr.kv
                 t0 = time.monotonic()
                 shipped = handle.import_kv(
@@ -1329,6 +1470,120 @@ class FleetRouter:
                     # a ticketed prefix ship has no recompute rung —
                     # the destination just stays cold
                     self.ticket_outcomes["cold"] += 1
+
+    def _resume_session_on(self, fr: _FleetRequest,
+                           handle: ReplicaHandle, prompt: List[int],
+                           now: float) -> bool:
+        """One resume attempt against the picked replica. The session
+        is consumed either way — a refused resume (holder lost the
+        chain, prompt diverged, replica died) falls back to a plain
+        re-prefilling add and the park is spent. Returns True when the
+        replica admitted the continuation itself (including the
+        hit==0 recompute floor, where the engine admits cold)."""
+        sid, fr.session = fr.session, None
+        rec = self._sessions.pop(sid, None)
+        if rec is not None and rec.get("holder") == handle.replica_id:
+            hit = handle.resume_session(
+                fr.request_id, sid, prompt,
+                self._effective_sampling(fr, now),
+                rng_state=fr.rng_state)
+            if hit is not None:
+                if hit > 0:
+                    self.num_session_resumes += 1
+                    self.num_session_hit_tokens += int(hit)
+                else:
+                    # chain evicted under the park: the engine admitted
+                    # the request cold — the ladder's recompute floor
+                    self.num_session_resume_recomputes += 1
+                return True
+        if rec is not None:
+            holder = self._by_id(rec.get("holder"))
+            if holder is not None and holder.alive:
+                holder.drop_session(sid)  # spent park: no record leak
+        self.num_session_resume_recomputes += 1
+        return False
+
+    def _offload_pressured_sessions(self) -> None:
+        """Past ``tier_offload_watermark``, move ONE parked session per
+        step from its pressured holder to the least-pressured peer:
+        ship the chain over the prefix ticket ladder (peer-push →
+        router-relay → stay-home, exactly one counted outcome per
+        issued ticket), have the peer adopt the session record, then
+        evict the holder's copy (``drop_session(to_peer=True)`` — the
+        adopter is now authoritative). Every failure leaves the session
+        untouched on its holder."""
+        wm = self.cfg.tier_offload_watermark
+        if wm is None or not self._sessions:
+            return
+        live = self._own_dispatchable()
+        if len(live) < 2:
+            return
+        stats = {h.replica_id: h.tier_stats() for h in live}
+        for sid, rec in list(self._sessions.items()):
+            ch = rec.get("chain_hash")
+            tokens = rec.get("tokens")
+            if not ch or not tokens:
+                continue  # no committed full block / unknown chain
+            src = self._by_id(rec.get("holder"))
+            st = stats.get(rec.get("holder"))
+            if src is None or not src.alive or not st:
+                continue
+            if st.get("pressure", 0.0) < wm:
+                continue
+            cold = [h for h in live
+                    if h.replica_id != src.replica_id
+                    and stats.get(h.replica_id)
+                    and stats[h.replica_id].get("pressure", 1.0) < wm]
+            if not cold:
+                continue
+            dst = min(cold, key=lambda h: (
+                stats[h.replica_id].get("pressure", 1.0),
+                h.replica_id))
+            if not self._ship_session_chain(src, dst, ch):
+                continue
+            if not dst.adopt_session(sid, tokens,
+                                     int(rec.get("covered", 0)),
+                                     tenant=rec.get("tenant")):
+                continue  # adopt refused: dst just keeps a warm prefix
+            src.drop_session(sid, to_peer=True)
+            rec["holder"] = dst.replica_id
+            self.num_session_offloads += 1
+            return  # one per step: policy never starves serving
+
+    def _ship_session_chain(self, src: ReplicaHandle,
+                            dst: ReplicaHandle, ch: str) -> bool:
+        """Move one session's cached chain ``src`` → ``dst`` down the
+        prefix ladder: peer-push first (payload never touches the
+        router), router-relay as fallback, stay-home as the harmless
+        floor. Same per-ticket outcome partition as every other
+        ticketed transfer."""
+        ok = False
+        ticket = None
+        if (self.cfg.peer_data_plane
+                and getattr(dst, "peer_endpoint", None)):
+            ticket = self._issue_ticket(
+                src, dst, "prefix", ch, self.cfg.peer_deadline_s * 1e3)
+            receipt = src.peer_send(ticket, dst.peer_endpoint)
+            if receipt is not None and dst.peer_commit(
+                    ticket["ticket_id"], kind="prefix"):
+                self.num_peer_ship_bytes += int(receipt.get("bytes", 0))
+                self.ticket_outcomes["peer"] += 1
+                ok = True
+        if not ok:
+            kv = self._export_prefix_guarded(src, ch)
+            if kv is not None:
+                meta, payload = kv
+                ok = bool(dst.import_prefix(meta=meta, payload=payload))
+                if ok:
+                    self.num_relay_bytes += len(payload)
+                    if ticket is not None:
+                        self.num_relay_fallbacks += 1
+                        self.ticket_outcomes["relay"] += 1
+        if not ok and ticket is not None:
+            # a ticketed session ship has no recompute rung — the
+            # session simply stays on its holder
+            self.ticket_outcomes["cold"] += 1
+        return ok
 
     def _effective_sampling(self, fr: _FleetRequest,
                             now: float) -> SamplingParams:

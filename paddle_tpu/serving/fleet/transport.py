@@ -936,6 +936,23 @@ class ReplicaServicer:
                 prompt_ids=[int(t) for t in p.get("prompt_ids") or []],
                 sampling=SamplingParams(**sp) if sp else None,
                 rng_state=p.get("rng_state")))
+        if method == "park_session":
+            return r.park_session(p["session_id"])
+        if method == "resume_session":
+            return r.resume_session(
+                p["request_id"], p["session_id"],
+                [int(t) for t in p["prompt_ids"]],
+                SamplingParams(**p["sampling"]),
+                rng_state=p.get("rng_state"))
+        if method == "drop_session":
+            return bool(r.drop_session(p["session_id"],
+                                       to_peer=bool(p.get("to_peer"))))
+        if method == "adopt_session":
+            return bool(r.adopt_session(
+                p["session_id"], [int(t) for t in p["tokens"]],
+                int(p["covered"]), tenant=p.get("tenant")))
+        if method == "tier_stats":
+            return r.tier_stats()
         if method == "shutdown":
             return True
         raise RpcError(f"unknown method {method!r}")
@@ -1233,6 +1250,61 @@ class SubprocessReplica(ReplicaHandle):
             return bool(self._mutate("peer_commit", params))
         except (ValueError, KeyError):
             return False
+
+    # -- tiered-KV sessions ------------------------------------------------
+    def park_session(self, session_id: str) -> Optional[dict]:
+        """Mutation semantics (the demotion moves replica-side state);
+        a clean remote refusal returns None with the replica alive."""
+        if not self.alive:
+            return None
+        try:
+            res = self._mutate("park_session", {"session_id": session_id})
+        except (ValueError, KeyError):
+            return None
+        return res if isinstance(res, dict) else None
+
+    def resume_session(self, request_id: str, session_id: str,
+                       prompt_ids: Sequence[int],
+                       sampling: SamplingParams, *,
+                       rng_state=None) -> Optional[int]:
+        if not self.alive:
+            return None
+        try:
+            res = self._mutate("resume_session", {
+                "request_id": request_id, "session_id": session_id,
+                "prompt_ids": [int(t) for t in prompt_ids],
+                "sampling": dataclasses.asdict(sampling),
+                "rng_state": rng_state})
+        except (ValueError, KeyError):
+            return None
+        return int(res) if res is not None else None
+
+    def drop_session(self, session_id: str, *,
+                     to_peer: bool = False) -> bool:
+        if not self.alive:
+            return False
+        try:
+            return bool(self._mutate("drop_session",
+                                     {"session_id": session_id,
+                                      "to_peer": bool(to_peer)}))
+        except (ValueError, KeyError):
+            return False
+
+    def adopt_session(self, session_id: str, tokens: Sequence[int],
+                      covered: int, *, tenant: Optional[str] = None) -> bool:
+        if not self.alive:
+            return False
+        try:
+            return bool(self._mutate("adopt_session", {
+                "session_id": session_id,
+                "tokens": [int(t) for t in tokens],
+                "covered": int(covered), "tenant": tenant}))
+        except (ValueError, KeyError):
+            return False
+
+    def tier_stats(self) -> Optional[dict]:
+        res = self._query("tier_stats")
+        return res if isinstance(res, dict) else None
 
     # -- fleet prefix cache ------------------------------------------------
     def prefix_digest(self) -> Optional[dict]:

@@ -1,0 +1,18 @@
+"""Tiered KV subsystem: device HBM -> host RAM -> remote peer.
+
+One :class:`TieredKVStore` per engine unifies the three tiers behind
+the BlockManager's virtual-block addressing (block_manager.py module
+docstring): table entries ``>= num_blocks`` name host-pool slots, the
+compiled ragged step attends them through an in-graph concat of the
+device and host pools, and the prefix trie is tier-blind — so demotion
+and promotion are pure byte moves plus an id rewrite, never a
+recompute. The peer tier is router-orchestrated: parked sessions whose
+holder's host pool passes the pressure watermark ship over the PR 14
+ticket plane to a peer's cache, with the classic degradation ladder
+(peer -> relay -> recompute) underneath every movement.
+"""
+from paddle_tpu.serving.kvtier.store import (
+    KVTiersConfig, SessionRecord, TieredKVStore,
+)
+
+__all__ = ["KVTiersConfig", "SessionRecord", "TieredKVStore"]

@@ -65,7 +65,13 @@ class ServingMetrics:
               # TP-sharded serving (ISSUE 17): shipped KV payloads that
               # landed through a cross-layout redistribute, and ship
               # continuations the mixed scheduler resumed mid-context
-              "kv_reshards", "continuation_resumes")
+              "kv_reshards", "continuation_resumes",
+              # tiered KV (ISSUE 19): cross-tier migration traffic,
+              # host/peer-tier occupancy, and parked-session resumes
+              # (all 0 on a non-tiered engine)
+              "kv_tier_demotes", "kv_tier_promotes",
+              "kv_tier_host_blocks_used", "kv_tier_peer_blocks_used",
+              "kv_tier_park_resumes")
 
     # per-terminal-reason histogram (ISSUE 8): every request's end state
     # lands in exactly one bucket — `serving/finish/<reason>` counters,
@@ -98,6 +104,18 @@ class ServingMetrics:
         "kv_reshards": lambda eng: eng.num_kv_reshards,
         "continuation_resumes":
             lambda eng: eng.scheduler.num_continuation_resumes,
+        # tiered-KV gauges read defensively: 0 on a non-tiered engine
+        "kv_tier_demotes": lambda eng: eng.block_manager.num_demotes,
+        "kv_tier_promotes": lambda eng: eng.block_manager.num_promotes,
+        "kv_tier_host_blocks_used": lambda eng: (
+            eng.block_manager.num_host_blocks_used
+            if getattr(eng, "_kvtier", None) is not None else 0),
+        "kv_tier_peer_blocks_used": lambda eng: (
+            eng._kvtier.peer_blocks
+            if getattr(eng, "_kvtier", None) is not None else 0),
+        "kv_tier_park_resumes": lambda eng: (
+            eng._kvtier.num_park_resumes
+            if getattr(eng, "_kvtier", None) is not None else 0),
     }
 
     def __init__(self, engine):

@@ -132,6 +132,13 @@ class Scheduler:
         # cross-TP-degree reshard — scheduling is layout-agnostic, so
         # this counter is the only place the scheduler sees them)
         self.num_continuation_resumes = 0
+        # tiered-KV relief hook (engine-installed): called with the
+        # OOM'ing request before any preemption; True means >= 1 device
+        # block was freed by demoting cold content to the host tier, so
+        # the claim retries instead of evicting a batch peer. Each True
+        # strictly grows the free list, so every retry loop below stays
+        # bounded.
+        self.tier_relief = None
 
     # -- queue ops -------------------------------------------------------
     def add(self, request: Request):
@@ -343,6 +350,9 @@ class Scheduler:
                     got_slot = True
                     break
                 except NoFreeBlocksError:
+                    if self.tier_relief is not None \
+                            and self.tier_relief(req):
+                        continue  # demoted cold content freed room
                     victim = self._preempt_one(req)
                     if victim is None:
                         break  # nothing left to evict but req itself
@@ -363,6 +373,36 @@ class Scheduler:
                                   swapped_in=swapped_in, expired=expired)
         return ScheduledBatch(kind="idle", preempted=preempted,
                               swapped_in=swapped_in, expired=expired)
+
+    def _claim_with_relief(self, req: Request, claim):
+        """Run a block claim, retrying after each successful tier-relief
+        demotion (tiered engines only; the claim raises BEFORE taking
+        anything, so a retry never double-claims). Re-raises the final
+        NoFreeBlocksError when relief is absent or dry."""
+        while True:
+            try:
+                return claim()
+            except NoFreeBlocksError:
+                if self.tier_relief is None or not self.tier_relief(req):
+                    raise
+
+    def _admit_with_relief(self, req: Request, n: int,
+                           claim) -> Optional[int]:
+        """Admission-time claim for an n-token chunk: ``claim(n)`` must
+        raise NoFreeBlocksError without taking anything. Tiered engines
+        additionally SHRINK the chunk when even relief cannot make the
+        whole thing fit the device pool — a request whose full context
+        exceeds device HBM admits with whatever fits and grows through
+        the mid-prefill pass, demoting its own cold prefix as it goes.
+        Returns the chunk size that fit, or None."""
+        while True:
+            try:
+                self._claim_with_relief(req, lambda: claim(n))
+                return n
+            except NoFreeBlocksError:
+                if self.tier_relief is None or n <= 1:
+                    return None
+                n = max(1, n // 2)
 
     # -- chunked-prefill mixed scheduling ---------------------------------
     def _schedule_mixed(self, expired: List[Request],
@@ -400,6 +440,9 @@ class Scheduler:
                                    write_from=write_from)
                     return True
                 except NoFreeBlocksError:
+                    if self.tier_relief is not None \
+                            and self.tier_relief(req):
+                        continue  # demoted cold content freed room
                     victim = self._preempt_one(req)
                     if victim is None:
                         self._evict(req)
@@ -473,11 +516,12 @@ class Scheduler:
                 # and filled at import, so admission is purely a seat +
                 # budget decision; growth past the imported coverage
                 # goes through the ordinary slot claim
-                n = min(total - req.num_cached, left)
-                try:
-                    bm.append_slot(req.request_id, req.num_cached + n,
-                                   write_from=req.num_cached)
-                except NoFreeBlocksError:
+                n = self._admit_with_relief(
+                    req, min(total - req.num_cached, left),
+                    lambda k: bm.append_slot(
+                        req.request_id, req.num_cached + k,
+                        write_from=req.num_cached))
+                if n is None:
                     break  # blocks free up as running requests finish
                 req.status = RequestStatus.RUNNING
                 self.num_continuation_resumes += 1
@@ -493,10 +537,11 @@ class Scheduler:
                 continue
             hit = bm.match_prefix(req.tokens)
             eff = min(hit, total - 1)
-            n = min(total - eff, left)
-            try:
-                bm.allocate(req.request_id, eff + n, tokens=req.tokens)
-            except NoFreeBlocksError:
+            n = self._admit_with_relief(
+                req, min(total - eff, left),
+                lambda k: bm.allocate(req.request_id, eff + k,
+                                      tokens=req.tokens))
+            if n is None:
                 break  # blocks free up as running requests finish
             req.num_cached = bm.last_hit_tokens
             req.status = RequestStatus.RUNNING

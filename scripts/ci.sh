@@ -43,6 +43,16 @@
 #                            fleet hit rate on the second replica
 #                            WITHOUT it ever prefilling the shared
 #                            header, and token parity; ~1 min)
+#   scripts/ci.sh --tiers    tiered KV smoke only (a request whose
+#                            context exceeds the device pool finishes
+#                            greedy+sampled token-identical via host-
+#                            tier demotion; park/resume re-prefills
+#                            ZERO prompt tokens counter-asserted; 3
+#                            subprocess workers offload a parked
+#                            session to a peer under the ticket ladder
+#                            and a real SIGKILL of the adopter
+#                            degrades the resume to a clean counted
+#                            recompute; ~2 min)
 #   scripts/ci.sh --tp       TP-sharded serving smoke only (forced
 #                            4-device host mesh; TP=2 token-identical
 #                            to TP=1 through preemption + prefix hits,
@@ -161,6 +171,19 @@ run_prefix() {
 
 if [[ "${1:-}" == "--prefix" ]]; then
     run_prefix
+    exit 0
+fi
+
+run_tiers() {
+    echo "== tiers smoke =="
+    # 600s: phase C spawns three worker processes that each build
+    # their own model before the first ping
+    timeout -k 10 600 env JAX_PLATFORMS=cpu PYTHONPATH=. \
+        python scripts/tiers_smoke.py
+}
+
+if [[ "${1:-}" == "--tiers" ]]; then
+    run_tiers
     exit 0
 fi
 

@@ -1,0 +1,221 @@
+"""Tiered KV smoke: device → host → peer hierarchy end to end.
+
+The ``scripts/ci.sh --tiers`` stage. Three phases, each pinned to the
+subsystem's core promise (demote instead of evict, promote instead of
+recompute, degrade instead of lose):
+
+A. **Over-pool serving** — a single request whose context NEEDS more
+   KV blocks than the device pool holds (8 device blocks = 32 tokens;
+   the request spans 52). The engine demotes cold blocks to the host
+   tier mid-flight and completes token-identical — greedy AND sampled
+   — to an unconstrained big-pool reference.
+B. **Park / resume** — a finished turn parks (chain demoted to host),
+   then a continuation prompt resumes it with ZERO prompt tokens
+   recomputed, counter-asserted (``num_resume_recomputed_tokens == 0``
+   and resume hit == tokens covered). Uses a 22-token prompt so the
+   partial-tail byte restore path is the one exercised.
+C. **Peer tier + SIGKILL** — 3 subprocess workers with tiered engines
+   behind a router whose ``tier_offload_watermark`` forces the parked
+   session off its pressured holder onto a cold peer over the ticketed
+   prefix ladder. The ADOPTER — the peer now holding the demoted
+   chain — takes a real ``SIGKILL`` mid-run; the resume degrades
+   cleanly to the recompute floor (counted, token-identical, no hang)
+   and every issued ticket lands in exactly one outcome bucket.
+
+Exit 0 on success; any broken invariant raises.
+"""
+import os
+import signal
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+from paddle_tpu.serving.fleet import (
+    FleetConfig, FleetRouter, ReplicaSupervisor, SupervisorConfig,
+    WorkerSpec,
+)
+
+_BASE = dict(block_size=4, max_num_seqs=8, max_model_len=96,
+             drain_grace_s=0.0)
+GREEDY = SamplingParams(max_new_tokens=8)
+SAMPLED = SamplingParams(max_new_tokens=8, temperature=0.8, top_k=20,
+                         seed=7)
+
+
+def _run(eng, max_steps=600):
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        assert steps < max_steps, "engine failed to converge"
+    if eng._kvtier is not None:
+        eng._kvtier.apply_moves()
+    eng.block_manager.check_invariants()
+
+
+def _reference(model, prompts_by_rid):
+    eng = LLMEngine(model, EngineConfig(num_blocks=256, **_BASE))
+    for rid, (prompt, sp) in prompts_by_rid.items():
+        eng.add_request(rid, prompt, sampling=sp)
+    _run(eng)
+    return {rid: list(eng.get_request(rid).generated)
+            for rid in prompts_by_rid}
+
+
+def phase_a_over_pool(model):
+    rng = np.random.default_rng(31)
+    prompt = [int(t) for t in rng.integers(0, 255, size=40)]
+    cases = {"big-g": (prompt, SamplingParams(max_new_tokens=12)),
+             "big-s": (prompt, SamplingParams(max_new_tokens=12,
+                                              temperature=0.8,
+                                              top_k=20, seed=7))}
+    ref = _reference(model, cases)
+    eng = LLMEngine(model, EngineConfig(
+        num_blocks=8, kv_tiers={"num_host_blocks": 24}, **_BASE))
+    need = (len(prompt) + 12 + eng.cfg.block_size - 1) \
+        // eng.cfg.block_size
+    assert need > 8, "scenario no longer exceeds the device pool"
+    for rid, (p, sp) in cases.items():
+        eng.add_request(rid, p, sampling=sp)
+        _run(eng)  # serially: each alone still exceeds the pool
+        assert list(eng.get_request(rid).generated) == ref[rid], (
+            "over-pool stream diverged from the unconstrained "
+            "reference", rid)
+    snap = eng.metrics.snapshot()
+    assert snap["serving_kv_tier_demotes"] > 0, snap
+    print("TIERS_A_OK need_blocks=%d device_blocks=8 demotes=%d "
+          "promotes=%d" % (need, snap["serving_kv_tier_demotes"],
+                           snap["serving_kv_tier_promotes"]),
+          flush=True)
+
+
+def phase_b_park_resume(model):
+    rng = np.random.default_rng(32)
+    # 22-token prompt -> covered % block_size != 0: the resume must
+    # restore the stashed partial-tail bytes, not just share full blocks
+    prompt = [int(t) for t in rng.integers(0, 255, size=22)]
+    eng = LLMEngine(model, EngineConfig(
+        num_blocks=16, kv_tiers=True, **_BASE))
+    eng.add_request("turn1", prompt, sampling=GREEDY)
+    _run(eng)
+    turn1 = list(eng.get_request("turn1").generated)
+    eng.release_request("turn1")
+    info = eng.park_session("turn1")
+    assert info is not None and info["parked"], info
+    prompt2 = prompt + turn1 + [int(t) for t in
+                                rng.integers(0, 255, size=5)]
+    hit = eng.resume_session("turn2", "turn1", prompt2,
+                             sampling=GREEDY)
+    assert hit == info["tokens_covered"], (hit, info)
+    _run(eng)
+    kvt = eng._kvtier
+    assert kvt.num_resume_recomputed_tokens == 0, \
+        kvt.num_resume_recomputed_tokens
+    snap = eng.metrics.snapshot()
+    assert snap["serving_kv_tier_park_resumes"] == 1, snap
+    ref = _reference(model, {"turn2": (prompt2, GREEDY)})
+    assert list(eng.get_request("turn2").generated) == ref["turn2"], \
+        "resumed stream diverged from the fresh-prefill reference"
+    print("TIERS_B_OK hit=%d recomputed=0 park_resumes=1"
+          % hit, flush=True)
+
+
+def phase_c_peer_kill(model):
+    engine = dict(num_blocks=16, kv_tiers={"num_host_blocks": 16},
+                  **_BASE)
+    sup = ReplicaSupervisor(
+        WorkerSpec(model="tiny_llama", seed=0, engine=engine,
+                   peer=True),
+        SupervisorConfig(
+            store_dir=tempfile.mkdtemp(prefix="tiers_smoke_hb_")))
+    try:
+        handles = [sup.spawn() for _ in range(3)]
+        for h in handles:
+            assert h.peer_endpoint, f"{h.replica_id} has no peer"
+        router = FleetRouter(
+            handles, FleetConfig(tier_offload_watermark=1e-6),
+            registry=sup.registry)
+        sup.router = router
+
+        rng = np.random.default_rng(33)
+        prompt = [int(t) for t in rng.integers(0, 255, size=21)]
+        rid = router.add_request("sess", prompt, sampling=GREEDY)
+        steps = 0
+        while router.has_unfinished():
+            router.step()
+            steps += 1
+            assert steps < 500, "router failed to converge (turn1)"
+        fr = router.get_request(rid)
+        turn1, holder = list(fr.generated), fr.replica_id
+        assert router.park_session(rid) is not None
+
+        # the sweep fires past the (absurdly low) watermark: the chain
+        # ships holder -> coldest peer over the ticket ladder and the
+        # peer adopts the session
+        router.step()
+        assert router.num_session_offloads == 1, \
+            router.num_session_offloads
+        adopter = router._sessions[rid]["holder"]
+        assert adopter != holder, "offload kept the session home"
+        assert sum(router.ticket_outcomes.values()) \
+            == router.num_tickets_issued, (router.ticket_outcomes,
+                                           router.num_tickets_issued)
+
+        # SIGKILL the peer now holding the demoted chain
+        victim = next(h for h in handles if h.replica_id == adopter)
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        victim.proc.wait(timeout=10)
+
+        prompt2 = prompt + turn1 + [9, 8, 7]
+        rid2 = router.resume_session(rid, prompt2, sampling=GREEDY)
+        steps = 0
+        while router.has_unfinished():
+            router.step()
+            steps += 1
+            assert steps < 500, "router failed to converge (resume)"
+        fr2 = router.get_request(rid2)
+        assert fr2.finish_reason in ("stop", "length"), \
+            fr2.finish_reason
+        assert fr2.replica_id != adopter
+        # the park was spent on the corpse: the resume degraded to the
+        # recompute floor — counted, not hung, not duplicated
+        assert router.num_session_resumes == 0, \
+            router.num_session_resumes
+        assert router.num_session_resume_recomputes == 1, \
+            router.num_session_resume_recomputes
+        ref = _reference(model, {rid2: (prompt2, GREEDY)})
+        assert list(fr2.generated) == ref[rid2], \
+            "post-kill recompute diverged from reference"
+        assert sum(router.ticket_outcomes.values()) \
+            == router.num_tickets_issued, (router.ticket_outcomes,
+                                           router.num_tickets_issued)
+        snap = router.snapshot()
+        assert snap["fleet_session_offloads"] == 1, snap
+        print("TIERS_C_OK offloads=1 adopter_killed=%s outcomes=%s "
+              "resume_recomputes=%d"
+              % (adopter, snap["fleet_ticket_outcomes"],
+                 snap["fleet_session_resume_recomputes"]),
+              flush=True)
+    finally:
+        sup.shutdown()
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    phase_a_over_pool(model)
+    phase_b_park_resume(model)
+    phase_c_peer_kill(model)
+    print("TIERS_SMOKE_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

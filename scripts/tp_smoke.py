@@ -6,6 +6,9 @@ pins the ISSUE-17 acceptance observables:
 * TP=2 ragged serving is token-identical to the TP=1 engine on a
   mixed greedy+sampled workload — through a forced-OOM preemption and
   prefix-cache hits — with zero attention-path padding;
+* the same workload under ``swap_mode='host'``: the OOM victim's KV
+  spills to host RAM as layout-sharded frames (``Layout.shard_frames``)
+  and restores on readmit bit-exactly at both degrees;
 * a KV ship from a TP=1 exporter into a TP=2 importer lands through
   ``redistribute`` (reshard counter + redistribute stats asserted)
   with ZERO prompt tokens recomputed (exactly the one mandatory
@@ -109,6 +112,41 @@ def parity_phase(model):
     print("TP_PARITY_OK reqs=%d preempt=%d prefix_hits=%d"
           % (len(out1), s2["preemptions"],
              s2["serving_prefix_cache_hits"]), flush=True)
+
+
+def host_swap_phase(model):
+    """Swap-based preemption at TP=2: the forced-OOM victim's KV
+    blocks spill to HOST memory as layout-sharded frames
+    (``Layout.shard_frames``) and restore on readmit — token parity
+    against the TP=1 host-swap engine proves the per-shard frame
+    round-trip reassembled bit-exactly."""
+    outs, snaps = {}, {}
+    for tp in (1, 2):
+        prompts, samplings = make_workload(model.config.vocab_size)
+        eng = LLMEngine(model, _ecfg(tp, swap_mode="host",
+                                     num_blocks=16))
+        rids = [eng.add_request(f"r{i}", p, sampling=sp)
+                for i, (p, sp) in enumerate(zip(prompts, samplings))]
+        faults.install("serving.force_oom.r0:flag*1")
+        try:
+            while eng.has_unfinished():
+                eng.step()
+                eng.block_manager.check_invariants()
+        finally:
+            faults.clear()
+        outs[tp] = {r: list(eng.get_request(r).generated)
+                    for r in rids}
+        snaps[tp] = eng.metrics.snapshot()
+    assert outs[1] == outs[2], \
+        "TP=2 host-swap diverged from TP=1:\n%r\n%r" % (outs[1],
+                                                        outs[2])
+    for tp, s in snaps.items():
+        assert s["serving_swapped_out"] >= 1 \
+                and s["serving_swapped_in"] >= 1, (tp, s)
+    print("TP_HOST_SWAP_OK swapped_out=%d swapped_in=%d"
+          % (snaps[2]["serving_swapped_out"],
+             snaps[2]["serving_swapped_in"]),
+          flush=True)
 
 
 def cross_degree_ship_phase(model):
@@ -280,6 +318,7 @@ def main():
     assert len(jax.devices()) >= 4, jax.devices()
     model = build_model()
     parity_phase(model)
+    host_swap_phase(model)
     cross_degree_ship_phase(model)
     fleet_handoff_phase(model, inject_fault=False)
     fleet_handoff_phase(model, inject_fault=True)
