@@ -254,10 +254,13 @@ class _Suppressions:
 
 
 def analyze_source(source: str, path: str = "<string>",
-                   disabled: Sequence[str] = ()) -> List[Finding]:
+                   disabled: Sequence[str] = (),
+                   keep_suppressed: bool = False) -> List[Finding]:
     """Run every registered rule over one module's source. Returns
     unsuppressed findings (plus ``bad-suppression`` meta findings),
-    sorted by position."""
+    sorted by position. With ``keep_suppressed`` the comment-suppressed
+    findings stay in the list, marked ``suppressed=True`` — the basis
+    for the CLI's per-rule suppression accounting."""
     rules = get_rules()
     known = set(rules) | set(META_RULES)
     try:
@@ -272,7 +275,11 @@ def analyze_source(source: str, path: str = "<string>",
         if name in disabled:
             continue
         findings.extend(rule.check(module))
-    findings = [f for f in findings if not sup.covers(f)]
+    if keep_suppressed:
+        for f in findings:
+            f.suppressed = sup.covers(f)
+    else:
+        findings = [f for f in findings if not sup.covers(f)]
     if "bad-suppression" not in disabled:
         findings.extend(sup.bad)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
@@ -297,7 +304,8 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def analyze_paths(paths: Sequence[str],
-                  disabled: Sequence[str] = ()) -> List[Finding]:
+                  disabled: Sequence[str] = (),
+                  keep_suppressed: bool = False) -> List[Finding]:
     """Analyze every ``.py`` under ``paths`` (files or directories)."""
     findings: List[Finding] = []
     for path in iter_python_files(paths):
@@ -311,5 +319,6 @@ def analyze_paths(paths: Sequence[str],
                 rule="parse-error", path=path, line=1, col=0,
                 message=f"cannot read: {e}"))
             continue
-        findings.extend(analyze_source(src, path=path, disabled=disabled))
+        findings.extend(analyze_source(src, path=path, disabled=disabled,
+                                       keep_suppressed=keep_suppressed))
     return findings

@@ -1,7 +1,10 @@
 """tpulint CLI — ``python -m paddle_tpu.analysis`` / ``tpulint``.
 
 Exit codes: 0 clean (or everything baselined), 1 findings, 2 usage
-error. ``--format=json`` emits one machine-readable object for CI.
+error. ``--format=json`` emits one machine-readable object for CI;
+``--format=github`` emits ``::error`` workflow annotations so findings
+surface inline on the PR diff; ``--stats`` appends a per-rule
+finding/suppression count table.
 """
 from __future__ import annotations
 
@@ -27,7 +30,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("paths", nargs="*",
                    help="files or directories to analyze")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text")
+    p.add_argument("--stats", action="store_true",
+                   help="append a per-rule table of finding and "
+                        "suppression counts (suppressions are counted "
+                        "from the disable comments that fired)")
     p.add_argument("--baseline", metavar="FILE",
                    help="JSON baseline of accepted findings to subtract")
     p.add_argument("--write-baseline", action="store_true",
@@ -51,6 +59,31 @@ def _list_rules() -> str:
         lines.append(f"    {rule.summary}")
     lines.append("meta: " + ", ".join(META_RULES) +
                  " (emitted by the engine itself)")
+    return "\n".join(lines)
+
+
+def _rule_stats(findings, suppressed) -> dict:
+    """{rule: {"findings": n, "suppressed": m}} for every rule with a
+    non-zero row — zero rows would bury the signal under ~17 blanks."""
+    stats: dict = {}
+    for f in findings:
+        stats.setdefault(f.rule, {"findings": 0, "suppressed": 0})
+        stats[f.rule]["findings"] += 1
+    for f in suppressed:
+        stats.setdefault(f.rule, {"findings": 0, "suppressed": 0})
+        stats[f.rule]["suppressed"] += 1
+    return dict(sorted(stats.items()))
+
+
+def _stats_table(findings, suppressed) -> str:
+    stats = _rule_stats(findings, suppressed)
+    if not stats:
+        return "tpulint: no findings and no active suppressions"
+    width = max(len(r) for r in stats)
+    lines = [f"{'rule':<{width}}  findings  suppressed"]
+    for rule, row in stats.items():
+        lines.append(f"{rule:<{width}}  {row['findings']:>8}  "
+                     f"{row['suppressed']:>10}")
     return "\n".join(lines)
 
 
@@ -78,10 +111,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         disabled = sorted((set(get_rules()) - set(only))
                           | set(disabled))
     try:
-        findings = analyze_paths(args.paths, disabled=disabled)
+        findings = analyze_paths(args.paths, disabled=disabled,
+                                 keep_suppressed=args.stats)
     except FileNotFoundError as e:
         print(f"tpulint: no such path: {e.args[0]}", file=sys.stderr)
         return 2
+    suppressed = [f for f in findings if f.suppressed]
+    findings = [f for f in findings if not f.suppressed]
 
     if args.write_baseline:
         if not args.baseline:
@@ -102,11 +138,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings, baselined = apply_baseline(findings, base)
 
     if args.format == "json":
-        print(json.dumps({
+        payload = {
             "findings": [f.to_dict() for f in findings],
             "count": len(findings),
             "baselined": baselined,
-        }, indent=2))
+        }
+        if args.stats:
+            payload["stats"] = _rule_stats(findings, suppressed)
+        print(json.dumps(payload, indent=2))
+    elif args.format == "github":
+        # workflow-command annotations: one ::error per finding so the
+        # Actions runner pins each onto the PR diff; the summary line
+        # is plain text, which the runner ignores
+        for f in findings:
+            msg = f.message.replace("%", "%25").replace("\n", "%0A")
+            print(f"::error file={f.path},line={f.line},"
+                  f"col={f.col + 1}::{f.rule}: {msg}")
+        print(f"tpulint: {len(findings)} finding(s)")
+        if args.stats:
+            print(_stats_table(findings, suppressed))
     else:
         for f in findings:
             print(f.render())
@@ -114,6 +164,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if baselined:
             tail += f" ({baselined} more suppressed by baseline)"
         print(tail)
+        if args.stats:
+            print(_stats_table(findings, suppressed))
     return 1 if findings else 0
 
 

@@ -1,0 +1,655 @@
+"""flowcheck shared model: path-sensitive resource lifecycle + repo
+vocabularies.
+
+The third analyzer family member, next to ``context`` (tracecheck's
+name resolution) and ``concurrency`` (lockcheck's lock model). Two
+halves, both built once per module and cached on it (the
+``get_concurrency`` idiom):
+
+**Resource lifecycle.** :class:`ResourceFlow` walks every function with
+an abstract interpreter over the statement structure: an *acquire* call
+(:data:`RESOURCE_SPECS` — BlockManager allocations, host-slot spills,
+lease acquires, issued tickets, parked-KV entries) creates a tracked
+resource; a paired *release* call (or an outcome-bucket increment, for
+tickets) retires it; storing it into a container, returning it, or
+yielding it transfers custody out of the function. Between acquire and
+release/transfer, every statement that can raise is checked against the
+enclosing ``try`` frames: the exception is threaded outward through
+handlers (a handler that releases is safe on that edge; one that
+swallows ends propagation; one that re-raises keeps the resource live
+into the next frame) and ``finally`` blocks, and if it can escape the
+function with the resource still held, that acquire is a *leak on
+raise* — the PR 14 ``import_kv`` scatter-fault bug class. Branches
+merge pessimistically (held-on-any-path stays held, so a conditional
+release does not count), and one level of ``self._helper()`` /
+bare-name closure is followed when scanning cleanup bodies, like
+lockcheck's.
+
+Known approximations (documented in ``rules/README.md``): exception
+*types* are not modeled (any handler is assumed able to catch), loops
+run once, and cross-class custody transfers are not chased — passing a
+resource as a plain call argument does NOT transfer it (that is
+exactly the PR 14 shape that must stay flagged), while a container
+store / return / yield that mentions it does.
+
+**Repo vocabularies.** Cross-module literal indexes for the coherence
+rules, cached per repo root: the set of ``num_*`` counter names *read*
+by the metrics layer (the serving/fleet metrics modules plus any
+``snapshot()``/``stats()``-shaped reader), the set written anywhere
+under ``paddle_tpu``, and the raw text of ``tests/`` + ``scripts/``
+(fault-point coverage lookups).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.context import dotted_name
+
+__all__ = [
+    "ResourceSpec", "RESOURCE_SPECS", "Resource", "Leak", "ResourceFlow",
+    "get_dataflow", "repo_root", "metrics_read_names",
+    "counter_write_names", "reference_text",
+]
+
+HELD, RELEASED, TRANSFERRED = "held", "released", "transferred"
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    """One acquire/release pairing the leak rule enforces."""
+
+    kind: str
+    acquire: Tuple[str, ...]
+    release: Tuple[str, ...]
+    # receiver's final attribute segment must match when given (keeps
+    # generic verbs like ``acquire`` from matching every lock)
+    receivers: Optional[Tuple[str, ...]] = None
+    # an AugAssign into ``<recv>.<name>[...]`` counts as release (the
+    # ticket-outcome accounting partition)
+    release_stores: Tuple[str, ...] = ()
+
+
+RESOURCE_SPECS: Tuple[ResourceSpec, ...] = (
+    ResourceSpec(kind="kv-blocks",
+                 acquire=("allocate", "append_slot", "import_blocks",
+                          "resume_chain"),
+                 release=("free", "trim"),
+                 receivers=("block_manager", "bm")),
+    ResourceSpec(kind="host-slots",
+                 acquire=("swap_out",),
+                 release=("swap_in", "free_host", "free"),
+                 receivers=("block_manager", "bm")),
+    ResourceSpec(kind="lease",
+                 acquire=("acquire",),
+                 release=("release", "adopt"),
+                 receivers=("lease_store",)),
+    ResourceSpec(kind="ticket",
+                 acquire=("_issue_ticket",),
+                 release=(),
+                 release_stores=("ticket_outcomes",)),
+    ResourceSpec(kind="parked-kv",
+                 acquire=("park_kv",),
+                 release=("drop_parked",)),
+)
+
+# calls that cannot plausibly raise between an acquire and its release —
+# everything else is a potential exception edge
+_BENIGN_NAMES = frozenset({
+    "len", "int", "float", "bool", "str", "repr", "min", "max", "sum",
+    "abs", "round", "sorted", "list", "dict", "set", "tuple",
+    "frozenset", "isinstance", "getattr", "hasattr", "id", "iter",
+    "range", "enumerate", "zip", "callable", "bytes", "type", "format",
+})
+_BENIGN_ATTRS = frozenset({
+    "get", "items", "keys", "values", "append", "add", "pop", "discard",
+    "setdefault", "update", "copy", "clear", "remove", "extend",
+    "startswith", "endswith", "split", "rsplit", "join", "strip",
+    "format", "monotonic", "time", "debug", "info", "warning", "lower",
+    "upper", "count",
+})
+# container verbs whose argument mention transfers custody
+_TRANSFER_ATTRS = frozenset({"append", "add", "setdefault", "put",
+                             "push", "register"})
+
+
+@dataclass(eq=False)   # identity hash: each acquire site is distinct
+class Resource:
+    spec: ResourceSpec
+    node: ast.AST            # the acquire call
+    method: str
+    keys: FrozenSet[str]     # var names + first-arg dotted names
+    reported: bool = False
+
+
+@dataclass
+class Leak:
+    resource: Resource
+    raise_node: ast.AST
+    via: str                 # what can raise ("call f(...)" / "raise")
+
+
+class _Frame:
+    """One enclosing try on the exception path: its handlers (empty for
+    a finally-only continuation frame) and its finalbody."""
+
+    __slots__ = ("handlers", "finalbody")
+
+    def __init__(self, handlers, finalbody):
+        self.handlers = handlers
+        self.finalbody = finalbody
+
+
+def _first_arg_key(call: ast.Call) -> Optional[str]:
+    if call.args:
+        return dotted_name(call.args[0])
+    return None
+
+
+def _mentions(node: ast.AST, keys: FrozenSet[str]) -> bool:
+    for n in ast.walk(node):
+        d = dotted_name(n)
+        if d is not None and d in keys:
+            return True
+    return False
+
+
+class ResourceFlow:
+    """Per-module leak analysis over :data:`RESOURCE_SPECS`."""
+
+    def __init__(self, module):
+        self.module = module
+        self.functions = module.traces.functions
+        self.leaks: List[Leak] = []
+        for fdef in self.functions.defs:
+            if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._run(fdef)
+
+    # -- acquire/release matching -----------------------------------------
+    def _acquires(self, call: ast.Call) -> Optional[Tuple[ResourceSpec,
+                                                          str]]:
+        if isinstance(call.func, ast.Attribute):
+            name = call.func.attr
+            recv = dotted_name(call.func.value)
+            recv_last = recv.rsplit(".", 1)[-1] if recv else None
+        elif isinstance(call.func, ast.Name):
+            name, recv_last = call.func.id, None
+        else:
+            return None
+        for spec in RESOURCE_SPECS:
+            if name not in spec.acquire:
+                continue
+            if spec.receivers is not None and recv_last not in \
+                    spec.receivers:
+                continue
+            return spec, name
+        return None
+
+    @staticmethod
+    def _match_release(call: ast.Call, res: Resource,
+                       ignore_keys: bool = False) -> bool:
+        if isinstance(call.func, ast.Attribute):
+            name = call.func.attr
+        elif isinstance(call.func, ast.Name):
+            name = call.func.id
+        else:
+            return False
+        if name not in res.spec.release:
+            return False
+        if ignore_keys or not res.keys:
+            return True
+        return any(_mentions(a, res.keys) for a in call.args)
+
+    def _helper_body(self, call: ast.Call) -> Optional[ast.AST]:
+        """ONE level of closure: ``self._x(...)`` / bare ``x(...)``
+        resolved to a def in this module."""
+        if isinstance(call.func, ast.Name):
+            return self.functions.resolve(call.func.id, call)
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id == "self":
+            cands = self.functions.by_name.get(call.func.attr)
+            return cands[0] if cands else None
+        return None
+
+    def _releases_in(self, node: ast.AST, res: Resource,
+                     follow_helpers: bool = True) -> bool:
+        """Does executing ``node`` (a statement or block element)
+        release ``res`` — directly, via an outcome-store increment, or
+        inside one level of helper call?"""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                if self._match_release(n, res):
+                    return True
+                if follow_helpers:
+                    body = self._helper_body(n)
+                    if body is not None and (not res.keys or not n.args
+                                             or any(_mentions(a, res.keys)
+                                                    for a in n.args)):
+                        for m in ast.walk(body):
+                            if isinstance(m, ast.Call) and \
+                                    self._match_release(m, res,
+                                                        ignore_keys=True):
+                                return True
+            elif isinstance(n, ast.AugAssign) and res.spec.release_stores:
+                tgt = n.target
+                if isinstance(tgt, ast.Subscript):
+                    d = dotted_name(tgt.value)
+                    if d and d.rsplit(".", 1)[-1] in \
+                            res.spec.release_stores:
+                        return True
+        return False
+
+    def _block_releases(self, stmts: Sequence[ast.stmt],
+                        res: Resource) -> bool:
+        return any(self._releases_in(s, res) for s in stmts)
+
+    @staticmethod
+    def _block_raises(stmts: Sequence[ast.stmt]) -> bool:
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Raise):
+                    return True
+        return False
+
+    # -- exception-edge escape --------------------------------------------
+    def _escapes(self, res: Resource, frames: Tuple[_Frame, ...]) -> bool:
+        """Thread a raise outward: True when the exception can leave
+        the function with ``res`` still held."""
+        for frame in reversed(frames):
+            if frame.finalbody and self._block_releases(frame.finalbody,
+                                                        res):
+                return False
+            if frame.handlers:
+                escaping = False
+                for h in frame.handlers:
+                    if self._block_releases(h.body, res):
+                        continue   # cleaned up before any re-raise
+                    if not self._block_raises(h.body):
+                        continue   # swallowed: propagation ends here
+                    escaping = True
+                if not escaping:
+                    return False
+        return True
+
+    # -- statement effects -------------------------------------------------
+    @staticmethod
+    def _may_raise(st: ast.stmt) -> Optional[str]:
+        for n in ast.walk(st):
+            if isinstance(n, ast.Raise):
+                return "an explicit raise"
+            if isinstance(n, ast.Assert):
+                return "a failing assert"
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Name) and \
+                        n.func.id in _BENIGN_NAMES:
+                    continue
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _BENIGN_ATTRS:
+                    continue
+                label = dotted_name(n.func) or "<call>"
+                return f"a raising call to {label}()"
+        return None
+
+    def _transfers(self, st: ast.stmt, res: Resource) -> bool:
+        if isinstance(st, ast.Return):
+            return st.value is not None and _mentions(st.value, res.keys)
+        if isinstance(st, ast.Assign):
+            into_container = any(
+                isinstance(t, (ast.Subscript, ast.Attribute))
+                for t in st.targets)
+            if into_container and (_mentions(st, res.keys)):
+                return True
+        if isinstance(st, ast.Expr):
+            for n in ast.walk(st):
+                if isinstance(n, ast.Yield) and n.value is not None \
+                        and _mentions(n.value, res.keys):
+                    return True
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _TRANSFER_ATTRS and \
+                        any(_mentions(a, res.keys) for a in n.args):
+                    return True
+        return False
+
+    def _acquired_in(self, st: ast.stmt) -> List[Resource]:
+        out: List[Resource] = []
+        for n in ast.walk(st):
+            if not isinstance(n, ast.Call):
+                continue
+            hit = self._acquires(n)
+            if hit is None:
+                continue
+            spec, method = hit
+            keys: Set[str] = set()
+            k = _first_arg_key(n)
+            if k:
+                keys.add(k)
+                # ``allocate(req.request_id)``: storing/registering the
+                # owning object ``req`` transfers custody too
+                base = k.split(".", 1)[0]
+                if base != "self":
+                    keys.add(base)
+            if isinstance(st, ast.Assign):
+                for tgt in st.targets:
+                    for t in ast.walk(tgt):
+                        if isinstance(t, ast.Name):
+                            keys.add(t.id)
+            out.append(Resource(spec=spec, node=n, method=method,
+                                keys=frozenset(keys)))
+        return out
+
+    # -- the walker ---------------------------------------------------------
+    def _run(self, fdef: ast.AST) -> None:
+        self._exec(fdef.body, (), {})
+
+    @staticmethod
+    def _merge(a: Dict[Resource, str],
+               b: Dict[Resource, str]) -> Dict[Resource, str]:
+        out = dict(a)
+        for res, status in b.items():
+            if out.get(res) == HELD or status == HELD:
+                out[res] = HELD
+            else:
+                out.setdefault(res, status)
+        return out
+
+    @staticmethod
+    def _gate(test: ast.AST, s_true: Dict["Resource", str],
+              s_false: Dict["Resource", str]) -> None:
+        """Truthiness path-sensitivity: after ``if handle:`` /
+        ``if handle is not None:`` the handle is known falsy in one
+        branch — a resource bound to that name cannot be held there
+        (``parked = h.park_kv(...)`` followed by ``if parked:``)."""
+        name, held_branch = None, s_true
+        if isinstance(test, ast.Name):
+            name = test.id
+        elif isinstance(test, ast.UnaryOp) and \
+                isinstance(test.op, ast.Not) and \
+                isinstance(test.operand, ast.Name):
+            name, held_branch = test.operand.id, s_false
+        elif isinstance(test, ast.Compare) and \
+                isinstance(test.left, ast.Name) and \
+                len(test.ops) == 1 and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            name = test.left.id
+            if isinstance(test.ops[0], ast.Is):
+                held_branch = s_false
+            elif not isinstance(test.ops[0], ast.IsNot):
+                name = None
+        if name is None:
+            return
+        dead = s_false if held_branch is s_true else s_true
+        for res, status in dead.items():
+            if status == HELD and name in res.keys:
+                dead[res] = RELEASED
+
+    def _exec(self, stmts: Sequence[ast.stmt],
+              frames: Tuple[_Frame, ...],
+              state: Dict[Resource, str],
+              snaps: Optional[List[Dict[Resource, str]]] = None) -> bool:
+        """Walk one block. Returns False when every path through it
+        terminates (return/raise/break/continue), so callers stop the
+        current path instead of leaking state past a ``return``. When
+        ``snaps`` is given (inside a try body), the state *before* each
+        statement that can raise is recorded — that join, not the
+        body-exit state, is what a handler observes: an acquire call
+        that raises never acquired."""
+        for st in stmts:
+            if isinstance(st, ast.Try):
+                if snaps is not None:
+                    snaps.append(dict(state))
+                self._try(st, frames, state)
+                if snaps is not None:
+                    # an inner handler may re-raise after body acquires
+                    snaps.append(dict(state))
+            elif isinstance(st, ast.If):
+                if snaps is not None:
+                    snaps.append(dict(state))
+                s1, s2 = dict(state), dict(state)
+                self._gate(st.test, s1, s2)
+                t1 = self._exec(st.body, frames, s1, snaps)
+                t2 = self._exec(st.orelse, frames, s2, snaps)
+                if not (t1 or t2):
+                    return False
+                merged = self._merge(s1, s2) if (t1 and t2) else \
+                    (s1 if t1 else s2)
+                state.clear()
+                state.update(merged)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                if snaps is not None:
+                    snaps.append(dict(state))
+                s1 = dict(state)
+                self._exec(st.body, frames, s1, snaps)
+                self._exec(st.orelse, frames, s1, snaps)
+                merged = self._merge(state, s1)   # zero-or-once
+                state.clear()
+                state.update(merged)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                if snaps is not None:
+                    snaps.append(dict(state))
+                if not self._exec(st.body, frames, state, snaps):
+                    return False
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue   # nested scopes get their own walk
+            else:
+                if snaps is not None and self._may_raise(st) is not None:
+                    snaps.append(dict(state))
+                self._simple(st, frames, state)
+                if isinstance(st, (ast.Return, ast.Raise, ast.Break,
+                                   ast.Continue)):
+                    return False
+        return True
+
+    def _try(self, st: ast.Try, frames: Tuple[_Frame, ...],
+             state: Dict[Resource, str]) -> None:
+        entry = dict(state)
+        inner = frames + (_Frame(st.handlers, st.finalbody),)
+        raise_snaps: List[Dict[Resource, str]] = []
+        fell = self._exec(st.body, inner, state, raise_snaps)
+        # a handler sees the state at the raising point, not body exit
+        exc = dict(entry)
+        for s in raise_snaps:
+            exc = self._merge(exc, s)
+        fin_frames = frames + ((_Frame((), st.finalbody),)
+                               if st.finalbody else ())
+        if fell:
+            self._exec(st.orelse, fin_frames, state)
+        handler_exits: List[Dict[Resource, str]] = []
+        for h in st.handlers:
+            hs = dict(exc)
+            if self._exec(h.body, fin_frames, hs) and \
+                    not self._block_raises(h.body):
+                handler_exits.append(hs)
+        for hs in handler_exits:
+            merged = self._merge(state, hs)
+            state.clear()
+            state.update(merged)
+        self._exec(st.finalbody, frames, state)
+
+    def _simple(self, st: ast.stmt, frames: Tuple[_Frame, ...],
+                state: Dict[Resource, str]) -> None:
+        held = [r for r, s in state.items() if s == HELD]
+        for res in held:
+            if self._releases_in(st, res):
+                state[res] = RELEASED
+        # the raise check precedes the transfer check: in
+        # ``self.x[k] = fallible()`` the raise happens before the store
+        via = self._may_raise(st)
+        if via is not None:
+            for res in held:
+                if state[res] != HELD or res.reported:
+                    continue
+                if self._escapes(res, frames):
+                    res.reported = True
+                    self.leaks.append(Leak(resource=res, raise_node=st,
+                                           via=via))
+        for res in held:
+            if state[res] == HELD and self._transfers(st, res):
+                state[res] = TRANSFERRED
+        for res in self._acquired_in(st):
+            state[res] = HELD
+            # `return self.bm.allocate(...)` hands custody to the caller
+            if self._transfers(st, res) or isinstance(st, ast.Return):
+                state[res] = TRANSFERRED
+
+
+def get_dataflow(module) -> ResourceFlow:
+    """The cached per-module :class:`ResourceFlow` (built on first
+    use, like ``concurrency.get_concurrency``)."""
+    flow = getattr(module, "_dataflow", None)
+    if flow is None:
+        flow = ResourceFlow(module)
+        module._dataflow = flow
+    return flow
+
+
+# ---------------------------------------------------------------------------
+# repo vocabularies (cross-module literal indexes)
+# ---------------------------------------------------------------------------
+_READER_FUNCS = frozenset({"snapshot", "stats", "host_tier_stats",
+                           "tier_stats", "summary", "as_dict"})
+_REPO_CACHE: Dict[Tuple[str, str], object] = {}
+
+
+def repo_root() -> Optional[str]:
+    """The checkout root — parent of the installed ``paddle_tpu``
+    package — or None when the runtime package is unavailable."""
+    try:
+        import paddle_tpu
+    except Exception:
+        return None
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+
+
+def _iter_py(*dirs: str) -> List[str]:
+    out: List[str] = []
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for root, subdirs, files in os.walk(d):
+            subdirs[:] = sorted(s for s in subdirs
+                                if s != "__pycache__"
+                                and not s.startswith("."))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except (OSError, UnicodeDecodeError):
+        return ""
+
+
+def _parse(path: str) -> Optional[ast.AST]:
+    src = _read(path)
+    if not src:
+        return None
+    try:
+        return ast.parse(src, filename=path)
+    except SyntaxError:
+        return None
+
+
+def _collect_num_reads(node: ast.AST, into: Set[str]) -> None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                and n.attr.startswith("num_"):
+            into.add(n.attr)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "getattr" and len(n.args) >= 2 \
+                and isinstance(n.args[1], ast.Constant) \
+                and isinstance(n.args[1].value, str) \
+                and n.args[1].value.startswith("num_"):
+            into.add(n.args[1].value)
+
+
+def metrics_read_names() -> FrozenSet[str]:
+    """Every ``num_*`` counter the metrics layer reads: the serving and
+    fleet metrics modules in full, plus any ``snapshot()``/``stats()``-
+    shaped reader function anywhere under ``paddle_tpu/serving``."""
+    root = repo_root()
+    if root is None:
+        return frozenset()
+    key = (root, "metrics_reads")
+    cached = _REPO_CACHE.get(key)
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    reads: Set[str] = set()
+    serving = os.path.join(root, "paddle_tpu", "serving")
+    for path in _iter_py(serving):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        if os.path.basename(path) == "metrics.py":
+            _collect_num_reads(tree, reads)
+            continue
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name in _READER_FUNCS:
+                _collect_num_reads(n, reads)
+    out = frozenset(reads)
+    _REPO_CACHE[key] = out
+    return out
+
+
+def counter_write_names() -> FrozenSet[str]:
+    """Every ``num_*`` name assigned or incremented anywhere under the
+    ``paddle_tpu`` package (the registered-but-never-bumped lookup)."""
+    root = repo_root()
+    if root is None:
+        return frozenset()
+    key = (root, "counter_writes")
+    cached = _REPO_CACHE.get(key)
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    writes: Set[str] = set()
+    for path in _iter_py(os.path.join(root, "paddle_tpu")):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for n in ast.walk(tree):
+            targets: List[ast.AST] = []
+            if isinstance(n, ast.Assign):
+                targets = list(n.targets)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name.startswith("num_"):
+                # a num_* property getter provides the value too
+                writes.add(n.name)
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        t.attr.startswith("num_"):
+                    writes.add(t.attr)
+                elif isinstance(t, ast.Name) and \
+                        t.id.startswith("num_"):
+                    writes.add(t.id)
+    out = frozenset(writes)
+    _REPO_CACHE[key] = out
+    return out
+
+
+def reference_text() -> str:
+    """Concatenated source of ``tests/`` + ``scripts/`` — the coverage
+    corpus for 'every registered fault point is exercised somewhere'."""
+    root = repo_root()
+    if root is None:
+        return ""
+    key = (root, "reference_text")
+    cached = _REPO_CACHE.get(key)
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    chunks = [_read(p) for p in _iter_py(os.path.join(root, "tests"),
+                                         os.path.join(root, "scripts"))]
+    out = "\n".join(chunks)
+    _REPO_CACHE[key] = out
+    return out

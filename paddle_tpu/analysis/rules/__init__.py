@@ -1,13 +1,19 @@
-"""tracecheck launch rules. Importing this package registers them all
-(the registry imports it lazily from ``get_rules``)."""
+"""tracecheck launch rules and flowcheck lifecycle rules. Importing
+this package registers them all (the registry imports it lazily from
+``get_rules``)."""
 from paddle_tpu.analysis.rules import (  # noqa: F401
     block_sync,
     blocking_lock,
     collective_divergence,
+    counter_drift,
     counter_leak,
+    fault_points,
     finish_reason,
     host_sync,
     lock_order,
+    resource_leak,
+    rpc_deadline,
+    rpc_verbs,
     shared_state,
     signal_safety,
     tensor_bool,

@@ -1,0 +1,172 @@
+"""counter-snapshot-drift: a counter bumped but invisible to the
+metrics layer, or a gauge registered over a counter nobody bumps.
+
+The serving/fleet observability contract (PRs 8/12/16): every lifetime
+counter lands in exactly one gauge registration AND the owning
+``snapshot()`` vocabulary, so BENCH JSON, ``profiler.counters()`` and
+the chaos tests' conservation pins all see the same numbers. PR 16's
+lease-accounting bugs were exactly this drift — counters bumped in
+``lease.py`` that no snapshot ever surfaced — found late. Three
+mechanically-checkable directions:
+
+1. **bumped-but-never-read** — a ``self.num_foo += ...`` increment in a
+   module under ``serving/``/``fleet/`` whose name is read by no
+   metrics module and no ``snapshot()``/``stats()``-shaped reader
+   anywhere under ``paddle_tpu/serving`` (the cross-module read index
+   in ``analysis/dataflow.py``). Anchored at the increment so an
+   inline suppression can state why the counter is deliberately
+   internal.
+2. **registered-but-unhandled** — in a metrics class (one defining a
+   ``GAUGES`` tuple), a ``GAUGES`` name with no getter in any
+   ``*_GAUGES`` dict and no literal mention elsewhere in the class
+   (the provider if-chain), or a getter-dict key missing from
+   ``GAUGES``.
+3. **registered-but-never-bumped** — a getter whose ``num_*`` read
+   names a counter that is never assigned or incremented anywhere
+   under ``paddle_tpu`` (the write index): a gauge wired to a ghost.
+
+Fix pattern: register the counter (gauge + snapshot key) or delete it;
+suppress only for counters that are deliberately engine-internal, with
+the consumer named in the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from paddle_tpu.analysis.dataflow import (
+    counter_write_names, metrics_read_names,
+)
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _in_scope(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "serving" in parts or "fleet" in parts
+
+
+def _is_metrics_module(path: str) -> bool:
+    return path.replace("\\", "/").endswith("metrics.py")
+
+
+def _gauge_classes(tree: ast.AST):
+    """(class node, GAUGES names, getter dicts, literals elsewhere)."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        gauges: Optional[ast.Assign] = None
+        getter_dicts: List[ast.Dict] = []
+        for st in cls.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                if name == "GAUGES":
+                    gauges = st
+                elif name.endswith("GAUGES") and \
+                        isinstance(st.value, ast.Dict):
+                    getter_dicts.append(st.value)
+        if gauges is None or not getter_dicts:
+            continue
+        names: Dict[str, ast.AST] = {}
+        for n in ast.walk(gauges.value):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                names.setdefault(n.value, n)
+        yield cls, names, getter_dicts, gauges
+
+
+@register(
+    "counter-snapshot-drift",
+    "counter bumped but not snapshotted/gauged, or gauge over a ghost",
+    _DOC)
+def check(module) -> List[Finding]:
+    if not _in_scope(module.path):
+        return []
+    out: List[Finding] = []
+    reads = metrics_read_names()
+    writes = counter_write_names()
+
+    # direction 2 + 3: metrics classes (GAUGES + getter dicts)
+    for cls, names, getter_dicts, gauges in _gauge_classes(module.tree):
+        getter_keys: Dict[str, ast.AST] = {}
+        for d in getter_dicts:
+            for k, v in zip(d.keys, d.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    getter_keys[k.value] = k
+                    if writes:
+                        ghost: Set[str] = set()
+                        _num_reads(v, ghost)
+                        for attr in sorted(ghost - writes):
+                            out.append(module.finding(
+                                "counter-snapshot-drift", v,
+                                f"gauge '{k.value}' reads {attr} which "
+                                f"is never assigned or incremented "
+                                f"anywhere under paddle_tpu — a "
+                                f"registered-but-never-bumped gauge "
+                                f"always reports its initial value"))
+        for key, node in getter_keys.items():
+            if key not in names:
+                out.append(module.finding(
+                    "counter-snapshot-drift", node,
+                    f"getter dict entry '{key}' is not in "
+                    f"{cls.name}.GAUGES — it never registers a "
+                    f"profiler counter provider"))
+        handled = set(getter_keys) | _non_gauge_literals(cls, gauges)
+        for name, node in names.items():
+            if name not in handled:
+                out.append(module.finding(
+                    "counter-snapshot-drift", node,
+                    f"{cls.name}.GAUGES entry '{name}' has no getter "
+                    f"in any *_GAUGES dict and no literal handler in "
+                    f"the class — its provider and snapshot value can "
+                    f"only be None/absent"))
+
+    # direction 1: increments with no metrics reader. Only `self.num_*`
+    # counts — a component's lifetime counters are bumped on self;
+    # `req.num_cached += n` is per-object state owned elsewhere.
+    if reads and not _is_metrics_module(module.path):
+        for n in ast.walk(module.tree):
+            if isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Attribute) and \
+                    isinstance(n.target.value, ast.Name) and \
+                    n.target.value.id == "self" and \
+                    n.target.attr.startswith("num_") and \
+                    n.target.attr not in reads:
+                out.append(module.finding(
+                    "counter-snapshot-drift", n,
+                    f"counter {n.target.attr} is incremented here but "
+                    f"read by no metrics gauge map and no snapshot()/"
+                    f"stats() reader under paddle_tpu/serving — it is "
+                    f"invisible to BENCH JSON, profiler.counters() and "
+                    f"the conservation pins; register it or delete it"))
+    return out
+
+
+def _num_reads(node: ast.AST, into: Set[str]) -> None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.ctx, ast.Load) and \
+                n.attr.startswith("num_"):
+            into.add(n.attr)
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Name) and \
+                n.func.id == "getattr" and len(n.args) >= 2 and \
+                isinstance(n.args[1], ast.Constant) and \
+                isinstance(n.args[1].value, str) and \
+                n.args[1].value.startswith("num_"):
+            into.add(n.args[1].value)
+
+
+def _non_gauge_literals(cls: ast.ClassDef,
+                        gauges: ast.Assign) -> Set[str]:
+    """String literals in the class OUTSIDE the GAUGES tuple itself —
+    a provider if-chain arm or a snapshot key counts as handling."""
+    inside = {id(n) for n in ast.walk(gauges.value)}
+    out: Set[str] = set()
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and id(n) not in inside:
+            out.add(n.value)
+    return out

@@ -1,0 +1,135 @@
+"""fault-point-literal: fault points come from the FAULT_POINTS
+registry, and every registered point is exercised by some test.
+
+The fault harness (``paddle_tpu.testing.faults``) is only as good as
+its point names: a typo'd ``faults.fire("serving.kv_scater")`` hook
+compiles, ships, and silently never fires — the chaos test that targets
+the real name passes vacuously against code that no longer has the
+hook. PR 20 centralizes every production point as a named constant in
+``paddle_tpu/testing/faults.py`` with a ``FAULT_POINTS`` frozenset
+over them (the TPP small-vocabulary discipline: a closed primitive set
+makes misuse mechanically detectable). Two directions:
+
+1. **call sites** — in any module importing the faults harness, a
+   ``faults.fire(...)`` / ``faults.check(...)`` whose point argument is
+   a raw string literal (or an f-string that STARTS with one) is
+   flagged: reference the registry constant instead
+   (``faults.fire(faults.SERVING_KV_SCATTER)``; keyed points compose
+   as ``f"{faults.SERVING_FORCE_OOM}.{request_id}"`` — constant first,
+   key suffix after).
+2. **registry coverage** — in the module that defines ``FAULT_POINTS``
+   itself, every registered point string must appear somewhere in
+   ``tests/`` or ``scripts/`` (the reference-text index in
+   ``analysis/dataflow.py``): a point no test ever installs or asserts
+   on is dead chaos surface.
+
+The registry module is exempt from direction 1 (it's where the
+literals live); test files are not linted, so test-side
+``faults.install("point:action")`` specs are unaffected.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from paddle_tpu.analysis.dataflow import reference_text
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+_MARKER = "testing.faults"
+
+
+def _faults_aliases(module) -> Set[str]:
+    """Local names bound to the faults harness module."""
+    out: Set[str] = set()
+    for alias, canon in module.imports.aliases.items():
+        if canon.endswith(_MARKER):
+            out.add(alias)
+    return out
+
+
+def _literal_head(arg: ast.AST) -> Optional[ast.AST]:
+    """The node to flag when the point argument is literal-led, else
+    None (a Name/Attribute reference, or an f-string led by one, is
+    the sanctioned registry-constant form)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant):
+            return head
+    return None
+
+
+def _registry_constants(module) -> Dict[str, ast.Assign]:
+    """point value -> assign node, for the module defining
+    FAULT_POINTS = frozenset({CONST, ...}) over module-level string
+    constants."""
+    tree = module.tree
+    consts: Dict[str, ast.Assign] = {}
+    members: Optional[Set[str]] = None
+    for st in tree.body:
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1 or \
+                not isinstance(st.targets[0], ast.Name):
+            continue
+        name = st.targets[0].id
+        if name == "FAULT_POINTS":
+            members = set()
+            for n in ast.walk(st.value):
+                if isinstance(n, ast.Name) and n.id != "frozenset":
+                    members.add(n.id)
+        elif isinstance(st.value, ast.Constant) and \
+                isinstance(st.value.value, str):
+            consts[name] = st
+    if members is None:
+        return {}
+    return {consts[m].value.value: consts[m]
+            for m in members if m in consts}
+
+
+@register(
+    "fault-point-literal",
+    "fault point not referenced from the FAULT_POINTS registry",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+
+    # direction 2: the registry module itself — every point covered
+    registry = _registry_constants(module)
+    if registry:
+        corpus = reference_text()
+        if corpus:
+            for point, node in sorted(registry.items()):
+                if point not in corpus:
+                    out.append(module.finding(
+                        "fault-point-literal", node,
+                        f"registered fault point '{point}' is "
+                        f"referenced by no file under tests/ or "
+                        f"scripts/ — dead chaos surface; exercise it "
+                        f"or drop it from FAULT_POINTS"))
+        return out  # the registry module is exempt from direction 1
+
+    # direction 1: call sites must reference registry constants
+    aliases = _faults_aliases(module)
+    if not aliases:
+        return out
+    for n in ast.walk(module.tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("fire", "check")
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id in aliases
+                and n.args):
+            continue
+        lit = _literal_head(n.args[0])
+        if lit is not None:
+            shown = lit.value
+            out.append(module.finding(
+                "fault-point-literal", n.args[0],
+                f"fault point {shown!r} is a raw literal — reference "
+                f"the FAULT_POINTS registry constant from "
+                f"paddle_tpu.testing.faults instead (keyed points "
+                f"compose as f-strings LED by the constant), so a "
+                f"typo'd point can never silently stop firing"))
+    return out

@@ -1,0 +1,67 @@
+"""leaked-resource-on-raise: an acquired resource can escape on an
+exception edge without its paired release.
+
+PR 14's ``import_kv`` bug class: ``block_manager.import_blocks`` landed
+a block table, the scatter fault fired between allocation and the
+scatter, and the blocks stayed allocated forever — found late, by a
+chaos test. This rule finds the shape at commit time, for every
+acquire/release pairing the runtime maintains by hand
+(:data:`paddle_tpu.analysis.dataflow.RESOURCE_SPECS`):
+
+* BlockManager allocations (``allocate`` / ``append_slot`` /
+  ``import_blocks`` / ``resume_chain``) paired with ``free``/``trim``,
+* host-slot spills (``swap_out``) paired with
+  ``swap_in``/``free_host``/``free``,
+* lease incarnations (``lease_store.acquire``) paired with
+  ``release``/``adopt``,
+* issued transfer tickets (``_issue_ticket``) paired with their
+  ``ticket_outcomes[...] += 1`` accounting bucket,
+* parked KV entries (``park_kv``) paired with ``drop_parked``.
+
+The shared model (``analysis/dataflow.py``) walks each function path-
+sensitively: exception edges thread outward through ``try`` frames — a
+handler that releases (directly or via one level of ``self._helper()``)
+is safe, a swallowing handler ends propagation, a ``finally`` release
+covers every edge — and custody transfers (container store, ``return``,
+``yield`` mentioning the resource) end tracking. A release under only
+one branch of an ``if`` does NOT count (held-on-any-path merging), so
+conditional cleanup is flagged.
+
+Fix pattern: wrap the fallible region in ``try/except`` that releases
+before re-raising (the ``import_kv`` shape), release in ``finally``, or
+transfer custody before the first fallible call. Suppress only where
+the escape is deliberate and owned elsewhere, with the owner named in
+the reason.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from paddle_tpu.analysis.dataflow import get_dataflow
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+@register(
+    "leaked-resource-on-raise",
+    "acquired resource can escape on an exception edge unreleased",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+    for leak in get_dataflow(module).leaks:
+        res = leak.resource
+        if res.spec.release:
+            pair = "/".join(res.spec.release)
+        else:
+            pair = (f"a {res.spec.release_stores[0]}[...] += 1 "
+                    f"outcome bucket")
+        keys = ", ".join(sorted(res.keys)) or "<anonymous>"
+        out.append(module.finding(
+            "leaked-resource-on-raise", res.node,
+            f"{res.spec.kind} acquired by {res.method}() (handle: "
+            f"{keys}) can escape on {leak.via} at line "
+            f"{getattr(leak.raise_node, 'lineno', '?')} without "
+            f"reaching {pair} — release in an except/finally before "
+            f"the exception propagates, or transfer custody first"))
+    return out

@@ -1,0 +1,82 @@
+"""unbounded-rpc-deadline: every fleet RPC carries an explicit
+deadline — no call may block on a stalled peer forever.
+
+The fleet survives SIGKILL'd replicas and stalled peer sockets only
+because every cross-process wait is bounded: ``RpcClient.call`` takes
+``deadline_s`` and raises ``RpcError`` past it, and the router's
+transfer-ticket ladder stamps each rung with ``deadline_ms`` so the
+watchdog can reap stuck walks. A single call site that omits the bound
+re-introduces the PR 13 hang class (router thread pinned on a dead
+replica's socket, heartbeats fine, throughput zero). Two shapes:
+
+1. a ``.call(...)`` on a receiver that names a client
+   (``self.client.call``, ``rpc_client.call``, ``c.call`` where the
+   last dotted segment ends in ``client``) with no ``deadline_s=``
+   keyword and no ``**kwargs`` splat that could carry one;
+2. a ``_issue_ticket(...)`` with fewer than five positional arguments
+   and no ``deadline_ms=`` keyword — an unstamped rung never expires
+   and the ticket-outcome accounting can't converge.
+
+Fix pattern: thread the caller's remaining budget (``deadline_s=`` on
+calls, ``_rung_deadline_ms(...)`` on rungs). Suppress only for calls
+whose receiver is not actually an RPC client, naming the real type in
+the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _receiver_is_client(func: ast.Attribute) -> bool:
+    """Last dotted segment of the receiver looks like an RPC client."""
+    recv = func.value
+    while isinstance(recv, ast.Attribute):
+        seg = recv.attr
+        return seg.lower().endswith("client")
+    if isinstance(recv, ast.Name):
+        return recv.id.lower().endswith("client")
+    return False
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name or kw.arg is None:  # explicit or **splat
+            return True
+    return False
+
+
+@register(
+    "unbounded-rpc-deadline",
+    "fleet RPC call or ticket rung without an explicit deadline",
+    _DOC)
+def check(module) -> List[Finding]:
+    out: List[Finding] = []
+    for n in ast.walk(module.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        func = n.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr == "call" and _receiver_is_client(func):
+            if not _has_kw(n, "deadline_s"):
+                out.append(module.finding(
+                    "unbounded-rpc-deadline", n,
+                    "RPC .call() without deadline_s= — an unbounded "
+                    "wait on a stalled peer pins this thread forever "
+                    "(the PR 13 hang class); thread the caller's "
+                    "remaining budget through deadline_s"))
+        elif func.attr == "_issue_ticket":
+            if len(n.args) < 5 and not _has_kw(n, "deadline_ms"):
+                out.append(module.finding(
+                    "unbounded-rpc-deadline", n,
+                    "_issue_ticket(...) without a deadline_ms rung "
+                    "bound — an unstamped ticket never expires, so the "
+                    "watchdog cannot reap the walk and ticket-outcome "
+                    "accounting cannot converge; pass "
+                    "_rung_deadline_ms(...) explicitly"))
+    return out

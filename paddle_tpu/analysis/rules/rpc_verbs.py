@@ -1,0 +1,136 @@
+"""rpc-verb-unclassified: every servicer verb is explicitly idempotent
+or a mutation — a new verb can never silently default.
+
+The fleet RPC layer's retry policy is a PARTITION: verbs in
+``IDEMPOTENT_METHODS`` are retried with backoff after a lost reply,
+verbs in ``MUTATION_METHODS`` get exactly one attempt (a retry could
+double-apply). Before PR 20 the partition was implicit —
+``method in IDEMPOTENT_METHODS`` — so a new read-only verb that nobody
+remembered to classify silently became a non-retried mutation
+(``tier_stats`` shipped exactly that way in PR 19). This rule makes
+the classification total and mechanical, in any module defining a
+``*Servicer`` class with a ``_dispatch`` method:
+
+* every verb string the dispatch chain compares against must appear in
+  exactly one of ``IDEMPOTENT_METHODS`` / ``MUTATION_METHODS``
+  (missing → flagged at the dispatch arm; in both → flagged too);
+* every classified verb must be dispatched (a stale set entry is
+  flagged at the set);
+* both frozensets must exist next to the servicer (a missing
+  ``MUTATION_METHODS`` is flagged once, at ``IDEMPOTENT_METHODS``).
+
+The runtime side enforces the same thing: ``RpcClient.call`` raises on
+an unclassified verb instead of guessing. Fix pattern: classify the
+verb where you add its dispatch arm — reads retry, mutations don't.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from paddle_tpu.analysis.registry import Finding, register
+
+_DOC = __doc__
+
+
+def _literal_set(tree: ast.AST, name: str) -> Optional[Dict[str, ast.AST]]:
+    """Module-level ``name = frozenset({...})`` string members."""
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name) and \
+                st.targets[0].id == name:
+            out: Dict[str, ast.AST] = {}
+            for n in ast.walk(st.value):
+                if isinstance(n, ast.Constant) and \
+                        isinstance(n.value, str):
+                    out.setdefault(n.value, n)
+            return out
+    return None
+
+
+def _dispatch_verbs(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """Verb literals the ``_dispatch`` chain compares ``method``
+    against (``if method == "verb":`` arms and ``in (...)`` tests)."""
+    verbs: Dict[str, ast.AST] = {}
+    for fn in cls.body:
+        if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "_dispatch"):
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Compare):
+                continue
+            names = {d for d in (
+                x.id for x in ast.walk(n) if isinstance(x, ast.Name))}
+            if "method" not in names:
+                continue
+            for cmp in [n.left, *n.comparators]:
+                for c in ast.walk(cmp):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, str):
+                        verbs.setdefault(c.value, c)
+    return verbs
+
+
+@register(
+    "rpc-verb-unclassified",
+    "servicer verb missing from the idempotent/mutation partition",
+    _DOC)
+def check(module) -> List[Finding]:
+    servicers = [c for c in ast.walk(module.tree)
+                 if isinstance(c, ast.ClassDef)
+                 and c.name.endswith("Servicer")
+                 and any(isinstance(f, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                         and f.name == "_dispatch" for f in c.body)]
+    if not servicers:
+        return []
+    idem = _literal_set(module.tree, "IDEMPOTENT_METHODS")
+    mut = _literal_set(module.tree, "MUTATION_METHODS")
+    out: List[Finding] = []
+    if idem is None:
+        # no partition at all: anchor once per servicer
+        for cls in servicers:
+            out.append(module.finding(
+                "rpc-verb-unclassified", cls,
+                f"{cls.name} dispatches RPC verbs but the module "
+                f"defines no IDEMPOTENT_METHODS frozenset — the retry "
+                f"policy has nothing to partition"))
+        return out
+    if mut is None:
+        anchor = next(iter(idem.values()), servicers[0])
+        out.append(module.finding(
+            "rpc-verb-unclassified", anchor,
+            "IDEMPOTENT_METHODS exists but MUTATION_METHODS does not — "
+            "the partition is one-sided, so an unlisted verb still "
+            "silently defaults to non-retried; define the explicit "
+            "mutation set"))
+        mut = {}
+    dispatched: Set[str] = set()
+    for cls in servicers:
+        verbs = _dispatch_verbs(cls)
+        dispatched |= set(verbs)
+        for verb, node in sorted(verbs.items()):
+            if verb in idem and verb in mut:
+                out.append(module.finding(
+                    "rpc-verb-unclassified", node,
+                    f"verb '{verb}' is in BOTH IDEMPOTENT_METHODS and "
+                    f"MUTATION_METHODS — the retry partition must be "
+                    f"disjoint"))
+            elif verb not in idem and verb not in mut:
+                out.append(module.finding(
+                    "rpc-verb-unclassified", node,
+                    f"verb '{verb}' is dispatched by {cls.name} but "
+                    f"classified in neither IDEMPOTENT_METHODS nor "
+                    f"MUTATION_METHODS — it would silently default; "
+                    f"add it to exactly one (reads retry, mutations "
+                    f"get one attempt)"))
+    for name, table in (("IDEMPOTENT_METHODS", idem),
+                        ("MUTATION_METHODS", mut)):
+        for verb, node in sorted(table.items()):
+            if verb not in dispatched:
+                out.append(module.finding(
+                    "rpc-verb-unclassified", node,
+                    f"{name} entry '{verb}' matches no _dispatch arm "
+                    f"in any servicer here — a stale classification "
+                    f"masks the next unclassified-verb failure"))
+    return out

@@ -203,7 +203,7 @@ def _write_data(path: str, arrays: Dict, tensors_meta: Dict,
         with open(obj_file, "w") as f:
             json.dump(objects, f)
         _fsync_path(obj_file)
-    _faults.fire("ckpt.data_written")
+    _faults.fire(_faults.CKPT_DATA_WRITTEN)
     if pcount == 1:
         Metadata(tensors_meta).save(os.path.join(path, _META_FILE))
         _fsync_path(os.path.join(path, _META_FILE))
@@ -278,7 +278,7 @@ def save_state_dict(state_dict: Dict, path: str):
         _default_barrier(f"ckpt_stage:{path}")
     _write_data(tmp, arrays, tensors_meta, data_file, objects=objects)
     if pidx == 0:
-        _faults.fire("ckpt.before_commit")
+        _faults.fire(_faults.CKPT_BEFORE_COMMIT)
         if os.path.isdir(path):
             os.rename(path, old)  # keep the old ckpt whole until the end
         os.replace(tmp, path)

@@ -307,7 +307,7 @@ class CheckpointManager:
             _write_data(tmp, arrays, tensors_meta, data_file,
                         barrier=tagged, objects=objects)
         if pidx == 0:
-            _faults.fire("ckpt.before_commit")
+            _faults.fire(_faults.CKPT_BEFORE_COMMIT)
             aside = final + ".old"
             if os.path.isdir(final):
                 if self._is_committed(final):
@@ -324,7 +324,7 @@ class CheckpointManager:
                     # drop only the torn dir, never the parked bytes
                     shutil.rmtree(final, ignore_errors=True)
             os.replace(tmp, final)
-            _faults.fire("ckpt.before_marker")
+            _faults.fire(_faults.CKPT_BEFORE_MARKER)
             # marker last: its presence certifies every byte before it
             marker = os.path.join(final, COMMITTED)
             marker_tmp = marker + ".tmp"
@@ -337,7 +337,7 @@ class CheckpointManager:
             _fsync_path(final)
             _fsync_path(self._root)
             shutil.rmtree(aside, ignore_errors=True)
-            _faults.fire("ckpt.committed")
+            _faults.fire(_faults.CKPT_COMMITTED)
         if tagged is not None:
             tagged(f"{step}_done")
         elif jax.process_count() > 1:
@@ -410,7 +410,7 @@ class CheckpointManager:
                     pass  # no hard links here: dedupe quietly degrades
         with self._cas_lock:
             self.last_cas_hits = cas_hits
-        _faults.fire("ckpt.data_written")
+        _faults.fire(_faults.CKPT_DATA_WRITTEN)
         meta = {
             name: TensorMetadata(tm.global_shape, tm.dtype, [
                 LocalTensorMetadata(c.global_offset, c.local_shape,

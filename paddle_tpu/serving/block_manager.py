@@ -749,8 +749,8 @@ class BlockManager:
         # (`serving.force_oom.<request_id>`) makes this growth OOM
         # exactly like a genuinely exhausted free list, so
         # preemption/swap paths are testable with a roomy cache
-        if faults.check("serving.force_oom") or \
-                faults.check(f"serving.force_oom.{request_id}"):
+        if faults.check(faults.SERVING_FORCE_OOM) or \
+                faults.check(f"{faults.SERVING_FORCE_OOM}.{request_id}"):
             raise NoFreeBlocksError(
                 f"request {request_id!r}: injected OOM "
                 f"(PADDLE_FAULTS serving.force_oom)")

@@ -1134,7 +1134,7 @@ class LLMEngine:
             # partial-failure cleanup: blocks are allocated but nothing
             # is registered yet — a scatter fault must not leak them
             # (the fault point stands in for a device OOM/transfer error)
-            faults.fire("serving.kv_scatter")
+            faults.fire(faults.SERVING_KV_SCATTER)
             k_np = self._land_wire(payload, 0, src_layout, want_shape,
                                    dtype)
             v_np = self._land_wire(payload, k_bytes, src_layout,
@@ -1269,7 +1269,7 @@ class LLMEngine:
         try:
             # same partial-failure discipline as import_kv: a scatter
             # fault after allocation frees the synthetic claim whole
-            faults.fire("serving.kv_scatter")
+            faults.fire(faults.SERVING_KV_SCATTER)
             k_np = self._land_wire(payload, 0, src_layout, want_shape,
                                    dtype)
             v_np = self._land_wire(payload, k_bytes, src_layout,
@@ -1862,7 +1862,7 @@ class LLMEngine:
 
                     eid = self._watchdog.arm(
                         tag, factor=COMPILE_ALLOWANCE if cold else 1.0)
-                faults.fire("serving.step")  # slow/raise/sigterm point
+                faults.fire(faults.SERVING_STEP)  # slow/raise/sigterm point
                 if self._ragged and self._kvtier is not None:
                     packed, finite, kcs, vcs = self._jstep_ragged(
                         [p._data for p in self._params],
@@ -1943,7 +1943,7 @@ class LLMEngine:
         if not self.cfg.nonfinite_guard:
             return set()
         poisoned = set()
-        for arg in faults.check("serving.nan_logits"):
+        for arg in faults.check(faults.SERVING_NAN_LOGITS):
             for i, r in enumerate(reqs):
                 if arg in (None, "", str(i), r.request_id):
                     poisoned.add(i)  # as-if this row's logits went NaN

@@ -178,7 +178,7 @@ class LeaseStore:
         the tokens carried in ``updates`` (renew-before-emit). False
         means the caller is fenced — or the write was dropped, which the
         caller must treat identically: self-fence, emit nothing."""
-        if faults.check("fleet.lease_expire", key=rid):
+        if faults.check(faults.FLEET_LEASE_EXPIRE, key=rid):
             self.num_renew_dropped += 1
             return False
         cur = self._load(rid)

@@ -45,7 +45,8 @@ class FleetMetrics:
               "sessions_tracked", "router_failovers",
               "requests_fenced", "requests_handed_over",
               "leases_acquired", "leases_completed",
-              "leases_adopted", "leases_expired", "leases_active")
+              "leases_adopted", "leases_expired", "leases_active",
+              "lease_fence_refusals", "lease_renew_dropped")
 
     _ROUTER_GAUGES = {
         "dispatched": lambda r: r.num_dispatched,
@@ -119,6 +120,13 @@ class FleetMetrics:
             r.lease_store.num_expired if r.lease_store else 0),
         "leases_active": lambda r: (
             r.lease_store.active() if r.lease_store else 0),
+        # fencing-side refusals: stale-incarnation mutations turned
+        # away, and renewals dropped after ownership moved (the PR 18
+        # split-brain guards, previously bumped but never surfaced)
+        "lease_fence_refusals": lambda r: (
+            r.lease_store.num_fence_refusals if r.lease_store else 0),
+        "lease_renew_dropped": lambda r: (
+            r.lease_store.num_renew_dropped if r.lease_store else 0),
     }
 
     def __init__(self, router):

@@ -657,7 +657,7 @@ class FleetRouter:
         outputs (hand-offs emit nothing — the request continues)."""
         if self.lease_store is not None:
             if not self.router_dead and faults.check(
-                    "fleet.router_kill", key=self.router_id):
+                    faults.FLEET_ROUTER_KILL, key=self.router_id):
                 # in-process SIGKILL: this router goes silent NOW — no
                 # farewell beat, no lease release, nothing emitted again
                 self.router_dead = True
@@ -724,18 +724,18 @@ class FleetRouter:
 
     # -- internals --------------------------------------------------------
     def _fire_fault_points(self, outputs: List[RequestOutput]) -> None:
-        for arg in faults.check("fleet.kill_replica"):
+        for arg in faults.check(faults.FLEET_KILL_REPLICA):
             h = self._fault_target(arg)
             if h is not None:
                 self.kill_replica(h.replica_id, "fault", outputs)
-        for arg in faults.check("fleet.drain_replica"):
+        for arg in faults.check(faults.FLEET_DRAIN_REPLICA):
             h = self._fault_target(arg)
             if h is not None:
                 for out in h.start_drain("fault"):
                     self._handle_output(h, out, outputs)
-        for arg in faults.check("fleet.slow_replica"):
+        for arg in faults.check(faults.FLEET_SLOW_REPLICA):
             time.sleep(float(arg) if arg else 0.01)
-        for arg in faults.check("fleet.worker_kill"):
+        for arg in faults.check(faults.FLEET_WORKER_KILL):
             h = self._fault_target(arg)
             hard_kill = getattr(h, "hard_kill", None)
             if callable(hard_kill):
@@ -906,7 +906,7 @@ class FleetRouter:
             owner_dead = owner not in live
             orphan = bool(rec.get("orphan"))
             steal = (not owner_dead and not rec["stale"]
-                     and bool(faults.check("fleet.lease_steal",
+                     and bool(faults.check(faults.FLEET_LEASE_STEAL,
                                            key=rid)))
             if not (owner_dead or orphan or rec["stale"] or steal):
                 continue
@@ -1364,11 +1364,11 @@ class FleetRouter:
             kv = handle.export_prefix(chain_hash)
         except (KeyError, ValueError, OSError):
             kv = None
-        if kv is not None and faults.check("fleet.prefix_ship_drop"):
+        if kv is not None and faults.check(faults.FLEET_PREFIX_SHIP_DROP):
             kv = None
         if kv is None:
             return None
-        if faults.check("fleet.prefix_ship_corrupt"):
+        if faults.check(faults.FLEET_PREFIX_SHIP_CORRUPT):
             # flip one payload byte: the import side's CRC check
             # rejects it and the destination stays cold
             meta, payload = kv
@@ -1429,7 +1429,7 @@ class FleetRouter:
             # the fallback, "stay cold" as the harmless floor
             if (cfg.peer_data_plane
                     and getattr(dst, "peer_endpoint", None)):
-                ticket = self._issue_ticket(
+                ticket = self._issue_ticket(  # tpulint: disable=leaked-resource-on-raise (every ladder walk ends in exactly one counted outcome — peer above, relay/cold in the fallback rungs below; handle RPCs return None on transport errors rather than raising)
                     src, dst, "prefix", ch, cfg.peer_deadline_s * 1e3)
                 receipt = src.peer_send(ticket, dst.peer_endpoint)
                 if receipt is not None and dst.peer_commit(
@@ -1561,7 +1561,7 @@ class FleetRouter:
         ticket = None
         if (self.cfg.peer_data_plane
                 and getattr(dst, "peer_endpoint", None)):
-            ticket = self._issue_ticket(
+            ticket = self._issue_ticket(  # tpulint: disable=leaked-resource-on-raise (every session-ship walk ends in exactly one counted outcome — peer/relay above, the explicit cold floor below; handle RPCs return None on transport errors rather than raising)
                 src, dst, "prefix", ch, self.cfg.peer_deadline_s * 1e3)
             receipt = src.peer_send(ticket, dst.peer_endpoint)
             if receipt is not None and dst.peer_commit(
@@ -1625,21 +1625,21 @@ class FleetRouter:
         that never ran has nothing to ship and is not a fallback.
         ``count_fallback=False`` leaves ALL fallback accounting to the
         caller (the ticket ladder does its own single-point counting)."""
-        for arg in faults.check("fleet.kv_ship_delay"):
+        for arg in faults.check(faults.FLEET_KV_SHIP_DELAY):
             time.sleep(float(arg) if arg else 0.01)
         try:
             kv = handle.export_kv(request_id)
         except (KeyError, ValueError, OSError):
             kv = None
         dropped = kv is not None and bool(
-            faults.check("fleet.kv_ship_drop"))
+            faults.check(faults.FLEET_KV_SHIP_DROP))
         if dropped:
             kv = None
         if kv is None:
             if count_fallback and (expected or dropped):
                 self.num_recompute_fallbacks += 1
             return None
-        if faults.check("fleet.kv_ship_corrupt"):
+        if faults.check(faults.FLEET_KV_SHIP_CORRUPT):
             # flip one payload byte: the import side's CRC check
             # rejects it and the dispatch falls back to recompute
             meta, payload = kv
@@ -1758,7 +1758,7 @@ class FleetRouter:
         receipt: Optional[dict] = None
         if (self.cfg.peer_data_plane and src is not None and src.alive
                 and getattr(dst, "peer_endpoint", None)):
-            ticket = self._issue_ticket(
+            ticket = self._issue_ticket(  # tpulint: disable=leaked-resource-on-raise (a ticketed KV walk always reaches the tail's `ticket_outcomes[outcome] += 1` — outcome defaults to the recompute floor; handle RPCs return None on transport errors rather than raising)
                 src, dst, "kv", rid, self._rung_deadline_ms(fr, now))
             t0 = time.monotonic()
             receipt = src.peer_send(ticket, dst.peer_endpoint)

@@ -262,7 +262,7 @@ class SimReplica(ReplicaHandle):
             cost += self.latency.decode_step_s * math.ceil(
                 decoded / max(1, self.max_seqs))
         self.last_cost = cost
-        self.num_steps += 1
+        self.num_steps += 1  # tpulint: disable=counter-snapshot-drift (per-tick work flag the sim loop itself reads and resets to pace stepping — not a lifetime counter)
         return outs
 
     def start_drain(self, reason: str = "manual") -> List[RequestOutput]:

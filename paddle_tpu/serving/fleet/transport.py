@@ -85,7 +85,7 @@ __all__ = [
     "RpcClient", "ReplicaServicer", "SubprocessReplica",
     "connect_replica",
     "send_frame", "recv_frame", "send_frame_with_blob",
-    "IDEMPOTENT_METHODS", "DEFAULT_DEADLINES",
+    "IDEMPOTENT_METHODS", "MUTATION_METHODS", "DEFAULT_DEADLINES",
     "PeerListener", "peer_push", "peer_secret", "sign_ticket",
 ]
 
@@ -381,12 +381,12 @@ def peer_push(endpoint: str, ticket: dict, meta: dict, payload: bytes,
     (sleep action — a stall that outlives ``timeout_s`` fails the
     push before any bytes move)."""
     t0 = time.monotonic()
-    if faults.check("fleet.peer_connect_fail"):
+    if faults.check(faults.FLEET_PEER_CONNECT_FAIL):
         raise OSError(f"peer connect to {endpoint} refused (injected)")
-    faults.fire("fleet.peer_stall")
-    if faults.check("fleet.peer_send_drop"):
+    faults.fire(faults.FLEET_PEER_STALL)
+    if faults.check(faults.FLEET_PEER_SEND_DROP):
         raise OSError(f"peer frame to {endpoint} dropped (injected)")
-    if faults.check("fleet.peer_frame_corrupt") and payload:
+    if faults.check(faults.FLEET_PEER_FRAME_CORRUPT) and payload:
         buf = bytearray(payload)
         buf[0] ^= 0xFF  # CRC refusal at the listener's door
         payload = bytes(buf)
@@ -434,9 +434,22 @@ class RpcRemoteError(RpcError):
 IDEMPOTENT_METHODS = frozenset({
     "ping", "admission_verdict", "estimated_ttft_ms", "load",
     "is_draining", "drained", "has_unfinished", "rng_state", "snapshot",
-    "export_kv", "prefix_digest", "export_prefix",
+    "export_kv", "prefix_digest", "export_prefix", "tier_stats",
     # re-asserting a lease generation is a no-op (max-register update)
     "fence_request",
+})
+
+# replica-side effects: exactly one attempt — a retry after a lost
+# reply could double-apply (double admit, double abort, a step run
+# twice, a staged peer payload committed twice). Together with
+# IDEMPOTENT_METHODS this is a total partition of the servicer verb
+# table; RpcClient.call refuses a verb in neither set so a new verb
+# must be classified where its dispatch arm is added.
+MUTATION_METHODS = frozenset({
+    "add_request", "abort_request", "release_request", "step",
+    "start_drain", "import_kv", "import_prefix", "park_kv",
+    "drop_parked", "peer_send", "peer_commit", "park_session",
+    "resume_session", "drop_session", "adopt_session", "shutdown",
 })
 
 # per-method deadline overrides: step/start_drain cover the engine's
@@ -548,7 +561,18 @@ class RpcClient:
         KV-ship payload path); a blob-carrying reply is attached to a
         dict result under ``_blob``."""
         if idempotent is None:
-            idempotent = method in IDEMPOTENT_METHODS
+            if method in IDEMPOTENT_METHODS:
+                idempotent = True
+            elif method in MUTATION_METHODS:
+                idempotent = False
+            else:
+                # an unclassified verb must not silently pick a retry
+                # policy — the tier_stats regression class
+                raise RpcError(
+                    f"RPC verb {method!r} is in neither "
+                    f"IDEMPOTENT_METHODS nor MUTATION_METHODS — "
+                    f"classify it where its dispatch arm is defined "
+                    f"(reads retry, mutations get one attempt)")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         attempts = (self.retries + 1) if idempotent else 1
@@ -572,8 +596,8 @@ class RpcClient:
     def _call_once(self, method: str, params: dict,
                    deadline_s: float,
                    blob: Optional[bytes] = None) -> Any:
-        faults.fire("fleet.rpc_delay")
-        if faults.check("fleet.rpc_drop"):
+        faults.fire(faults.FLEET_RPC_DELAY)
+        if faults.check(faults.FLEET_RPC_DROP):
             self.stats["timeouts"] += 1
             raise RpcTimeout(f"{method}: frame dropped (injected)")
         with self._lock:

@@ -329,11 +329,18 @@ class TieredKVStore:
             bm.free(request_id)   # the empty claim must not linger
             self.num_resume_recomputes += 1
         elif tail_block is not None:
-            eng._kcs = eng._kcs.at[:, [tail_block]].set(
-                eng.kv_layout.unshard_frames(rec.tail_k))
-            eng._vcs = eng._vcs.at[:, [tail_block]].set(
-                eng.kv_layout.unshard_frames(rec.tail_v))
-            eng._pin_caches()
+            try:
+                eng._kcs = eng._kcs.at[:, [tail_block]].set(
+                    eng.kv_layout.unshard_frames(rec.tail_k))
+                eng._vcs = eng._vcs.at[:, [tail_block]].set(
+                    eng.kv_layout.unshard_frames(rec.tail_v))
+                eng._pin_caches()
+            except Exception:
+                # a failed tail restore must not strand the resumed
+                # claim: free the whole chain before the error
+                # propagates (the session record stays for a retry)
+                bm.free(request_id)
+                raise
         self.num_park_resumes += 1
         self.num_resume_recomputed_tokens += max(0, covered - hit)
         self.sessions.pop(session_id, None)
@@ -392,6 +399,8 @@ class TieredKVStore:
             "sessions": len(self.sessions),
             "parks": self.num_parks,
             "park_resumes": self.num_park_resumes,
+            "resume_recomputes": self.num_resume_recomputes,
+            "resume_recomputed_tokens": self.num_resume_recomputed_tokens,
             "peer_blocks": self.peer_blocks,
         })
         return st

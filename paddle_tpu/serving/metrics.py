@@ -48,9 +48,10 @@ class ServingMetrics:
               "rejected", "step_retries", "poisoned_aborts",
               "drain_started", "drain_aborted", "drain_completed",
               # ragged hot path (ISSUE 9): attention-path padding waste
-              # plus prefix-cache and chunked-prefill traffic
+              # plus prefix-cache, copy-on-write, and chunked-prefill
+              # traffic
               "padded_token_frac", "prefix_cache_hits",
-              "prefix_cache_hit_tokens", "prefill_chunks",
+              "prefix_cache_hit_tokens", "cow_copies", "prefill_chunks",
               # in-graph sampling + speculative decoding (ISSUE 11):
               # draft proposal/acceptance traffic and sampled-step count
               "spec_proposed", "spec_accepted", "spec_acceptance_rate",
@@ -94,6 +95,7 @@ class ServingMetrics:
         "prefix_cache_hits": lambda eng: eng.block_manager.num_prefix_hits,
         "prefix_cache_hit_tokens":
             lambda eng: eng.block_manager.num_prefix_hit_tokens,
+        "cow_copies": lambda eng: eng.block_manager.num_cow_copies,
         "prefill_chunks": lambda eng: eng.scheduler.num_prefill_chunks,
         "spec_proposed": lambda eng: eng.num_spec_proposed,
         "spec_accepted": lambda eng: eng.num_spec_accepted,

@@ -233,8 +233,17 @@ class Scheduler:
             # copy NOW: the freed device blocks' bytes are intact until
             # the next compiled step writes them, and nothing dispatches
             # before schedule() returns
-            self.kv_swapper.copy_out(victim, dev, host)
-            victim.swap_out()
+            try:
+                self.kv_swapper.copy_out(victim, dev, host)
+                victim.swap_out()
+            except Exception:
+                # a torn spill copy must not strand the host slots:
+                # drop them and demote to the recompute path (nothing
+                # was emitted, so the prompt replays exactly)
+                self.block_manager.free_host(victim.request_id)
+                victim.preempt()
+                self.waiting.appendleft(victim)
+                return
             self.swapped.append(victim)
             self.num_swap_outs += 1
         else:

@@ -58,10 +58,77 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "FAULT_EXIT", "Fault", "FaultInjector", "fire", "check", "install",
-    "clear", "injected", "active_injector", "tear_file", "child_pids",
-    "kill_one_child", "wait_for_path",
+    "FAULT_EXIT", "FAULT_POINTS", "Fault", "FaultInjector", "fire",
+    "check", "install", "clear", "injected", "active_injector",
+    "tear_file", "child_pids", "kill_one_child", "wait_for_path",
+    # registry constants (every production fault point, by name)
+    "SERVING_FORCE_OOM", "SERVING_KV_SCATTER", "SERVING_STEP",
+    "SERVING_NAN_LOGITS", "FLEET_PEER_CONNECT_FAIL", "FLEET_PEER_STALL",
+    "FLEET_PEER_SEND_DROP", "FLEET_PEER_FRAME_CORRUPT",
+    "FLEET_RPC_DELAY", "FLEET_RPC_DROP", "FLEET_KILL_REPLICA",
+    "FLEET_DRAIN_REPLICA", "FLEET_SLOW_REPLICA", "FLEET_WORKER_KILL",
+    "FLEET_ROUTER_KILL", "FLEET_LEASE_STEAL", "FLEET_LEASE_EXPIRE",
+    "FLEET_PREFIX_SHIP_DROP", "FLEET_PREFIX_SHIP_CORRUPT",
+    "FLEET_KV_SHIP_DELAY", "FLEET_KV_SHIP_DROP", "FLEET_KV_SHIP_CORRUPT",
+    "CKPT_BEFORE_COMMIT", "CKPT_BEFORE_MARKER", "CKPT_COMMITTED",
+    "CKPT_DATA_WRITTEN",
 ]
+
+# -- the fault-point registry ----------------------------------------------
+# Every production fault point, as a named constant: call sites reference
+# these (the ``fault-point-literal`` lint rule enforces it), so a typo'd
+# point can never silently stop firing, and the registry is the one list
+# a coverage check can walk. Keyed points compose as f-strings LED by the
+# constant: ``f"{faults.SERVING_FORCE_OOM}.{request_id}"``.
+
+# serving engine (in-process data faults)
+SERVING_FORCE_OOM = "serving.force_oom"        # keyed: .<request_id>
+SERVING_KV_SCATTER = "serving.kv_scatter"
+SERVING_STEP = "serving.step"
+SERVING_NAN_LOGITS = "serving.nan_logits"
+
+# fleet transport + peer data plane (per-RPC / per-push)
+FLEET_PEER_CONNECT_FAIL = "fleet.peer_connect_fail"
+FLEET_PEER_STALL = "fleet.peer_stall"
+FLEET_PEER_SEND_DROP = "fleet.peer_send_drop"
+FLEET_PEER_FRAME_CORRUPT = "fleet.peer_frame_corrupt"
+FLEET_RPC_DELAY = "fleet.rpc_delay"
+FLEET_RPC_DROP = "fleet.rpc_drop"
+
+# fleet router (per-step chaos + replicated control plane; the last
+# three are KEYED — see ``check(key=...)``)
+FLEET_KILL_REPLICA = "fleet.kill_replica"
+FLEET_DRAIN_REPLICA = "fleet.drain_replica"
+FLEET_SLOW_REPLICA = "fleet.slow_replica"
+FLEET_WORKER_KILL = "fleet.worker_kill"
+FLEET_ROUTER_KILL = "fleet.router_kill"
+FLEET_LEASE_STEAL = "fleet.lease_steal"
+FLEET_LEASE_EXPIRE = "fleet.lease_expire"
+
+# KV / prefix ship path
+FLEET_PREFIX_SHIP_DROP = "fleet.prefix_ship_drop"
+FLEET_PREFIX_SHIP_CORRUPT = "fleet.prefix_ship_corrupt"
+FLEET_KV_SHIP_DELAY = "fleet.kv_ship_delay"
+FLEET_KV_SHIP_DROP = "fleet.kv_ship_drop"
+FLEET_KV_SHIP_CORRUPT = "fleet.kv_ship_corrupt"
+
+# checkpoint commit protocol
+CKPT_BEFORE_COMMIT = "ckpt.before_commit"
+CKPT_BEFORE_MARKER = "ckpt.before_marker"
+CKPT_COMMITTED = "ckpt.committed"
+CKPT_DATA_WRITTEN = "ckpt.data_written"
+
+FAULT_POINTS = frozenset({
+    SERVING_FORCE_OOM, SERVING_KV_SCATTER, SERVING_STEP,
+    SERVING_NAN_LOGITS, FLEET_PEER_CONNECT_FAIL, FLEET_PEER_STALL,
+    FLEET_PEER_SEND_DROP, FLEET_PEER_FRAME_CORRUPT, FLEET_RPC_DELAY,
+    FLEET_RPC_DROP, FLEET_KILL_REPLICA, FLEET_DRAIN_REPLICA,
+    FLEET_SLOW_REPLICA, FLEET_WORKER_KILL, FLEET_ROUTER_KILL,
+    FLEET_LEASE_STEAL, FLEET_LEASE_EXPIRE, FLEET_PREFIX_SHIP_DROP,
+    FLEET_PREFIX_SHIP_CORRUPT, FLEET_KV_SHIP_DELAY, FLEET_KV_SHIP_DROP,
+    FLEET_KV_SHIP_CORRUPT, CKPT_BEFORE_COMMIT, CKPT_BEFORE_MARKER,
+    CKPT_COMMITTED, CKPT_DATA_WRITTEN,
+})
 
 # exit code for the "crash" action: distinct from every code the runtime
 # uses (watchdog 6, gang-abort 7, launch re-form 75) so tests can assert

@@ -79,11 +79,13 @@ BASELINE=.tpulint-baseline.json
 
 run_lint() {
     echo "== tpulint =="
+    # --stats prints the per-rule finding/suppression table so a CI
+    # log shows WHERE the suppression budget sits, not just "0"
     if [[ -f "$BASELINE" ]]; then
         python -m paddle_tpu.analysis "${LINT_PATHS[@]}" \
-            --baseline "$BASELINE"
+            --baseline "$BASELINE" --stats
     else
-        python -m paddle_tpu.analysis "${LINT_PATHS[@]}"
+        python -m paddle_tpu.analysis "${LINT_PATHS[@]}" --stats
     fi
 }
 

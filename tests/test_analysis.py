@@ -18,6 +18,12 @@ def run(src):
     return analyze_source(textwrap.dedent(src), path="fixture.py")
 
 
+def run_at(src, path):
+    """Path-gated rules (counter-snapshot-drift is serving/fleet-scoped)
+    see whatever path we claim for the fixture."""
+    return analyze_source(textwrap.dedent(src), path=path)
+
+
 def rules_of(findings):
     return [f.rule for f in findings]
 
@@ -29,7 +35,11 @@ def test_rule_catalog_has_all_launch_rules():
             "counter-provider-leak", "block-until-ready-in-loop",
             "unlocked-shared-state", "lock-order-cycle",
             "blocking-under-lock", "signal-handler-unsafe",
-            "collective-divergence", "finish-reason-literal"} <= names
+            "collective-divergence", "finish-reason-literal",
+            "leaked-resource-on-raise", "counter-snapshot-drift",
+            "fault-point-literal", "rpc-verb-unclassified",
+            "unbounded-rpc-deadline"} <= names
+    assert len(names) == 17
     for r in get_rules().values():
         assert r.summary and r.doc  # per-rule docs are part of the API
 
@@ -1570,3 +1580,440 @@ class TestLockcheckBaselineAndCli:
         write_baseline(b1, findings)
         write_baseline(b2, list(reversed(findings)))
         assert open(b1).read() == open(b2).read()
+
+
+# ---------------------------------------------------------------------------
+# leaked-resource-on-raise (flowcheck)
+# ---------------------------------------------------------------------------
+class TestLeakedResource:
+    def test_pr14_import_kv_scatter_leak_flagged(self):
+        """Re-introducing the PR 14 bug — blocks landed, scatter faults,
+        no rollback — must be caught at commit time, not by chaos."""
+        fs = run("""
+            class Engine:
+                def import_kv(self, request_id, blocks, frames):
+                    self.block_manager.import_blocks(request_id, blocks)
+                    self._scatter(frames)
+                    self.sessions[request_id] = blocks
+        """)
+        assert rules_of(fs) == ["leaked-resource-on-raise"]
+        assert "import_blocks" in fs[0].message
+
+    def test_rollback_in_except_then_reraise_clean(self):
+        """The PR 14 FIX shape: release in the handler, re-raise."""
+        fs = run("""
+            class Engine:
+                def import_kv(self, request_id, blocks, frames):
+                    self.block_manager.import_blocks(request_id, blocks)
+                    try:
+                        self._scatter(frames)
+                    except Exception:
+                        self.block_manager.free(request_id)
+                        raise
+                    self.sessions[request_id] = blocks
+        """)
+        assert rules_of(fs) == []
+
+    def test_release_in_finally_clean(self):
+        fs = run("""
+            class Probe:
+                def measure(self, request_id):
+                    self.block_manager.allocate(request_id, 4)
+                    try:
+                        self._touch(request_id)
+                    finally:
+                        self.block_manager.free(request_id)
+        """)
+        assert rules_of(fs) == []
+
+    def test_swallowing_handler_releases_clean(self):
+        fs = run("""
+            class Sched:
+                def admit(self, req):
+                    self.block_manager.allocate(req.request_id, 4)
+                    try:
+                        self._kick()
+                    except Exception:
+                        self.block_manager.free(req.request_id)
+                        return
+                    self.running.append(req)
+        """)
+        assert rules_of(fs) == []
+
+    def test_conditional_release_still_flagged(self):
+        """A release under only one branch does not cover the raise
+        edge — held-on-any-path merging."""
+        fs = run("""
+            class Sched:
+                def admit(self, req, ok):
+                    self.block_manager.allocate(req.request_id, 4)
+                    if ok:
+                        self.block_manager.free(req.request_id)
+                    self._kick()
+        """)
+        assert rules_of(fs) == ["leaked-resource-on-raise"]
+
+    def test_transfer_before_fallible_call_clean(self):
+        fs = run("""
+            class Sched:
+                def admit(self, req):
+                    self.block_manager.allocate(req.request_id, 4)
+                    self.running.append(req)
+                    self._kick()
+        """)
+        assert rules_of(fs) == []
+
+    def test_swap_out_host_slots_pairing(self):
+        fs = run("""
+            class Sched:
+                def evict(self, victim):
+                    self.block_manager.swap_out(victim.request_id, 2)
+                    self._copy(victim)
+                    self.swapped.append(victim)
+        """)
+        assert rules_of(fs) == ["leaked-resource-on-raise"]
+        assert "swap_out" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# counter-snapshot-drift (flowcheck)
+# ---------------------------------------------------------------------------
+class TestCounterDrift:
+    def test_bumped_but_never_read_flagged(self):
+        fs = run_at("""
+            class Sched:
+                def step(self):
+                    self.num_zz_invisible_counter += 1
+        """, "paddle_tpu/serving/fixture.py")
+        assert rules_of(fs) == ["counter-snapshot-drift"]
+        assert "num_zz_invisible_counter" in fs[0].message
+
+    def test_counter_with_real_reader_clean(self):
+        # num_swap_outs is surfaced by the serving metrics layer
+        fs = run_at("""
+            class Sched:
+                def step(self):
+                    self.num_swap_outs += 1
+        """, "paddle_tpu/serving/fixture.py")
+        assert rules_of(fs) == []
+
+    def test_out_of_scope_module_ignored(self):
+        fs = run_at("""
+            class Opt:
+                def step(self):
+                    self.num_zz_invisible_counter += 1
+        """, "paddle_tpu/optimizer/fixture.py")
+        assert rules_of(fs) == []
+
+    def test_gauge_without_getter_flagged(self):
+        fs = run_at("""
+            class M:
+                GAUGES = ("good", "orphan")
+                _E_GAUGES = {"good": lambda e: e.num_swap_outs}
+        """, "paddle_tpu/serving/fixture_metrics.py")
+        assert rules_of(fs) == ["counter-snapshot-drift"]
+        assert "orphan" in fs[0].message
+
+    def test_getter_key_missing_from_gauges_flagged(self):
+        fs = run_at("""
+            class M:
+                GAUGES = ("good",)
+                _E_GAUGES = {"good": lambda e: e.num_swap_outs,
+                             "stray": lambda e: e.num_swap_outs}
+        """, "paddle_tpu/serving/fixture_metrics.py")
+        assert rules_of(fs) == ["counter-snapshot-drift"]
+        assert "stray" in fs[0].message
+
+    def test_ghost_gauge_flagged(self):
+        fs = run_at("""
+            class M:
+                GAUGES = ("g",)
+                _E_GAUGES = {"g": lambda e: e.num_zz_ghost_counter}
+        """, "paddle_tpu/serving/fixture_metrics.py")
+        assert rules_of(fs) == ["counter-snapshot-drift"]
+        assert "never assigned" in fs[0].message
+
+    def test_coherent_metrics_class_clean(self):
+        fs = run_at("""
+            class M:
+                GAUGES = ("g", "chain")
+                _E_GAUGES = {"g": lambda e: e.num_swap_outs}
+
+                def provider(self, name):
+                    if name == "chain":
+                        return 0
+        """, "paddle_tpu/serving/fixture_metrics.py")
+        assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# fault-point-literal (flowcheck)
+# ---------------------------------------------------------------------------
+class TestFaultPointLiteral:
+    def test_raw_literal_call_site_flagged(self):
+        fs = run("""
+            from paddle_tpu.testing import faults
+
+            class Engine:
+                def step(self):
+                    faults.fire("serving.step")
+        """)
+        assert rules_of(fs) == ["fault-point-literal"]
+        assert "serving.step" in fs[0].message
+
+    def test_literal_led_fstring_flagged(self):
+        fs = run("""
+            from paddle_tpu.testing import faults
+
+            class BM:
+                def allocate(self, request_id):
+                    faults.check(f"serving.force_oom.{request_id}")
+        """)
+        assert rules_of(fs) == ["fault-point-literal"]
+
+    def test_registry_constant_forms_clean(self):
+        fs = run("""
+            from paddle_tpu.testing import faults
+
+            class Engine:
+                def step(self, request_id):
+                    faults.fire(faults.SERVING_STEP)
+                    faults.check(
+                        f"{faults.SERVING_FORCE_OOM}.{request_id}")
+        """)
+        assert rules_of(fs) == []
+
+    def test_unrelated_fire_method_clean(self):
+        fs = run("""
+            class Trigger:
+                def pull(self):
+                    self.gun.fire("bang")
+        """)
+        assert rules_of(fs) == []
+
+    def test_unreferenced_registry_point_flagged(self):
+        """Direction 2: a FAULT_POINTS member no test or script ever
+        mentions is dead chaos surface."""
+        # the coverage corpus includes THIS file, so the dead point's
+        # name is assembled at runtime to keep it out of the corpus
+        dead = "zz.nobody_" + "ever_installs"
+        fs = run(f"""
+            ZZ = "{dead}"
+            OK = "fleet.slow_replica"
+            FAULT_POINTS = frozenset({{ZZ, OK}})
+        """)
+        assert rules_of(fs) == ["fault-point-literal"]
+        assert dead in fs[0].message
+
+    def test_covered_registry_clean(self):
+        fs = run("""
+            A = "fleet.slow_replica"
+            B = "ckpt.committed"
+            FAULT_POINTS = frozenset({A, B})
+        """)
+        assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-verb-unclassified (flowcheck)
+# ---------------------------------------------------------------------------
+SERVICER_HEAD = """
+    IDEMPOTENT_METHODS = frozenset({"ping"})
+    MUTATION_METHODS = frozenset({"step"})
+
+    class WorkerServicer:
+        def _dispatch(self, method, args):
+            if method == "ping":
+                return "pong"
+            if method == "step":
+                return self.eng.step()
+"""
+
+
+class TestRpcVerbUnclassified:
+    def test_unclassified_dispatch_arm_flagged(self):
+        # the PR 19 tier_stats shape: dispatched, classified nowhere
+        fs = run(SERVICER_HEAD + """\
+                if method == "tier_stats":
+                    return self.eng.stats()
+        """)
+        assert rules_of(fs) == ["rpc-verb-unclassified"]
+        assert "tier_stats" in fs[0].message
+
+    def test_total_partition_clean(self):
+        fs = run(SERVICER_HEAD)
+        assert rules_of(fs) == []
+
+    def test_verb_in_both_sets_flagged(self):
+        fs = run("""
+            IDEMPOTENT_METHODS = frozenset({"ping", "step"})
+            MUTATION_METHODS = frozenset({"step"})
+
+            class WorkerServicer:
+                def _dispatch(self, method, args):
+                    if method == "ping":
+                        return "pong"
+                    if method == "step":
+                        return self.eng.step()
+        """)
+        assert rules_of(fs) == ["rpc-verb-unclassified"]
+        assert "BOTH" in fs[0].message
+
+    def test_stale_set_entry_flagged(self):
+        fs = run("""
+            IDEMPOTENT_METHODS = frozenset({"ping", "vanished"})
+            MUTATION_METHODS = frozenset()
+
+            class WorkerServicer:
+                def _dispatch(self, method, args):
+                    if method == "ping":
+                        return "pong"
+        """)
+        assert rules_of(fs) == ["rpc-verb-unclassified"]
+        assert "vanished" in fs[0].message
+
+    def test_one_sided_partition_flagged(self):
+        fs = run("""
+            IDEMPOTENT_METHODS = frozenset({"ping"})
+
+            class WorkerServicer:
+                def _dispatch(self, method, args):
+                    if method == "ping":
+                        return "pong"
+        """)
+        assert rules_of(fs) == ["rpc-verb-unclassified"]
+        assert "one-sided" in fs[0].message
+
+    def test_module_without_servicer_clean(self):
+        fs = run("""
+            IDEMPOTENT_METHODS = frozenset({"stale_but_unchecked"})
+
+            class Plain:
+                def _dispatch(self, method, args):
+                    return None
+        """)
+        # Plain is not a *Servicer: the rule stays out of non-RPC code
+        assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# unbounded-rpc-deadline (flowcheck)
+# ---------------------------------------------------------------------------
+class TestRpcDeadline:
+    def test_call_without_deadline_flagged(self):
+        fs = run("""
+            class Handle:
+                def ping(self):
+                    return self.client.call("ping", {})
+        """)
+        assert rules_of(fs) == ["unbounded-rpc-deadline"]
+        assert "deadline_s" in fs[0].message
+
+    def test_call_with_deadline_clean(self):
+        fs = run("""
+            class Handle:
+                def ping(self):
+                    return self.client.call("ping", {}, deadline_s=5.0)
+        """)
+        assert rules_of(fs) == []
+
+    def test_splat_kwargs_clean(self):
+        fs = run("""
+            class Handle:
+                def ping(self, **kw):
+                    return self.rpc_client.call("ping", {}, **kw)
+        """)
+        assert rules_of(fs) == []
+
+    def test_non_client_receiver_clean(self):
+        fs = run("""
+            class Handle:
+                def ping(self):
+                    return self.conn.call("ping", {})
+        """)
+        assert rules_of(fs) == []
+
+    def test_ticket_without_deadline_ms_flagged(self):
+        fs = run("""
+            class Router:
+                def ship(self, src, dst, rid):
+                    return self._issue_ticket(src, dst, rid)
+        """)
+        assert rules_of(fs) == ["unbounded-rpc-deadline"]
+        assert "deadline_ms" in fs[0].message
+
+    def test_ticket_with_deadline_ms_clean(self):
+        fs = run("""
+            class Router:
+                def ship(self, src, dst, rid):
+                    return self._issue_ticket(
+                        src, dst, rid,
+                        deadline_ms=self._rung_deadline_ms(1))
+        """)
+        assert rules_of(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# flowcheck rules through the CLI: --only, baseline, github, --stats
+# ---------------------------------------------------------------------------
+LEAKY = textwrap.dedent("""\
+    class Engine:
+        def import_kv(self, request_id, blocks, frames):
+            self.block_manager.import_blocks(request_id, blocks)
+            self._scatter(frames)
+            self.sessions[request_id] = blocks
+""")
+
+
+class TestFlowcheckCli:
+    def test_only_selects_flowcheck_rule(self, tmp_path, capsys):
+        p = tmp_path / "leaky.py"
+        p.write_text(LEAKY + "\nimport jax\n\n@jax.jit\ndef f(x):\n"
+                     "    return x.item()\n")
+        assert cli_main([str(p), "--only",
+                         "leaked-resource-on-raise"]) == 1
+        out = capsys.readouterr().out
+        assert "leaked-resource-on-raise" in out
+        assert "host-sync-in-traced" not in out
+
+    def test_baseline_roundtrip_flowcheck(self, tmp_path, capsys):
+        p = tmp_path / "leaky.py"
+        p.write_text(LEAKY)
+        base = str(tmp_path / "b.json")
+        assert cli_main([str(p), "--baseline", base,
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli_main([str(p), "--baseline", base]) == 0
+        # a new leak is NOT absorbed by the old baseline
+        p.write_text(LEAKY + textwrap.dedent("""\
+
+            class Probe:
+                def grab(self, request_id):
+                    self.block_manager.allocate(request_id, 4)
+                    self._touch(request_id)
+        """))
+        assert cli_main([str(p), "--baseline", base]) == 1
+
+    def test_github_format_annotations(self, tmp_path, capsys):
+        p = tmp_path / "leaky.py"
+        p.write_text(LEAKY)
+        assert cli_main([str(p), "--format=github"]) == 1
+        out = capsys.readouterr().out
+        assert f"::error file={p}," in out
+        assert "::leaked-resource-on-raise:" in out
+        assert "line=3," in out
+
+    def test_stats_counts_suppressions(self, tmp_path, capsys):
+        p = tmp_path / "leaky.py"
+        p.write_text(LEAKY.replace(
+            "self.block_manager.import_blocks(request_id, blocks)",
+            "self.block_manager.import_blocks(request_id, blocks)"
+            "  # tpulint: disable=leaked-resource-on-raise (fixture)"))
+        assert cli_main([str(p), "--stats"]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+        assert "leaked-resource-on-raise" in out
+        # the table row shows 0 findings / 1 suppression
+        row = [ln for ln in out.splitlines()
+               if ln.startswith("leaked-resource-on-raise")][0]
+        assert row.split()[-2:] == ["0", "1"]

@@ -38,6 +38,25 @@ def test_fault_spec_parsing():
         faults.Fault.parse("nonsense")
 
 
+def test_fault_point_registry_total_and_covered():
+    """The FAULT_POINTS registry contract: every SERVING_*/FLEET_*/
+    CKPT_* constant is registered, every registered point is exercised
+    somewhere under tests/ or scripts/ (no dead chaos surface), and —
+    via the fault-point-literal lint rule that test_lint_clean gates —
+    every production fire()/check() site references the registry."""
+    consts = {v for k, v in vars(faults).items()
+              if isinstance(v, str)
+              and k.split("_")[0] in ("SERVING", "FLEET", "CKPT")
+              and "_" in k}
+    assert consts == set(faults.FAULT_POINTS)
+    assert len(faults.FAULT_POINTS) >= 26
+    from paddle_tpu.analysis.dataflow import reference_text
+    corpus = reference_text()
+    missing = sorted(p for p in faults.FAULT_POINTS if p not in corpus)
+    assert missing == [], \
+        f"registered fault points never exercised: {missing}"
+
+
 def test_fault_skip_and_times():
     with faults.injected("p:raise@1*1") as inj:
         faults.fire("p")  # skipped
@@ -133,6 +152,21 @@ def test_manager_commit_latest_restore(tmp_path):
     assert mgr.restore_or_initialize(st) == 2
     np.testing.assert_array_equal(st["x"].numpy(),
                                   np.full(4, 2.0, np.float32))
+
+
+def test_committed_fault_fires_after_marker_durable(tmp_path):
+    """``ckpt.committed`` fires strictly AFTER the COMMITTED marker is
+    durable: a crash injected there is survivable — the retry finds the
+    already-committed copy of the same step and preserves it whole."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    with faults.injected("ckpt.committed:raise*1") as inj:
+        mgr.save(1, _mgr_state(3.0), block=True)
+        assert inj.faults("ckpt.committed")[0].fired == 1
+    assert mgr.latest_step() == 1
+    st = _mgr_state(0.0)
+    assert mgr.restore_or_initialize(st) == 1
+    np.testing.assert_array_equal(st["x"].numpy(),
+                                  np.full(4, 3.0, np.float32))
 
 
 def test_manager_async_save_and_wait(tmp_path):

@@ -469,6 +469,22 @@ class TestHandoff:
         assert final[0].finish_reason == "aborted:drain"
         assert router.num_handoffs == 2
 
+    def test_slow_replica_fault_stalls_router_step(self):
+        # the chaos point slows the router loop WITHOUT touching any
+        # request state: generations are unchanged, only wall time grows
+        ra = FakeReplica("ra", ttft=1.0)
+        router = FleetRouter([ra])
+        rid = router.add_request([1], SamplingParams(max_new_tokens=3))
+        inj = faults.install("fleet.slow_replica:flag:0.05*2")
+        t0 = time.monotonic()
+        outs = _drain_router(router)
+        assert time.monotonic() - t0 >= 0.1
+        assert inj.faults("fleet.slow_replica")[0].fired == 2
+        final = [o for o in outs if o.finished]
+        assert [o.request_id for o in final] == [rid]
+        assert final[0].finish_reason == "length"
+        assert len(final[0].generated) == 3
+
     def test_kill_fault_reenqueues_in_arrival_order(self):
         ra, rb = FakeReplica("ra", ttft=1.0, capacity=8), \
             FakeReplica("rb", ttft=9.0, capacity=8)

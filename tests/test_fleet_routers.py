@@ -277,6 +277,19 @@ def _twin_routers(replicas, **cfg_kw):
 
 
 class TestTwinRouters:
+    def test_lease_fencing_counters_surfaced_by_metrics(self):
+        """PR 18 bumped num_fence_refusals/num_renew_dropped but no
+        fleet gauge surfaced either — the counter-snapshot-drift class
+        this PR's linter now catches at commit time."""
+        ra, _rb = _twin_routers([SimReplica("sr0")])
+        ls = ra.lease_store
+        gen = ls.acquire("r1", ra.router_id, {})
+        assert not ls.renew("r1", "intruder", gen)   # fenced
+        snap = ra.snapshot()
+        assert snap["fleet_lease_fence_refusals"] == \
+            ls.num_fence_refusals == 1
+        assert snap["fleet_lease_renew_dropped"] == ls.num_renew_dropped
+
     def test_replica_ownership_partitions(self):
         replicas = [SimReplica(f"sr{i}") for i in range(8)]
         ra, rb = _twin_routers(replicas)

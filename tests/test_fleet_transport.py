@@ -35,7 +35,10 @@ from paddle_tpu.serving.fleet import (
     ReplicaHandle, ReplicaLoad, ReplicaServicer, RpcClient,
     RpcRemoteError, RpcTimeout, SubprocessReplica,
 )
-from paddle_tpu.serving.fleet.transport import recv_frame, send_frame
+from paddle_tpu.serving.fleet.transport import (
+    IDEMPOTENT_METHODS, MUTATION_METHODS, RpcError, recv_frame,
+    send_frame,
+)
 from paddle_tpu.serving.request import FINISH_REASONS
 from paddle_tpu.testing import faults
 
@@ -103,9 +106,25 @@ class TestRpcClient:
         cl, _ = self._client(
             lambda m: {"id": m["id"], "ok": True,
                        "result": m["params"]["x"] * 2})
-        assert cl.call("double", {"x": 21}) == 42
-        assert cl.call("double", {"x": 3}) == 6
+        # "double" is a test-only verb outside the fleet partition, so
+        # it must be classified explicitly at the call site
+        assert cl.call("double", {"x": 21}, idempotent=True) == 42
+        assert cl.call("double", {"x": 3}, idempotent=True) == 6
         assert cl.stats["calls"] == 2
+        cl.close()
+
+    def test_unclassified_verb_raises_not_defaults(self):
+        """PR 19 shipped tier_stats dispatched but classified nowhere —
+        it silently became a non-retried mutation. Now an unclassified
+        verb refuses to pick a retry policy at all."""
+        cl, _ = self._client(
+            lambda m: {"id": m["id"], "ok": True, "result": 1})
+        with pytest.raises(RpcError, match="neither"):
+            cl.call("double", {"x": 1})
+        # explicit classification and partitioned verbs still work
+        assert cl.call("tier_stats", {}) == 1   # now IDEMPOTENT
+        assert "tier_stats" in IDEMPOTENT_METHODS
+        assert IDEMPOTENT_METHODS.isdisjoint(MUTATION_METHODS)
         cl.close()
 
     def test_mutation_timeout_no_retry(self):

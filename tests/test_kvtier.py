@@ -375,6 +375,44 @@ class TestParkResume:
         _run(eng)
         assert eng._kvtier.num_resume_recomputes == 1
         assert eng._kvtier.num_resume_recomputed_tokens > 0
+        # both counters are part of the stats() vocabulary (they were
+        # bumped-but-never-read before PR 20's drift linter)
+        stats = eng.tier_stats()
+        assert stats["resume_recomputes"] == 1
+        assert stats["resume_recomputed_tokens"] > 0
+        ref = _reference(tiny_model, {"s2": (prompt2, GREEDY)})
+        assert list(eng.get_request("s2").generated) == ref["s2"]
+
+    def test_torn_tail_restore_frees_resumed_claim(self, tiny_model):
+        """A tail restore that dies mid-copy must free the whole
+        resumed chain claim (the leaked-resource-on-raise class this
+        PR's linter flags) while keeping the session record, so the
+        SAME resume retries cleanly."""
+        rng = np.random.default_rng(13)
+        prompt = [int(t) for t in rng.integers(0, 255, size=22)]
+        eng = LLMEngine(tiny_model, _tiered_cfg(num_blocks=16))
+        eng.add_request("s", prompt, sampling=GREEDY)
+        _run(eng)
+        turn1 = list(eng.get_request("s").generated)
+        eng.release_request("s")
+        info = eng.park_session("s")
+        assert info is not None and info["parked"]
+        prompt2 = prompt + turn1 + [1, 2, 3]
+        def torn(*a):
+            raise RuntimeError("torn tail copy")
+        eng._pin_caches = torn          # dies inside the tail restore
+        try:
+            with pytest.raises(RuntimeError, match="torn tail copy"):
+                eng.resume_session("s2", "s", prompt2, sampling=GREEDY)
+        finally:
+            del eng._pin_caches         # back to the class method
+        bm = eng.block_manager
+        assert not bm.has_table("s2")     # the claim did not strand
+        bm.check_invariants()
+        assert eng.session_info("s") is not None  # kept for the retry
+        hit = eng.resume_session("s2", "s", prompt2, sampling=GREEDY)
+        assert hit == info["tokens_covered"]
+        _run(eng)
         ref = _reference(tiny_model, {"s2": (prompt2, GREEDY)})
         assert list(eng.get_request("s2").generated) == ref["s2"]
 

@@ -88,6 +88,26 @@ def test_suppression_inventory_is_intentional():
         # os.replace deliberately run under _BUILD_LOCK — serializing
         # the slow compile is the lock's entire purpose
         "paddle_tpu/io/shm_queue.py": 3,
+        # fleet/router.py ×3 (leaked-resource-on-raise): the KV-ship
+        # ticket ladders — every walk ends in exactly one counted
+        # outcome because the ReplicaHandle RPC wrappers catch all
+        # transport errors and return None rather than raising; the
+        # walker can't see that cross-module no-raise contract
+        "paddle_tpu/serving/fleet/router.py": 3,
+        # request.py (counter-snapshot-drift): num_swaps is a
+        # per-request diagnostic asserted directly by the resilience
+        # tests; the fleet-visible aggregate is the scheduler's
+        # swapped_out gauge
+        "paddle_tpu/serving/request.py": 1,
+        # fleet/sim.py (counter-snapshot-drift): num_steps is a
+        # per-tick work flag the sim loop itself reads and resets to
+        # pace stepping — not a lifetime counter
+        "paddle_tpu/serving/fleet/sim.py": 1,
+        # fleet/supervisor.py ×2 (counter-snapshot-drift): the
+        # num_spawns/num_restarts ledger is asserted directly by the
+        # failover tests; the supervisor runs beside the router fleet,
+        # outside the router-scoped gauge maps
+        "paddle_tpu/serving/fleet/supervisor.py": 2,
     }
     found = {}
     bare = re.compile(r"tpulint:\s*disable=")

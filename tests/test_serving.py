@@ -375,6 +375,35 @@ def test_scheduler_host_pool_exhaustion_falls_back_to_recompute():
     bm.check_invariants()
 
 
+def test_scheduler_torn_spill_copy_frees_host_slots():
+    """A copy_out that dies mid-spill must not strand the victim's host
+    slots (the leaked-resource-on-raise class this PR's linter flags):
+    the slots come back and the victim demotes to the recompute path."""
+    class _TornSwapper(_StubSwapper):
+        def copy_out(self, request, dev_table, host_table):
+            raise RuntimeError("DMA torn mid-frame")
+
+    bm = BlockManager(num_blocks=4, block_size=2, num_host_blocks=4)
+    s = Scheduler(bm, SchedulerConfig(max_num_seqs=4), swap_mode="host",
+                  kv_swapper=_TornSwapper())
+    a = _req("a", n_prompt=4, max_new=8, arrival=1.0)
+    b = _req("b", n_prompt=4, max_new=8, arrival=2.0)
+    for r in (a, b):
+        s.add(r)
+    s.schedule()
+    for r in (a, b):
+        r.num_cached += len(r.tokens_to_run())
+        r.append_token(7)
+    batch = s.schedule()                     # OOM -> spill of b tears
+    assert [r.request_id for r in batch.preempted] == ["b"]
+    assert b.status == RequestStatus.WAITING  # recompute, not SWAPPED
+    assert b.num_cached == 0
+    assert s.num_swap_outs == 0              # the spill never counted
+    assert not bm.has_host_table("b")        # host slots reclaimed
+    assert bm.num_free_host_blocks == 4
+    bm.check_invariants()
+
+
 def test_scheduler_priority_orders_admission_and_eviction():
     """priority < 0 beats FCFS: a late VIP admits first and is never
     the eviction victim while a lower-priority peer remains."""

@@ -185,6 +185,25 @@ def test_serving_counters_registered_in_profiler(tiny_model):
     assert snap["ttft_ms_avg"] > 0
 
 
+def test_cow_copies_surfaced_by_metrics(tiny_model):
+    """BlockManager.num_cow_copies was bumped since PR 13 but surfaced
+    by no gauge or snapshot key — the counter-snapshot-drift class."""
+    from paddle_tpu import profiler
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    assert "cow_copies" in ServingMetrics.GAUGES
+    m = tiny_model
+    eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
+                                    max_model_len=32))
+    eng.add_request([1, 2, 3], sampling=SamplingParams(max_new_tokens=2))
+    eng.run()
+    c = profiler.counters()
+    assert c[f"serving/cow_copies#{id(eng)}"] == \
+        eng.block_manager.num_cow_copies
+    assert eng.metrics.snapshot()["serving_cow_copies"] == \
+        eng.block_manager.num_cow_copies
+
+
 def test_engine_admission_validation(tiny_model):
     m = tiny_model
     eng = LLMEngine(m, EngineConfig(block_size=4, max_num_seqs=2,
